@@ -1,0 +1,89 @@
+#!/usr/bin/env python
+"""Benchmark harness — prints ONE JSON line for the driver.
+
+Measures steady-state training throughput (images/sec/chip) of the flagship
+AlexNet BSP configuration on the available hardware — the reference's
+headline metric (time per 5120 images, SURVEY.md §6) recast per-chip as
+``BASELINE.json`` specifies.
+
+The reference's published numbers are not retrievable this session
+(``BASELINE.md``): ``vs_baseline`` is computed against an ESTIMATED 1×K80
+AlexNet figure from the Theano-MPI era (~128 images/sec for batch-128
+train+comm on one worker — the order of magnitude the arXiv:1605.08325 setup
+reports qualitatively).  Replace ``K80_ALEXNET_IPS`` if real numbers surface.
+"""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+K80_ALEXNET_IPS = 128.0   # estimated reference single-K80 AlexNet throughput
+
+
+def main() -> int:
+    model_name = os.environ.get("BENCH_MODEL", "alexnet")
+    iters = int(os.environ.get("BENCH_ITERS", "20"))
+    warmup = int(os.environ.get("BENCH_WARMUP", "5"))
+
+    import jax
+    from theanompi_tpu.parallel.exchanger import BSP_Exchanger
+    from theanompi_tpu.parallel.mesh import WORKER_AXIS, worker_mesh
+    from theanompi_tpu.parallel import steps
+
+    mesh = worker_mesh()
+    n_chips = mesh.shape[WORKER_AXIS]
+    config = {"mesh": mesh, "size": n_chips, "rank": 0, "verbose": False}
+
+    if model_name == "alexnet":
+        from theanompi_tpu.models.alex_net import AlexNet
+        config["synthetic_batches"] = 4
+        model = AlexNet(config)
+    else:
+        from theanompi_tpu.models.cifar10 import Cifar10_model
+        config["synthetic_train"] = 4096
+        model = Cifar10_model(config)
+
+    model.compile_iter_fns(BSP_Exchanger(config))
+    batch = model.data.next_train_batch(0)
+    dev_batch = steps.put_batch(mesh, batch)
+    n_images = int(batch["y"].shape[0])
+
+    import jax.numpy as jnp
+    lr = jnp.float32(model.current_lr)
+    rng = jax.random.key(0)
+
+    def step(i):
+        nonlocal dev_batch
+        model.step_state, cost, err = model.train_fn(
+            model.step_state, dev_batch, lr, rng, jnp.int32(i))
+        return cost
+
+    for i in range(warmup):
+        cost = step(i)
+    jax.block_until_ready(cost)
+
+    t0 = time.time()
+    for i in range(iters):
+        cost = step(warmup + i)
+    jax.block_until_ready(cost)
+    dt = time.time() - t0
+
+    ips = n_images * iters / dt
+    ips_chip = ips / n_chips
+    out = {
+        "metric": f"images_per_sec_per_chip ({model_name} batch "
+                  f"{model.batch_size} BSP, {n_chips} chip(s), "
+                  f"{jax.devices()[0].platform})",
+        "value": round(ips_chip, 2),
+        "unit": "images/sec/chip",
+        "vs_baseline": round(ips_chip / K80_ALEXNET_IPS, 3),
+    }
+    print(json.dumps(out))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,87 @@
+"""Test harness: simulate an 8-device mesh on CPU.
+
+SURVEY.md §4: the reference had no unit-testable communicator — multi-GPU
+correctness was only checkable on a real cluster.  JAX's forced host platform
+device count gives every exchanger/rule a real 8-way mesh in CI.
+
+NOTE: ``JAX_PLATFORMS=cpu`` as an env var is hijacked by the axon TPU plugin
+in this environment; the programmatic config update below is the reliable
+way to force CPU (see .claude/skills/verify/SKILL.md).
+"""
+
+import os
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+from theanompi_tpu.models import layers as L  # noqa: E402
+from theanompi_tpu.models.data import DataBase  # noqa: E402
+from theanompi_tpu.models.model_base import ModelBase  # noqa: E402
+
+
+class SyntheticData(DataBase):
+    """Tiny deterministic 2-class dataset for fast rule/equivalence tests."""
+
+    DIM = 16
+
+    def __init__(self, config=None, batch_size=8, n_train=256, n_val=64):
+        super().__init__(config, batch_size)
+        rng = np.random.RandomState(7)
+        w = rng.randn(self.DIM)
+
+        def make(n, seed):
+            r = np.random.RandomState(seed)
+            x = r.randn(n, self.DIM).astype(np.float32)
+            y = (x @ w > 0).astype(np.int32)
+            return x, y
+
+        self.x_train, self.y_train = make(n_train, 11)
+        self.x_val, self.y_val = make(n_val, 22)
+        self._finalize()
+
+
+class TinyModel(ModelBase):
+    """Minimal MLP following the full model contract — compiles in seconds
+    on the CPU mesh, used by rule/equivalence/checkpoint tests."""
+
+    batch_size = 8
+    epochs = 2
+    n_subb = 1
+    learning_rate = 0.05
+    momentum = 0.9
+    weight_decay = 0.0
+    lr_adjust_epochs = ()
+    seed = 3
+
+    def build_model(self):
+        import jax.numpy as jnp
+        cd = self.config.get("compute_dtype", jnp.float32)
+        dim = SyntheticData.DIM
+        self.seq = L.Sequential([
+            L.FC(dim, 32, w_init="he", compute_dtype=cd, name="fc1"),
+            L.FC(32, 2, w_init=("normal", 0.01), activation=None,
+                 compute_dtype=cd, name="out"),
+        ])
+        self.data = SyntheticData(self.config, self.batch_size,
+                                  n_train=int(self.config.get("n_train", 256)))
+
+
+@pytest.fixture(scope="session")
+def mesh8():
+    from theanompi_tpu.parallel.mesh import worker_mesh
+    return worker_mesh(8)
+
+
+@pytest.fixture(scope="session")
+def mesh4():
+    from theanompi_tpu.parallel.mesh import worker_mesh
+    return worker_mesh(4)

@@ -1,0 +1,157 @@
+"""Layer library unit tests vs NumPy oracles (reference layers2.py parity:
+Conv/Pool/LRN/FC/Dropout/Softmax/BatchNorm — SURVEY.md §2.7)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from theanompi_tpu.models import layers as L
+
+KEY = jax.random.key(0)
+F32 = jnp.float32
+
+
+def test_conv_shapes_and_groups():
+    x = jnp.ones((2, 16, 16, 4))
+    conv = L.Conv(4, 8, 3, padding="SAME", compute_dtype=F32, name="c")
+    p = conv.init(KEY)
+    assert p["w"].shape == (3, 3, 4, 8)
+    y = conv.apply(p, x)
+    assert y.shape == (2, 16, 16, 8)
+    # AlexNet-style 2-group conv halves the per-group input channels
+    gconv = L.Conv(4, 8, 3, groups=2, compute_dtype=F32, name="g")
+    gp = gconv.init(KEY)
+    assert gp["w"].shape == (3, 3, 2, 8)
+    assert gconv.apply(gp, x).shape == (2, 16, 16, 8)
+
+
+def test_conv_stride_valid():
+    x = jnp.ones((1, 227, 227, 3))
+    conv = L.Conv(3, 96, 11, stride=4, padding="VALID", compute_dtype=F32)
+    y = conv.apply(conv.init(KEY), x)
+    assert y.shape == (1, 55, 55, 96)   # AlexNet conv1 geometry
+
+
+def test_conv_grad_works_in_bf16():
+    """The default mixed-precision path must be differentiable (regression:
+    conv transpose with preferred_element_type broke in jax 0.9)."""
+    conv = L.Conv(3, 4, 3, compute_dtype=jnp.bfloat16, name="c")
+    p = conv.init(KEY)
+    x = jnp.ones((2, 8, 8, 3))
+
+    def loss(p):
+        return conv.apply(p, x).astype(jnp.float32).sum()
+
+    g = jax.grad(loss)(p)
+    assert g["w"].shape == p["w"].shape
+    assert bool(jnp.isfinite(g["w"]).all())
+
+
+def test_pool_max_oracle():
+    x = jnp.asarray(np.arange(16, dtype=np.float32).reshape(1, 4, 4, 1))
+    pool = L.Pool(2, 2, mode="max")
+    y = np.asarray(pool.apply(None, x))[0, :, :, 0]
+    np.testing.assert_array_equal(y, [[5, 7], [13, 15]])
+
+
+def test_pool_avg_oracle():
+    x = jnp.ones((1, 4, 4, 1))
+    pool = L.Pool(2, 2, mode="avg")
+    np.testing.assert_allclose(np.asarray(pool.apply(None, x)), 1.0)
+
+
+def test_overlapping_pool():
+    # the reference zoo's 3x3/stride-2 overlapping pooling
+    x = jnp.ones((1, 15, 15, 2))
+    pool = L.Pool(3, 2, mode="max")
+    assert pool.apply(None, x).shape == (1, 7, 7, 2)
+
+
+def test_lrn_oracle():
+    rng = np.random.RandomState(0)
+    x = rng.rand(2, 3, 3, 7).astype(np.float32)
+    lrn = L.LRN(n=5, k=2.0, alpha=1e-4, beta=0.75)
+    got = np.asarray(lrn.apply(None, jnp.asarray(x)))
+    # numpy oracle: cross-channel windowed sum of squares
+    sq = x ** 2
+    pad = np.pad(sq, [(0, 0)] * 3 + [(2, 2)])
+    ssum = sum(pad[..., i:i + 7] for i in range(5))
+    expect = x / (2.0 + (1e-4 / 5) * ssum) ** 0.75
+    np.testing.assert_allclose(got, expect, rtol=1e-5, atol=1e-6)
+
+
+def test_dropout_train_vs_eval():
+    d = L.Dropout(0.5)
+    x = jnp.ones((4, 100))
+    # eval: identity
+    np.testing.assert_array_equal(np.asarray(d.apply(None, x)), 1.0)
+    # train: ~half dropped, survivors scaled 2x
+    y = np.asarray(d.apply(None, x, train=True, rng=jax.random.key(1)))
+    assert set(np.unique(y)) <= {0.0, 2.0}
+    assert 0.3 < (y == 0).mean() < 0.7
+
+
+def test_batchnorm_train_and_running_stats():
+    bn = L.BatchNorm(4, momentum=0.5)
+    p, s = bn.init(KEY), bn.init_state()
+    rng = np.random.RandomState(1)
+    x = jnp.asarray(rng.randn(32, 5, 5, 4).astype(np.float32) * 3 + 1)
+    y, s2 = bn.apply(p, x, train=True, state=s)
+    ym = np.asarray(y).mean(axis=(0, 1, 2))
+    ys = np.asarray(y).std(axis=(0, 1, 2))
+    np.testing.assert_allclose(ym, 0.0, atol=1e-4)
+    np.testing.assert_allclose(ys, 1.0, atol=1e-2)
+    # running stats pulled halfway (momentum 0.5) toward batch stats
+    assert (np.asarray(s2["mean"]) != 0).all()
+    # eval mode uses running stats and returns no update
+    y_eval, s3 = bn.apply(p, x, train=False, state=s2)
+    assert s3 is None
+
+
+def test_sequential_threads_bn_state():
+    seq = L.Sequential([
+        L.FC(8, 8, compute_dtype=F32, name="fc"),
+        L.BatchNorm(8, name="bn"),
+    ])
+    p = seq.init(KEY)
+    s = seq.init_state()
+    x = jnp.ones((4, 8))
+    y, s2 = seq.apply(p, x, train=True, state=s)
+    assert y.shape == (4, 8)
+    assert "bn" in s2 and (np.asarray(s2["bn"]["mean"]) !=
+                           np.asarray(s["bn"]["mean"])).any()
+
+
+def test_sequential_unique_names():
+    seq = L.Sequential([L.Pool(2, name="p"), L.Pool(2, name="p")])
+    assert seq._keys == ["p", "p_1"]
+
+
+def test_softmax_cross_entropy_oracle():
+    logits = jnp.asarray([[2.0, 0.0, -2.0], [0.0, 0.0, 0.0]])
+    labels = jnp.asarray([0, 2])
+    got = float(L.softmax_cross_entropy(logits, labels))
+    p0 = np.exp(2) / (np.exp(2) + 1 + np.exp(-2))
+    expect = (-np.log(p0) - np.log(1 / 3)) / 2
+    np.testing.assert_allclose(got, expect, rtol=1e-6)
+
+
+def test_errors_topk():
+    logits = jnp.asarray([[5., 4., 3., 2., 1., 0.]] * 2)
+    labels = jnp.asarray([0, 5])
+    assert float(L.errors(logits, labels)) == 0.5
+    assert float(L.errors_top_x(logits, labels, 5)) == 0.5
+    assert float(L.errors_top_x(logits, labels, 6)) == 0.0
+
+
+def test_init_schemes():
+    k = jax.random.key(2)
+    w = L.init_weight(k, (1000,), ("normal", 0.01))
+    assert 0.005 < float(jnp.std(w)) < 0.015
+    c = L.init_weight(k, (10,), ("constant", 0.1))
+    np.testing.assert_allclose(np.asarray(c), 0.1)
+    he = L.init_weight(k, (100, 100), "he")
+    assert 0.1 < float(jnp.std(he)) < 0.2    # sqrt(2/100) ≈ 0.141
+    with pytest.raises(ValueError):
+        L.init_weight(k, (3,), "bogus")

@@ -1,0 +1,122 @@
+"""Per-rule behavior tests (SURVEY.md §4 item c, plus rule invariants the
+reference never machine-checked)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tests.conftest import TinyModel
+from theanompi_tpu.parallel import steps
+from theanompi_tpu.parallel.exchanger import (ASGD_Exchanger, BSP_Exchanger,
+                                              EASGD_Exchanger,
+                                              GOSGD_Exchanger, get_exchanger)
+from theanompi_tpu.parallel.mesh import worker_mesh
+
+
+def _setup(exchanger_cls, n=8, **cfg):
+    mesh = worker_mesh(n)
+    config = {"mesh": mesh, "size": n, "rank": 0, "verbose": False,
+              "batch_size": 8, "sync_each_iter": True, **cfg}
+    model = TinyModel(config)
+    exch = exchanger_cls(config)
+    model.compile_iter_fns(exch)
+    model.data.shuffle_data(0)
+    return model, exch
+
+
+@pytest.mark.parametrize("rule", ["bsp", "easgd", "asgd", "gosgd"])
+def test_rule_convergence_smoke(rule):
+    """Few-iteration convergence smoke per rule — the reference's session
+    scripts, made assertable."""
+    model, exch = _setup(get_exchanger(rule).__class__,
+                         sync_freq=2, exch_prob=0.8)
+    costs = []
+    for i in range(10):
+        model.train_iter(i + 1, None)
+        exch.exchange(None, i + 1)
+        costs.append(float(model.current_info["cost"]))
+    assert np.mean(costs[-3:]) < np.mean(costs[:3]), costs
+
+
+def test_easgd_center_moves_toward_workers():
+    model, exch = _setup(EASGD_Exchanger, sync_freq=1, alpha=0.5)
+    center0 = jax.device_get(exch.canonical_params(model.step_state))
+    for i in range(3):
+        model.train_iter(i + 1, None)
+        exch.exchange(None, i + 1)
+    center1 = jax.device_get(exch.canonical_params(model.step_state))
+    moved = any(
+        not np.allclose(a, b)
+        for a, b in zip(jax.tree_util.tree_leaves(center0),
+                        jax.tree_util.tree_leaves(center1)))
+    assert moved
+
+
+def test_easgd_workers_pulled_toward_center():
+    """After an elastic exchange, worker-replica spread must shrink."""
+    model, exch = _setup(EASGD_Exchanger, sync_freq=10**9, alpha=0.5)
+    for i in range(4):   # local steps only — replicas diverge
+        model.train_iter(i + 1, None)
+
+    def spread(state):
+        leaves = jax.tree_util.tree_leaves(
+            jax.device_get(state["params"]))
+        return sum(np.ptp(l, axis=0).mean() for l in leaves)
+
+    before = spread(model.step_state)
+    assert before > 0
+    exch.exchange_freq = 1
+    exch.exchange(None, 1)
+    after = spread(model.step_state)
+    assert after < before * 0.75
+
+
+def test_asgd_pull_resets_workers_to_center():
+    model, exch = _setup(ASGD_Exchanger, sync_freq=1)
+    for i in range(2):
+        model.train_iter(i + 1, None)
+        exch.exchange(None, i + 1)
+    state = model.step_state
+    params = jax.device_get(state["params"])
+    center = jax.device_get(steps.unbox(state["extra"])["center"])
+    for pl, cl in zip(jax.tree_util.tree_leaves(params),
+                      jax.tree_util.tree_leaves(center)):
+        for w in range(8):
+            np.testing.assert_allclose(pl[w], cl, rtol=1e-6, atol=1e-7)
+
+
+def test_gosgd_alpha_sum_conserved():
+    """GoSGD's Σα invariant (mixing weights are redistributed, never created
+    or destroyed)."""
+    model, exch = _setup(GOSGD_Exchanger, exch_prob=0.9)
+    for i in range(6):
+        model.train_iter(i + 1, None)
+        exch.exchange(None, i + 1)
+        alpha = np.asarray(
+            jax.device_get(model.step_state["extra"]["alpha"]))
+        np.testing.assert_allclose(alpha.sum(), 8.0, rtol=1e-5)
+        assert (alpha > 0).all()
+
+
+def test_gosgd_gossip_mixes_replicas():
+    """With p=1 gossip every step, replicas must contract toward consensus
+    versus never-exchanging replicas."""
+    model, exch = _setup(GOSGD_Exchanger, exch_prob=1.0)
+    model_ref, _ = _setup(GOSGD_Exchanger, exch_prob=1.0)
+
+    def spread(m):
+        leaves = jax.tree_util.tree_leaves(jax.device_get(
+            m.step_state["params"]))
+        return sum(np.ptp(l, axis=0).mean() for l in leaves)
+
+    for i in range(6):
+        model.train_iter(i + 1, None)
+        exch.exchange(None, i + 1)
+        model_ref.train_iter(i + 1, None)   # no exchange
+    assert spread(model) < spread(model_ref)
+
+
+def test_unknown_rule_raises():
+    with pytest.raises(ValueError, match="unknown exchanger"):
+        get_exchanger("gossip")

@@ -1,0 +1,16 @@
+"""theanompi_tpu — a TPU-native distributed training framework with the
+capabilities of Theano-MPI (wanjinchang/Theano-MPI; see SURVEY.md).
+
+Public session API (contract-compatible with the reference, SURVEY.md §2.6):
+
+    from theanompi_tpu import BSP
+    rule = BSP()
+    rule.init(devices=4, modelfile='theanompi_tpu.models.cifar10',
+              modelclass='Cifar10_model')
+    rule.wait()
+"""
+
+from .sync_rule import ASGD, BSP, EASGD, GOSGD, SyncRule
+
+__version__ = "0.1.0"
+__all__ = ["BSP", "EASGD", "ASGD", "GOSGD", "SyncRule", "__version__"]

@@ -1,0 +1,61 @@
+"""Process/runtime core.
+
+TPU-native rebuild of Theano-MPI's ``theanompi/lib/base.py``
+(SURVEY.md §2.1): the reference's ``MPI_GPU_Process`` did MPI init
+(``MPI.COMM_WORLD`` rank/size), GPU device binding via ``THEANO_FLAGS``, and
+model import by dotted ``modelfile`` string + ``modelclass`` name, building
+the shared ``config`` dict handed to models.
+
+Here one Python process per HOST drives all its local chips; "rank/size" map
+to ``jax.process_index()`` / the worker-mesh extent; device binding is
+unnecessary (XLA owns the chips); the communicator object is the named-axis
+mesh from :mod:`theanompi_tpu.parallel.mesh`.  Method names are kept for
+contract parity.
+"""
+
+from __future__ import annotations
+
+import importlib
+from typing import Optional
+
+import jax
+
+from .parallel.mesh import WORKER_AXIS, init_multihost, worker_mesh
+
+
+class MeshProcess:
+    """≙ reference ``MPI_GPU_Process``."""
+
+    def __init__(self, config: Optional[dict] = None):
+        self.config = dict(config or {})
+        self.verbose: bool = self.config.get("verbose", True)
+        self.mesh = None
+        self.rank = 0
+        self.size = 1
+
+    def get_internode_comm(self):
+        """Bring up the communicator (≙ MPI_Init + COMM_WORLD): multi-host
+        control plane if configured, then the 1-D workers mesh."""
+        init_multihost(
+            coordinator_address=self.config.get("coordinator_address"),
+            num_processes=self.config.get("num_processes"),
+            process_id=self.config.get("process_id"),
+        )
+        self.mesh = worker_mesh(self.config.get("n_workers"))
+        self.rank = jax.process_index()
+        self.size = self.mesh.shape[WORKER_AXIS]
+        self.config.update(rank=self.rank, size=self.size, mesh=self.mesh,
+                           verbose=self.verbose and self.rank == 0)
+        return self.mesh
+
+    def init_device(self):
+        """No-op on TPU (the reference bound THEANO_FLAGS=device=cudaN here);
+        kept so session scripts written against the reference API run."""
+        return jax.devices()
+
+    def build_model(self, modelfile: str, modelclass: str):
+        """Import the model by dotted module path + class name — identical
+        contract to the reference's importlib-based model loading."""
+        mod = importlib.import_module(modelfile)
+        cls = getattr(mod, modelclass)
+        return cls(self.config)

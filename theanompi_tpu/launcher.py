@@ -1,0 +1,89 @@
+"""Launcher.
+
+TPU-native rebuild of Theano-MPI's ``theanompi/launcher.py``
+(SURVEY.md §2.6): the reference composed an ``mpirun -np N ... python -u -m
+theanompi.<worker> <modelfile> <modelclass>`` command line (MPMD for EASGD's
+server+workers) with per-rank ``THEANO_FLAGS`` env, spawned it, and forwarded
+worker stdout.
+
+On TPU there is nothing to spawn on a single host — one process drives all
+local chips — so the local path simply runs the worker in-process.  For a
+multi-host TPU pod slice the launcher composes the per-host command lines
+(every host runs the SAME program under ``jax.distributed``; rank binding is
+automatic), either printing them for ``gcloud compute tpus tpu-vm ssh
+--worker=all --command=...`` or executing the local host's share.
+"""
+
+from __future__ import annotations
+
+import argparse
+import shlex
+import subprocess
+import sys
+from typing import List, Optional
+
+
+def compose_worker_cmd(rule: str, modelfile: str, modelclass: str,
+                       config_kv: List[str],
+                       coordinator: Optional[str] = None,
+                       num_processes: Optional[int] = None,
+                       process_id: Optional[int] = None) -> List[str]:
+    """Build the per-host worker command (≙ the reference's mpirun line)."""
+    cmd = [sys.executable, "-u", "-m", "theanompi_tpu.worker",
+           rule, modelfile, modelclass]
+    if coordinator:
+        cmd.append(f"coordinator_address={coordinator}")
+    if num_processes:
+        cmd.append(f"num_processes={num_processes}")
+    if process_id is not None:
+        cmd.append(f"process_id={process_id}")
+    cmd.extend(config_kv)
+    return cmd
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="theanompi_tpu.launcher",
+        description="Launch distributed training (≙ Theano-MPI's mpirun "
+                    "composition). Local: runs in-process over all chips. "
+                    "--num-hosts>1: prints/executes per-host commands.")
+    p.add_argument("--rule", default="bsp",
+                   choices=["bsp", "easgd", "asgd", "gosgd"])
+    p.add_argument("--modelfile", default="theanompi_tpu.models.cifar10")
+    p.add_argument("--modelclass", default="Cifar10_model")
+    p.add_argument("--n-workers", type=int, default=None,
+                   help="chips to use on this host (default: all)")
+    p.add_argument("--num-hosts", type=int, default=1)
+    p.add_argument("--coordinator", default=None,
+                   help="host:port of process 0 (multi-host)")
+    p.add_argument("--process-id", type=int, default=None,
+                   help="this host's index (multi-host exec mode)")
+    p.add_argument("--emit-only", action="store_true",
+                   help="print the per-host commands instead of executing")
+    p.add_argument("config", nargs="*", help="key=value model/worker config")
+    args = p.parse_args(argv)
+
+    kv = list(args.config)
+    if args.n_workers:
+        kv.append(f"n_workers={args.n_workers}")
+
+    if args.num_hosts > 1:
+        cmds = [compose_worker_cmd(args.rule, args.modelfile, args.modelclass,
+                                   kv, args.coordinator, args.num_hosts, i)
+                for i in range(args.num_hosts)]
+        if args.emit_only or args.process_id is None:
+            print("# run on each TPU host (e.g. via gcloud compute tpus "
+                  "tpu-vm ssh --worker=all):")
+            for i, c in enumerate(cmds):
+                print(f"# host {i}:")
+                print(shlex.join(c))
+            return 0
+        return subprocess.call(cmds[args.process_id])
+
+    # single host: in-process (no spawn needed — the mesh IS the workers)
+    from .worker import main as worker_main
+    return worker_main([args.rule, args.modelfile, args.modelclass] + kv)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,5 @@
+"""Model zoo following the duck-typed Theano-MPI contract (SURVEY.md §2.5).
+
+Models are imported lazily by dotted path (the reference's importlib
+convention), e.g. ``theanompi_tpu.models.cifar10:Cifar10_model``.
+"""

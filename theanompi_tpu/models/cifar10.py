@@ -1,0 +1,49 @@
+"""CIFAR-10 CNN — the smoke-test model.
+
+Reference: ``theanompi/models/cifar10.py`` (SURVEY.md §2.7) — the
+``Cifar10_model`` used in the README quick-start and every rule's session
+test.  Same role here: a small conv net following the full model contract,
+fast enough to train on an 8-device CPU mesh in CI.
+
+Architecture (conv-pool ×3 + FC, ReLU, momentum SGD with step decay): kept in
+the reference's AlexNet-era style; hyperparameters live as class attributes —
+the module-level-dict config system of the reference (SURVEY.md §5.6).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from . import layers as L
+from .data.cifar10 import Cifar10_data
+from .model_base import ModelBase
+
+
+class Cifar10_model(ModelBase):
+    batch_size = 128
+    epochs = 30
+    n_subb = 1
+    learning_rate = 0.05
+    momentum = 0.9
+    weight_decay = 0.0001
+    lr_adjust_epochs = (20, 25)
+
+    def build_model(self) -> None:
+        cd = self.config.get("compute_dtype", jnp.bfloat16)
+        self.seq = L.Sequential([
+            L.Conv(3, 64, 5, padding="SAME", w_init="he",
+                   compute_dtype=cd, name="conv1"),
+            L.Pool(3, 2, mode="max", name="pool1"),
+            L.Conv(64, 128, 5, padding="SAME", w_init="he",
+                   compute_dtype=cd, name="conv2"),
+            L.Pool(3, 2, mode="max", name="pool2"),
+            L.Conv(128, 128, 3, padding="SAME", w_init="he",
+                   compute_dtype=cd, name="conv3"),
+            L.Pool(3, 2, mode="max", name="pool3"),
+            L.Flatten(),
+            L.FC(128 * 3 * 3, 256, w_init="he", compute_dtype=cd, name="fc1"),
+            L.Dropout(0.5, name="drop1"),
+            L.FC(256, 10, w_init=("normal", 0.01), activation=None,
+                 compute_dtype=cd, name="softmax"),
+        ])
+        self.data = Cifar10_data(self.config, self.batch_size)

@@ -1,0 +1,74 @@
+"""Data pipeline.
+
+TPU-native rebuild of Theano-MPI's ``theanompi/models/data/``
+(SURVEY.md §2.8): sharded train/val file lists, common-seed shuffling (all
+workers permute identically, each takes its stride), CPU-side augmentation,
+and a parallel loader that overlaps I/O + augment with compute.
+
+The reference's flagship loader spawned a child process per worker via
+``MPI.COMM_SELF.Spawn`` that wrote augmented batches straight into the
+trainer's GPU buffer through a CUDA IPC handle.  The TPU equivalent is a
+background prefetch pipeline per host (``theanompi_tpu.models.data.prefetch``)
+that double-buffers ``jax.device_put`` onto the local shards — async
+host→device transfer replaces the IPC trick.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import numpy as np
+
+
+class DataBase:
+    """In-memory dataset with the reference's sharding/shuffle semantics.
+
+    A "global batch" is ``size × batch_size`` samples (each worker consumed
+    its own ``batch_size``-image file batch in the reference); the mesh
+    splits it so chip *i* sees the *i*-th contiguous block — the stride-style
+    partition the reference used on its shuffled filename list.
+    """
+
+    def __init__(self, config: Optional[dict] = None, batch_size: int = 128):
+        self.config = dict(config or {})
+        self.size = self.config.get("size", 1)
+        self.batch_size = batch_size
+        self.global_batch = self.size * batch_size
+        self.x_train = self.y_train = self.x_val = self.y_val = None
+        self._perm = None
+        self._train_ptr = 0
+        self._val_ptr = 0
+
+    # subclasses populate x/y arrays then call _finalize()
+    def _finalize(self) -> None:
+        n_train, n_val = len(self.y_train), len(self.y_val)
+        self.n_batch_train = n_train // self.global_batch
+        self.n_batch_val = max(1, n_val // self.global_batch)
+        self._perm = np.arange(n_train)
+        assert self.n_batch_train > 0, (
+            f"{n_train} train samples < one global batch {self.global_batch}")
+
+    def shuffle_data(self, seed: int) -> None:
+        """Common-seed shuffle (reference: identical RNG on all ranks so the
+        strided shards are disjoint)."""
+        rng = np.random.RandomState(seed)
+        self._perm = rng.permutation(len(self.y_train))
+        self._train_ptr = 0
+        self._val_ptr = 0
+
+    def next_train_batch(self, count: int) -> Dict[str, np.ndarray]:
+        i = self._train_ptr % self.n_batch_train
+        self._train_ptr += 1
+        idx = self._perm[i * self.global_batch:(i + 1) * self.global_batch]
+        return self._make_batch(self.x_train[idx], self.y_train[idx], train=True)
+
+    def next_val_batch(self, count: int) -> Dict[str, np.ndarray]:
+        i = self._val_ptr % self.n_batch_val
+        self._val_ptr += 1
+        sl = slice(i * self.global_batch, (i + 1) * self.global_batch)
+        return self._make_batch(self.x_val[sl], self.y_val[sl], train=False)
+
+    def _make_batch(self, x, y, train: bool) -> Dict[str, np.ndarray]:
+        """Hook for augmentation; default: cast only."""
+        return {"x": np.ascontiguousarray(x, dtype=np.float32),
+                "y": np.ascontiguousarray(y, dtype=np.int32)}

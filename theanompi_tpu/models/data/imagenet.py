@@ -1,0 +1,174 @@
+"""ImageNet data object.
+
+Reference: ``theanompi/models/data/imagenet.py`` (SURVEY.md §2.8) — ImageNet
+pre-processed offline into hickle ``.hkl`` files (one file = one 128-image
+uint8 batch, inherited from ``uoguelph-mlrg/theano_alexnet``), a mean image
+``.npy``, shuffled shard lists with a common seed, and random-crop(256→227)
++ horizontal-mirror augmentation on CPU.
+
+This rebuild keeps that on-disk contract so existing data prep works:
+``config['data_dir']`` (or ``$IMAGENET_DIR``) must contain ``train_hkl/`` and
+``val_hkl/`` of batch files plus ``img_mean.npy``.  ``.hkl`` is read via
+hickle when installed, with a ``.npy``/``.npz`` fallback per file extension.
+Without a data dir it synthesizes deterministic random uint8 image batches —
+enough for throughput benchmarking (bench.py) and pipeline tests, where only
+shapes and rates matter.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, List, Optional
+
+import numpy as np
+
+RAW = 256       # stored image side (reference batch files are 256×256)
+CROP = 227      # AlexNet crop (VGG uses 224; configurable)
+N_CLASS = 1000
+
+
+def _load_batch_file(path: str) -> np.ndarray:
+    if path.endswith(".hkl"):
+        import hickle  # optional dep, as in the reference
+        return np.asarray(hickle.load(path))
+    if path.endswith(".npz"):
+        with np.load(path) as z:
+            return z[list(z.files)[0]]
+    return np.load(path)
+
+
+class ImageNet_data:
+    """Sharded batch-file loader with reference augmentation semantics.
+
+    Unlike the in-memory :class:`DataBase`, this is file-batch oriented like
+    the reference: an epoch is a shuffled list of batch FILES; each training
+    step concatenates ``size`` files' worth of images into the global batch.
+    """
+
+    def __init__(self, config: Optional[dict] = None, batch_size: int = 128,
+                 crop: int = CROP):
+        self.config = dict(config or {})
+        self.size = self.config.get("size", 1)
+        self.batch_size = batch_size
+        self.global_batch = self.size * batch_size
+        self.crop = int(self.config.get("crop_size", crop))
+        self.rng = np.random.RandomState(self.config.get("seed", 42))
+
+        d = self.config.get("data_dir") or os.environ.get("IMAGENET_DIR")
+        if d and os.path.isdir(os.path.join(d, "train_hkl")):
+            self._init_real(d)
+            self.synthetic = False
+        else:
+            self._init_synthetic()
+            self.synthetic = True
+        self._train_ptr = 0
+        self._val_ptr = 0
+        self._perm = np.arange(len(self.train_files)) if not self.synthetic \
+            else None
+
+    # -- real batch files ---------------------------------------------------
+
+    def _init_real(self, d: str) -> None:
+        def listdir(sub):
+            p = os.path.join(d, sub)
+            return sorted(os.path.join(p, f) for f in os.listdir(p)
+                          if f.split(".")[-1] in ("hkl", "npy", "npz"))
+
+        self.train_files: List[str] = listdir("train_hkl")
+        self.val_files: List[str] = listdir("val_hkl")
+        self.train_labels = np.load(os.path.join(d, "train_labels.npy"))
+        self.val_labels = np.load(os.path.join(d, "val_labels.npy"))
+        mean_path = os.path.join(d, "img_mean.npy")
+        self.img_mean = (np.load(mean_path).astype(np.float32)
+                         if os.path.exists(mean_path) else
+                         np.float32(122.0))
+        files_per_step = self.size
+        self.n_batch_train = len(self.train_files) // files_per_step
+        self.n_batch_val = max(1, len(self.val_files) // files_per_step)
+
+    # -- synthetic ----------------------------------------------------------
+
+    def _init_synthetic(self) -> None:
+        self.n_batch_train = int(self.config.get("synthetic_batches", 64))
+        self.n_batch_val = int(self.config.get("synthetic_val_batches", 4))
+        self.train_files = self.val_files = []
+        self.img_mean = np.float32(122.0)
+        # one cached uint8 megabatch, re-labeled per step (throughput only)
+        r = np.random.RandomState(0)
+        self._synth_x = r.randint(0, 256,
+                                  (self.global_batch, RAW, RAW, 3),
+                                  dtype=np.uint8)
+        self._synth_y = r.randint(0, N_CLASS, self.global_batch).astype(
+            np.int32)
+
+    # -- contract ------------------------------------------------------------
+
+    def shuffle_data(self, seed: int) -> None:
+        """Common-seed shuffle of the batch-FILE list (reference semantics:
+        all ranks shuffle identically, each takes its stride)."""
+        if not self.synthetic:
+            self._perm = np.random.RandomState(seed).permutation(
+                len(self.train_files))
+        self._train_ptr = 0
+        self._val_ptr = 0
+
+    def next_train_batch(self, count: int) -> Dict[str, np.ndarray]:
+        if self.synthetic:
+            return self._augment(self._synth_x, self._synth_y, train=True)
+        i = self._train_ptr % self.n_batch_train
+        self._train_ptr += 1
+        idx = self._perm[i * self.size:(i + 1) * self.size]
+        xs = np.concatenate([_load_batch_file(self.train_files[j])
+                             for j in idx])
+        ys = np.concatenate([self.train_labels[j * self.batch_size:
+                                               (j + 1) * self.batch_size]
+                             for j in idx])
+        return self._augment(self._to_nhwc(xs), ys.astype(np.int32),
+                             train=True)
+
+    def next_val_batch(self, count: int) -> Dict[str, np.ndarray]:
+        if self.synthetic:
+            return self._augment(self._synth_x, self._synth_y, train=False)
+        i = self._val_ptr % self.n_batch_val
+        self._val_ptr += 1
+        idx = range(i * self.size, (i + 1) * self.size)
+        xs = np.concatenate([_load_batch_file(self.val_files[j])
+                             for j in idx])
+        ys = np.concatenate([self.val_labels[j * self.batch_size:
+                                             (j + 1) * self.batch_size]
+                             for j in idx])
+        return self._augment(self._to_nhwc(xs), ys.astype(np.int32),
+                             train=False)
+
+    @staticmethod
+    def _to_nhwc(x: np.ndarray) -> np.ndarray:
+        """Reference .hkl files are bc01 (N,C,H,W) or c01b; normalize."""
+        if x.ndim == 4 and x.shape[1] in (1, 3) and x.shape[-1] not in (1, 3):
+            return np.ascontiguousarray(x.transpose(0, 2, 3, 1))
+        if x.ndim == 4 and x.shape[0] in (1, 3):        # c01b legacy layout
+            return np.ascontiguousarray(x.transpose(3, 1, 2, 0))
+        return x
+
+    def _augment(self, x: np.ndarray, y: np.ndarray,
+                 train: bool) -> Dict[str, np.ndarray]:
+        """Reference augmentation: random 256→crop window + horizontal
+        mirror at train time; center crop at val; mean subtraction."""
+        n, h, w = x.shape[0], x.shape[1], x.shape[2]
+        c = self.crop
+        if train:
+            oy = self.rng.randint(0, h - c + 1)
+            ox = self.rng.randint(0, w - c + 1)
+            flip = bool(self.rng.randint(2))
+        else:
+            oy = (h - c) // 2
+            ox = (w - c) // 2
+            flip = False
+        out = x[:, oy:oy + c, ox:ox + c, :]
+        if flip:
+            out = out[:, :, ::-1, :]
+        mean = self.img_mean
+        if isinstance(mean, np.ndarray) and mean.ndim == 3:
+            mean = self._to_nhwc(mean[None])[0, oy:oy + c, ox:ox + c, :]
+        out = out.astype(np.float32) - mean
+        return {"x": np.ascontiguousarray(out, dtype=np.float32),
+                "y": np.ascontiguousarray(y, dtype=np.int32)}

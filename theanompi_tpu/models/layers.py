@@ -1,0 +1,385 @@
+"""Layer library.
+
+TPU-native rebuild of Theano-MPI's ``theanompi/models/layers2.py``
+(SURVEY.md §2.7): ``Weight`` (init schemes + ``.npy`` save/load), ``Conv``
+(was cuDNN, now ``lax.conv_general_dilated`` lowered onto the MXU), ``Pool``,
+``LRN``, ``FC``, ``Dropout`` (train/test switch), ``Softmax``, ``BatchNorm``,
+and input mean-subtraction handling.
+
+Design departures from the reference, all deliberate and TPU-first:
+
+* **NHWC layout** (reference was Theano's bc01/NCHW): XLA:TPU's native conv
+  layout, keeps the channel dim in the lane dimension of the VPU/MXU tiles.
+* **Pure pytrees, no shared variables**: a layer is a small object holding
+  static hyperparameters; ``init(key)`` returns its parameter pytree and
+  ``apply(params, x, ...)`` is pure, so the whole model jits and shards.
+* **Mixed precision hook**: every layer takes ``compute_dtype`` — params stay
+  float32, matmul/conv inputs are cast (bfloat16 on TPU) with float32
+  accumulation via ``preferred_element_type``.
+* **BatchNorm state** (running stats) is threaded as a separate ``state``
+  pytree through :class:`Sequential` rather than mutated in place.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+# ---------------------------------------------------------------------------
+# Weight: init schemes + save/load  (reference: layers2.Weight)
+# ---------------------------------------------------------------------------
+
+def init_weight(key, shape: Sequence[int], scheme: Union[str, Tuple[str, float]],
+                dtype=jnp.float32) -> jnp.ndarray:
+    """Initialize one weight array.
+
+    Scheme forms (matching the reference's ``Weight`` modes):
+      ``('normal', std)``   gaussian, the AlexNet-era default (std 0.01/0.005)
+      ``('constant', c)``   constant fill (bias init 0 / 0.1 / 1)
+      ``'xavier'``          Glorot uniform
+      ``'he'``              He normal (fan-in), for ReLU nets
+    """
+    if isinstance(scheme, tuple):
+        kind, arg = scheme
+    else:
+        kind, arg = scheme, None
+    if kind == "normal":
+        std = 0.01 if arg is None else arg
+        return std * jax.random.normal(key, shape, dtype)
+    if kind == "constant":
+        c = 0.0 if arg is None else arg
+        return jnp.full(shape, c, dtype)
+    fan_in, fan_out = _fans(shape)
+    if kind == "xavier":
+        limit = math.sqrt(6.0 / (fan_in + fan_out))
+        return jax.random.uniform(key, shape, dtype, -limit, limit)
+    if kind == "he":
+        std = math.sqrt(2.0 / fan_in)
+        return std * jax.random.normal(key, shape, dtype)
+    raise ValueError(f"unknown init scheme {kind!r}")
+
+
+def _fans(shape: Sequence[int]) -> Tuple[int, int]:
+    if len(shape) == 1:
+        return shape[0], shape[0]
+    if len(shape) == 2:
+        return shape[0], shape[1]
+    # conv HWIO: receptive field * in, receptive field * out
+    rf = int(np.prod(shape[:-2]))
+    return rf * shape[-2], rf * shape[-1]
+
+
+# ---------------------------------------------------------------------------
+# Layer base + Sequential
+# ---------------------------------------------------------------------------
+
+class Layer:
+    """Base layer: static hyperparams on the object, params/state as pytrees."""
+
+    name: str = "layer"
+    has_state: bool = False  # True for BatchNorm (running stats)
+
+    def init(self, key) -> Any:
+        return None
+
+    def init_state(self) -> Any:
+        return None
+
+    def apply(self, params, x, *, train: bool = False, rng=None, state=None):
+        """Returns ``(y, new_state)``; ``new_state`` is None for stateless layers."""
+        raise NotImplementedError
+
+    def __repr__(self):
+        return f"{type(self).__name__}({self.name})"
+
+
+class Sequential:
+    """Composes layers; params/state are dicts keyed by unique layer names.
+
+    Reference equivalent: the explicit layer lists each model file built and
+    iterated over (``layers2`` usage in ``alex_net.py`` etc.).
+    """
+
+    def __init__(self, layers: List[Layer]):
+        self.layers = layers
+        seen: Dict[str, int] = {}
+        self._keys = []
+        for l in layers:
+            n = l.name
+            if n in seen:
+                seen[n] += 1
+                n = f"{n}_{seen[l.name]}"
+            else:
+                seen[n] = 0
+            self._keys.append(n)
+
+    def init(self, key) -> Dict[str, Any]:
+        params = {}
+        for k, layer in zip(self._keys, self.layers):
+            key, sub = jax.random.split(key)
+            p = layer.init(sub)
+            if p is not None:
+                params[k] = p
+        return params
+
+    def init_state(self) -> Dict[str, Any]:
+        state = {}
+        for k, layer in zip(self._keys, self.layers):
+            s = layer.init_state()
+            if s is not None:
+                state[k] = s
+        return state
+
+    def apply(self, params, x, *, train=False, rng=None, state=None):
+        state = state or {}
+        new_state = dict(state)
+        for k, layer in zip(self._keys, self.layers):
+            if rng is not None:
+                rng, sub = jax.random.split(rng)
+            else:
+                sub = None
+            y = layer.apply(params.get(k), x, train=train, rng=sub,
+                            state=state.get(k))
+            if layer.has_state:
+                x, st = y
+                if st is not None:
+                    new_state[k] = st
+            else:
+                x = y if not isinstance(y, tuple) else y[0]
+        return x, new_state
+
+
+# ---------------------------------------------------------------------------
+# Conv  (reference: layers2.Conv on cuDNN; here lax conv on the MXU)
+# ---------------------------------------------------------------------------
+
+class Conv(Layer):
+    def __init__(self, in_ch: int, out_ch: int, kernel: Union[int, Tuple[int, int]],
+                 stride: Union[int, Tuple[int, int]] = 1,
+                 padding: Union[str, int] = "SAME",
+                 groups: int = 1,
+                 w_init=("normal", 0.01), b_init=("constant", 0.0),
+                 activation: Optional[str] = "relu",
+                 compute_dtype=jnp.bfloat16, name: str = "conv"):
+        self.in_ch, self.out_ch = in_ch, out_ch
+        self.kernel = (kernel, kernel) if isinstance(kernel, int) else tuple(kernel)
+        self.stride = (stride, stride) if isinstance(stride, int) else tuple(stride)
+        if isinstance(padding, int):
+            self.padding = [(padding, padding), (padding, padding)]
+        else:
+            self.padding = padding
+        self.groups = groups  # AlexNet's historical 2-group convs
+        self.w_init, self.b_init = w_init, b_init
+        self.activation = activation
+        self.compute_dtype = compute_dtype
+        self.name = name
+
+    def init(self, key):
+        kh, kw = self.kernel
+        kw_key, b_key = jax.random.split(key)
+        w = init_weight(kw_key, (kh, kw, self.in_ch // self.groups, self.out_ch),
+                        self.w_init)
+        b = init_weight(b_key, (self.out_ch,), self.b_init)
+        return {"w": w, "b": b}
+
+    def apply(self, params, x, *, train=False, rng=None, state=None):
+        cd = self.compute_dtype
+        # No preferred_element_type here: with bf16 operands the MXU still
+        # accumulates in fp32 internally, and requesting an fp32 output breaks
+        # the conv transpose (bf16 kernel vs fp32 cotangent) in jax 0.9.
+        y = jax.lax.conv_general_dilated(
+            x.astype(cd), params["w"].astype(cd),
+            window_strides=self.stride, padding=self.padding,
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+            feature_group_count=self.groups,
+        )
+        y = y + params["b"].astype(cd)
+        return _activate(y, self.activation)
+
+
+class FC(Layer):
+    """Fully connected layer (reference: layers2.FC / Softmax head matmul)."""
+
+    def __init__(self, n_in: int, n_out: int,
+                 w_init=("normal", 0.005), b_init=("constant", 0.0),
+                 activation: Optional[str] = "relu",
+                 compute_dtype=jnp.bfloat16, name: str = "fc"):
+        self.n_in, self.n_out = n_in, n_out
+        self.w_init, self.b_init = w_init, b_init
+        self.activation = activation
+        self.compute_dtype = compute_dtype
+        self.name = name
+
+    def init(self, key):
+        kw, kb = jax.random.split(key)
+        return {"w": init_weight(kw, (self.n_in, self.n_out), self.w_init),
+                "b": init_weight(kb, (self.n_out,), self.b_init)}
+
+    def apply(self, params, x, *, train=False, rng=None, state=None):
+        cd = self.compute_dtype
+        y = jnp.dot(x.astype(cd), params["w"].astype(cd))
+        y = y + params["b"].astype(cd)
+        return _activate(y, self.activation)
+
+
+class Pool(Layer):
+    """Max/avg pooling via ``lax.reduce_window`` (reference: layers2.Pool)."""
+
+    def __init__(self, size: Union[int, Tuple[int, int]] = 2,
+                 stride: Optional[Union[int, Tuple[int, int]]] = None,
+                 mode: str = "max", padding: str = "VALID", name: str = "pool"):
+        self.size = (size, size) if isinstance(size, int) else tuple(size)
+        stride = stride if stride is not None else self.size
+        self.stride = (stride, stride) if isinstance(stride, int) else tuple(stride)
+        self.mode = mode
+        self.padding = padding
+        self.name = name
+
+    def apply(self, params, x, *, train=False, rng=None, state=None):
+        window = (1,) + self.size + (1,)
+        strides = (1,) + self.stride + (1,)
+        if self.mode == "max":
+            return jax.lax.reduce_window(x, -jnp.inf, jax.lax.max, window, strides,
+                                         self.padding)
+        s = jax.lax.reduce_window(x, 0.0, jax.lax.add, window, strides, self.padding)
+        if self.padding == "VALID":
+            return s / (self.size[0] * self.size[1])
+        ones = jnp.ones_like(x)
+        counts = jax.lax.reduce_window(ones, 0.0, jax.lax.add, window, strides,
+                                       self.padding)
+        return s / counts
+
+
+class LRN(Layer):
+    """Cross-channel local response normalization (AlexNet-era; reference
+    layers2.LRN):  b = a / (k + alpha/n * sum_{window} a^2)^beta."""
+
+    def __init__(self, n: int = 5, k: float = 2.0, alpha: float = 1e-4,
+                 beta: float = 0.75, name: str = "lrn"):
+        self.n, self.k, self.alpha, self.beta = n, k, alpha, beta
+        self.name = name
+
+    def apply(self, params, x, *, train=False, rng=None, state=None):
+        sq = jnp.square(x.astype(jnp.float32))
+        half = self.n // 2
+        ssum = jax.lax.reduce_window(
+            sq, 0.0, jax.lax.add,
+            window_dimensions=(1, 1, 1, self.n), window_strides=(1, 1, 1, 1),
+            padding=[(0, 0), (0, 0), (0, 0), (half, half)],
+        )
+        return (x / jnp.power(self.k + (self.alpha / self.n) * ssum,
+                              self.beta)).astype(x.dtype)
+
+
+class Dropout(Layer):
+    """Train/test-switched dropout (reference: layers2.Dropout)."""
+
+    def __init__(self, rate: float = 0.5, name: str = "dropout"):
+        self.rate = rate
+        self.name = name
+
+    def apply(self, params, x, *, train=False, rng=None, state=None):
+        if not train or self.rate == 0.0:
+            return x
+        assert rng is not None, "Dropout in train mode needs an rng"
+        keep = 1.0 - self.rate
+        mask = jax.random.bernoulli(rng, keep, x.shape)
+        return jnp.where(mask, x / keep, 0.0)
+
+
+class BatchNorm(Layer):
+    """Batch normalization with running stats (reference: layers2.BatchNorm).
+
+    Train mode uses batch statistics and returns updated running stats in the
+    state pytree; eval mode uses running stats.  Normalizes over all axes but
+    the last (NHWC channel)."""
+
+    has_state = True
+
+    def __init__(self, n_ch: int, momentum: float = 0.9, eps: float = 1e-5,
+                 name: str = "bn"):
+        self.n_ch, self.momentum, self.eps = n_ch, momentum, eps
+        self.name = name
+
+    def init(self, key):
+        return {"scale": jnp.ones((self.n_ch,)), "bias": jnp.zeros((self.n_ch,))}
+
+    def init_state(self):
+        return {"mean": jnp.zeros((self.n_ch,)), "var": jnp.ones((self.n_ch,))}
+
+    def apply(self, params, x, *, train=False, rng=None, state=None):
+        axes = tuple(range(x.ndim - 1))
+        x32 = x.astype(jnp.float32)
+        if train:
+            mean = jnp.mean(x32, axes)
+            var = jnp.var(x32, axes)
+            m = self.momentum
+            new_state = {"mean": m * state["mean"] + (1 - m) * mean,
+                         "var": m * state["var"] + (1 - m) * var}
+        else:
+            mean, var = state["mean"], state["var"]
+            new_state = None
+        inv = jax.lax.rsqrt(var + self.eps)
+        y = (x32 - mean) * inv * params["scale"] + params["bias"]
+        return y.astype(x.dtype), new_state
+
+
+class Flatten(Layer):
+    def __init__(self, name: str = "flatten"):
+        self.name = name
+
+    def apply(self, params, x, *, train=False, rng=None, state=None):
+        return x.reshape((x.shape[0], -1))
+
+
+class Activation(Layer):
+    def __init__(self, kind: str = "relu", name: str = "act"):
+        self.kind = kind
+        self.name = name
+
+    def apply(self, params, x, *, train=False, rng=None, state=None):
+        return _activate(x, self.kind)
+
+
+def _activate(x, kind: Optional[str]):
+    if kind is None or kind == "linear":
+        return x
+    if kind == "relu":
+        return jax.nn.relu(x)
+    if kind == "tanh":
+        return jnp.tanh(x)
+    if kind == "sigmoid":
+        return jax.nn.sigmoid(x)
+    if kind == "leaky_relu":
+        return jax.nn.leaky_relu(x, 0.2)
+    raise ValueError(f"unknown activation {kind!r}")
+
+
+# ---------------------------------------------------------------------------
+# Loss / error heads (reference: layers2.Softmax negative_log_likelihood +
+# errors / errors_top_x)
+# ---------------------------------------------------------------------------
+
+def softmax_cross_entropy(logits, labels) -> jnp.ndarray:
+    """Mean NLL of integer ``labels`` under softmax(logits), in float32."""
+    logits = logits.astype(jnp.float32)
+    logz = jax.nn.logsumexp(logits, axis=-1)
+    ll = jnp.take_along_axis(logits, labels[:, None], axis=-1)[:, 0]
+    return jnp.mean(logz - ll)
+
+
+def errors(logits, labels) -> jnp.ndarray:
+    """Top-1 error rate."""
+    return jnp.mean((jnp.argmax(logits, axis=-1) != labels).astype(jnp.float32))
+
+
+def errors_top_x(logits, labels, x: int = 5) -> jnp.ndarray:
+    """Top-x error rate (reference reports top-5 for ImageNet)."""
+    _, topk = jax.lax.top_k(logits, x)
+    hit = jnp.any(topk == labels[:, None], axis=-1)
+    return jnp.mean((~hit).astype(jnp.float32))

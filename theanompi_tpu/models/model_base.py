@@ -1,0 +1,241 @@
+"""The duck-typed model contract.
+
+Theano-MPI's actual public API is its model contract (SURVEY.md §2.5): a
+model exposes ``params``, ``data``, ``compile_iter_fns()``,
+``train_iter(count, recorder)``, ``val_iter(count, recorder)``,
+``adjust_hyperp(epoch)``, ``scale_lr(size)``, ``epochs``, ``n_subb``.  The
+worker loop drives any object with that shape.  :class:`ModelBase` implements
+the contract once over the TPU step machinery; concrete models
+(``cifar10.py``, ``alex_net.py``, ...) only define their layer stack, data
+object, and hyperparameters — mirroring how reference model files were layer
+lists plus a module-level hyperparameter dict.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..parallel import steps
+from ..parallel.mesh import WORKER_AXIS, worker_mesh
+from ..utils import checkpoint as ckpt_lib
+from ..utils import helper_funcs
+from ..utils.opt import get_optimizer
+from . import layers as L
+
+
+class ModelBase:
+    """Implements the reference model contract over compiled SPMD steps."""
+
+    # hyperparameter defaults; concrete models override (these mirror the
+    # module-level dicts that served as the reference's config system, §5.6)
+    batch_size: int = 128          # per-worker, as in the reference
+    epochs: int = 60
+    n_subb: int = 1                # sub-batches per comm step (grad accum)
+    learning_rate: float = 0.01
+    momentum: float = 0.9
+    weight_decay: float = 0.0001
+    optimizer: str = "momentum"
+    lr_adjust_epochs: tuple = ()   # epochs at which lr /= 10 (step schedule)
+    seed: int = 42
+
+    def __init__(self, config: Optional[dict] = None):
+        self.config = dict(config or {})
+        self.verbose = self.config.get("verbose", True)
+        self.rank = self.config.get("rank", 0)
+        self.size = self.config.get("size", 1)
+        self.mesh = self.config.get("mesh")
+        if self.mesh is None:
+            self.mesh = worker_mesh(self.config.get("n_workers"))
+            self.size = self.mesh.shape[WORKER_AXIS]
+            # build_model()'s data object reads size from config — keep it
+            # coherent when the model is constructed standalone (no Worker).
+            self.config.setdefault("rank", self.rank)
+            self.config["size"] = self.size
+        for k in ("batch_size", "epochs", "n_subb", "learning_rate", "seed"):
+            if k in self.config:
+                setattr(self, k, self.config[k])
+        self.seed = int(self.config.get("seed", self.seed))
+        self.current_lr = float(self.learning_rate)
+
+        self.seq: L.Sequential = None
+        self.data = None
+        self.build_model()            # subclass hook: set self.seq, self.data
+        assert self.seq is not None, "build_model() must set self.seq"
+        if self.config.get("para_load", False) and self.data is not None:
+            # reference's para_load=True flag → background parallel loader
+            from .data.prefetch import PrefetchLoader
+            self.data = PrefetchLoader(self.data)
+
+        key = jax.random.key(self.seed)
+        self.params = self.seq.init(key)
+        self.bn_state = self.seq.init_state()
+        self.opt = get_optimizer(self.optimizer, mu=self.momentum,
+                                 weight_decay=self.weight_decay) \
+            if self.optimizer in ("momentum", "nesterov") \
+            else get_optimizer(self.optimizer, weight_decay=self.weight_decay)
+
+        self.step_state: Optional[Dict[str, Any]] = None
+        self.train_fn = None
+        self.val_fn = None
+        self.exchanger = None
+        self._exch_key = jax.random.key(self.seed + 1)
+        self._val_params_boxed = None
+        self._val_bn_boxed = None
+        self.current_info: Dict[str, Any] = {}
+
+    # -- subclass hooks ----------------------------------------------------
+
+    def build_model(self) -> None:
+        raise NotImplementedError
+
+    def loss_and_metrics(self, params, bn_state, batch, rng, train):
+        """Default head: softmax cross-entropy + top-1 error."""
+        logits, new_bn = self.seq.apply(params, batch["x"], train=train,
+                                        rng=rng, state=bn_state)
+        cost = L.softmax_cross_entropy(logits, batch["y"])
+        err = L.errors(logits, batch["y"])
+        return cost, (err, new_bn)
+
+    def val_metrics(self, params, bn_state, batch):
+        logits, _ = self.seq.apply(params, batch["x"], train=False,
+                                   state=bn_state)
+        cost = L.softmax_cross_entropy(logits, batch["y"])
+        return cost, (L.errors(logits, batch["y"]),
+                      L.errors_top_x(logits, batch["y"], 5))
+
+    # -- contract: compile -------------------------------------------------
+
+    def compile_iter_fns(self, exchanger=None) -> None:
+        """≙ reference ``model.compile_iter_fns()`` → ``theano.function``;
+        here: jit the SPMD train/val steps and box the state onto the mesh."""
+        from ..parallel.exchanger import BSP_Exchanger
+        self.exchanger = exchanger or BSP_Exchanger(self.config)
+        self.exchanger.prepare(self.mesh, self)
+        n = self.mesh.shape[WORKER_AXIS]
+
+        extra = self.exchanger.extra_state_template()
+        opt_state = self.opt.init(self.params)
+        unboxed = {"params": self.params, "opt_state": opt_state,
+                   "bn_state": self.bn_state, "extra": extra}
+        self.step_state = {k: steps.replicate_tree(v, n, self.mesh)
+                           for k, v in unboxed.items()}
+        self.train_fn = steps.build_train_step(self.mesh, self, self.exchanger)
+        self.val_fn = steps.build_val_step(self.mesh, self)
+        self._step_rng = jax.random.key(self.seed + 2)
+
+    # -- contract: iteration -----------------------------------------------
+
+    def train_iter(self, count: int, recorder=None) -> None:
+        if recorder:
+            recorder.start()
+        batch = self.data.next_train_batch(count)
+        if recorder:
+            recorder.end("load")
+            recorder.start()
+        dev_batch = steps.put_batch(self.mesh, batch)
+        self.step_state, cost, err = self.train_fn(
+            self.step_state, dev_batch, jnp.float32(self.current_lr),
+            self._step_rng, jnp.int32(count))
+        cost, err = jnp.mean(cost), jnp.mean(err)
+        if self.config.get("sync_each_iter", False):
+            # Reference-style blocking loop: section buckets = wall time.
+            cost, err = float(cost), float(err)
+        # else: device scalars flow to the recorder and materialize at print
+        # cadence, keeping dispatch asynchronous (device queue stays full).
+        if recorder:
+            recorder.end("train")
+            n_images = int(batch["y"].shape[0])
+            recorder.train_error(count, cost, err, n_images)
+        self.current_info.update(cost=cost, error=err)
+
+    def begin_val(self) -> None:
+        """Snapshot the parameters validation should score: the canonical
+        params for async rules (EASGD center, GoSGD consensus), the replica
+        set itself for BSP (already identical)."""
+        n = self.mesh.shape[WORKER_AXIS]
+        if self.exchanger is not None and hasattr(self.exchanger,
+                                                  "canonical_params"):
+            canon = self.exchanger.canonical_params(self.step_state)
+            self._val_params_boxed = steps.replicate_tree(canon, n, self.mesh)
+        else:
+            self._val_params_boxed = self.step_state["params"]
+        self._val_bn_boxed = self.step_state["bn_state"]
+
+    def val_iter(self, count: int, recorder=None) -> None:
+        if self._val_params_boxed is None:
+            self.begin_val()
+        if recorder:
+            recorder.start()
+        batch = self.data.next_val_batch(count)
+        dev_batch = steps.put_batch(self.mesh, batch)
+        cost, err, err5 = self.val_fn(self._val_params_boxed,
+                                      self._val_bn_boxed, dev_batch)
+        cost = float(np.mean(jax.device_get(cost)))
+        err = float(np.mean(jax.device_get(err)))
+        err5 = float(np.mean(jax.device_get(err5)))
+        if recorder:
+            recorder.end("val")
+            recorder.val_error(count, cost, err, err5)
+
+    def end_val(self) -> None:
+        self._val_params_boxed = None
+        self._val_bn_boxed = None
+
+    # -- contract: hyperparameters ----------------------------------------
+
+    def adjust_hyperp(self, epoch: int) -> None:
+        """Step LR decay (÷10 at the epochs in ``lr_adjust_epochs``) — the
+        schedule style every reference zoo model used."""
+        lr = float(self.learning_rate)
+        for e in self.lr_adjust_epochs:
+            if epoch >= e:
+                lr /= 10.0
+        self.current_lr = lr * self._lr_scale
+
+    _lr_scale: float = 1.0
+
+    def scale_lr(self, size: int) -> None:
+        """Linear LR scaling by worker count (reference ``scale_lr``)."""
+        self._lr_scale = float(size)
+        self.current_lr = self.current_lr * size
+
+    def next_exchange_key(self):
+        self._exch_key, sub = jax.random.split(self._exch_key)
+        return sub
+
+    # -- contract: persistence --------------------------------------------
+
+    def save(self, ckpt_dir: str, epoch: int, count: int = 0) -> str:
+        # Replica 0 of each boxed tree (BSP replicas are identical; for async
+        # rules the canonical params are saved below, like the reference
+        # saving the server's center).
+        state = {k: jax.device_get(steps.unbox(v))
+                 for k, v in self.step_state.items()}
+        # For async rules the canonical params are worth keeping too.
+        if hasattr(self.exchanger, "canonical_params"):
+            state["params"] = jax.device_get(
+                self.exchanger.canonical_params(self.step_state))
+        return ckpt_lib.save_checkpoint(ckpt_dir, state, epoch, count)
+
+    def load(self, ckpt_dir: str, epoch: Optional[int] = None) -> Optional[int]:
+        """Restore state (call after ``compile_iter_fns``). Returns the epoch
+        restored from, or None."""
+        n = self.mesh.shape[WORKER_AXIS]
+        template = {k: steps.unbox(jax.device_get(v))
+                    for k, v in self.step_state.items()}
+        restored = ckpt_lib.load_checkpoint(ckpt_dir, template, epoch)
+        if restored is None:
+            return None
+        meta = restored.pop("_meta")
+        self.step_state = {k: steps.replicate_tree(v, n, self.mesh)
+                           for k, v in restored.items()}
+        return int(meta["epoch"])
+
+    @property
+    def n_params(self) -> int:
+        return helper_funcs.tree_size(self.params)

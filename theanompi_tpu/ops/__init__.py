@@ -1,0 +1,1 @@
+"""Custom TPU ops (Pallas kernels + jnp fallbacks)."""

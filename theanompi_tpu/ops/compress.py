@@ -1,0 +1,62 @@
+"""Sign bit-pack/unpack kernels for the compressed exchanger.
+
+TPU-native successor to the reference's in-repo native code: Theano-MPI's
+``Exch_asa16``/``Exch_copper16`` compiled inline fp32↔fp16 CUDA kernels at
+runtime via ``pycuda.compiler.SourceModule`` to halve wire bandwidth
+(SURVEY.md §2.9, items N1/N2).  Here the compression is more aggressive —
+1 bit per element.  This module currently ships the portable jnp
+implementation (used on CPU tests and as the reference oracle); the Pallas
+TPU kernel pair (pack / unpack-accumulate) is the planned hot path and will
+slot in behind the same two functions.
+
+Layout contract: input length must be a multiple of :data:`PACK_ALIGN`
+(= 1024 = 8 bits × 128 lanes) so both the packed and unpacked views tile
+cleanly onto the VPU's (8, 128) registers.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# 8 bits/byte × 128 lanes: keeps packed rows lane-aligned on TPU.
+PACK_ALIGN = 1024
+
+_POWERS = 2 ** np.arange(8, dtype=np.uint8)  # LSB-first bit order
+
+
+def pack_signs(c: jnp.ndarray) -> jnp.ndarray:
+    """Pack sign bits of ``c`` (>=0 → 1, <0 → 0) into a uint8 vector, 8/byte.
+
+    ``c`` must be 1-D with length % PACK_ALIGN == 0.  Returns [len(c)//8]
+    uint8.
+    """
+    n = c.shape[0]
+    assert n % PACK_ALIGN == 0, f"pack_signs needs length % {PACK_ALIGN}, got {n}"
+    bits = (c >= 0).astype(jnp.uint8).reshape(n // 8, 8)
+    return (bits * _POWERS).sum(axis=1).astype(jnp.uint8)
+
+
+def unpack_signs(packed: jnp.ndarray) -> jnp.ndarray:
+    """Inverse of :func:`pack_signs`: uint8 [m] → float32 [8m] of ±1."""
+    bits = (packed[:, None] >> jnp.arange(8, dtype=jnp.uint8)) & 1
+    return (bits.astype(jnp.float32) * 2.0 - 1.0).reshape(-1)
+
+
+def unpack_signs_weighted_sum(all_packed: jnp.ndarray,
+                              scales: jnp.ndarray) -> jnp.ndarray:
+    """Decode ``[n_workers, m]`` packed sign buffers and return
+    ``sum_w scales[w] * signs[w]`` as float32 ``[8m]``.
+
+    This is the decode+accumulate half of the compressed allreduce: each
+    worker runs it locally after the all-gather of packed bits, so only bits
+    ever cross ICI.
+    """
+    n_workers, m = all_packed.shape
+    bits = (all_packed[:, :, None] >> jnp.arange(8, dtype=jnp.uint8)) & 1
+    signs = bits.astype(jnp.float32) * 2.0 - 1.0          # [w, m, 8]
+    weighted = signs * scales[:, None, None]
+    return weighted.sum(axis=0).reshape(-1)

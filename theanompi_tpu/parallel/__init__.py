@@ -1,0 +1,7 @@
+"""Parallelism: mesh runtime, exchangers (BSP/EASGD/ASGD/GoSGD), collective
+strategies, SPMD step assembly."""
+
+from .mesh import WORKER_AXIS, worker_mesh
+from .exchanger import (ASGD_Exchanger, BSP_Exchanger, EASGD_Exchanger,
+                        GOSGD_Exchanger, get_exchanger)
+from .strategies import get_strategy

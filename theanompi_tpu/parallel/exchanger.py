@@ -1,0 +1,365 @@
+"""Parameter/gradient exchangers — the four parallelism rules.
+
+TPU-native rebuild of Theano-MPI's ``theanompi/lib/exchanger.py``
+(SURVEY.md §2.2): the reference implements pure data parallelism in four
+flavors that differ in *when* and *with whom* parameters are mixed —
+
+* **BSP**: every iteration, all workers average gradients/parameters
+  (allreduce, barrier semantics) → here ``lax.psum``-family strategies fused
+  into the compiled step, or post-step parameter averaging.
+* **EASGD**: a center parameter store; every ``sync_freq`` iterations each
+  worker does an elastic pairwise update with it (Zhang et al. 2015).
+* **ASGD**: downpour-style push of accumulated deltas / pull of fresh params.
+* **GoSGD**: decentralized gossip — with probability ``p`` send
+  ``(params, α/2)`` to a random peer and merge by weighted averaging
+  (Blot et al. 2016).
+
+**Asynchrony on SPMD hardware (the semantic delta, SURVEY.md §7):** TPU chips
+in one program execute in lockstep, so "server serves one worker at a time"
+and "message arrives whenever" have no direct analogue.  Each async rule maps
+to its *synchronous-cadence* variant with the update algebra kept exact:
+
+* EASGD → the synchronous elastic averaging step from the EASGD paper's own
+  momentum variant: all workers exchange with the (replicated) center every
+  ``sync_freq`` steps.  A real parameter-server process becomes a replicated
+  center pytree — no server rank burns a chip.
+* ASGD → workers train locally ``sync_freq`` steps, then the center absorbs
+  the *sum* of worker deltas (downpour applies every worker's contribution)
+  and workers restart from the new center.
+* GoSGD → per-step Bernoulli send gating is kept per-worker; the random peer
+  choice becomes a shared random ring-shift (every sender shifts by the same
+  random ``s`` that step, delivered via ``lax.ppermute``), preserving the
+  weighted-average merge and the Σα invariant exactly.
+
+Exchange cost rides ICI inside compiled programs in all cases.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from . import steps
+from .mesh import WORKER_AXIS
+from .strategies import Strategy, get_strategy
+
+
+class Exchanger:
+    """Base exchanger.
+
+    Lifecycle (mirrors the reference: ``Exchanger(config, model)`` then
+    ``.prepare(...)`` then per-iteration ``.exchange(recorder)``):
+
+    * :meth:`prepare` — given the mesh and model, build state templates and
+      jit the exchange collective.
+    * :meth:`step_update` — traced INSIDE the per-worker train step: apply
+      grads locally, optionally reducing them first (BSP fused mode).
+    * :meth:`exchange` — Python-level cadence hook called by the worker loop
+      after each ``train_iter``; runs the rule's collective when due.
+    """
+
+    name = "exchanger"
+
+    def __init__(self, config: Optional[dict] = None):
+        self.config = dict(config or {})
+        self.exchange_freq = 1
+        self.mesh: Optional[Mesh] = None
+        self.model = None
+        self._exchange_fn = None
+
+    # -- wiring ------------------------------------------------------------
+
+    def prepare(self, mesh: Mesh, model) -> None:
+        self.mesh = mesh
+        self.model = model
+        self.size = mesh.shape[WORKER_AXIS]
+
+    def extra_state_template(self) -> Dict[str, Any]:
+        """Unboxed per-worker persistent state (error feedback, center, α...)."""
+        return {}
+
+    # -- in-step (traced) --------------------------------------------------
+
+    def step_update(self, params, opt_state, grads, extra, lr, *, axis, size,
+                    count):
+        """Default: purely local optimizer step (async rules train locally
+        between exchanges)."""
+        opt = self.model.opt
+        params, opt_state = opt.update(grads, opt_state, params, lr)
+        return params, opt_state, extra
+
+    # -- exchange collective (Python cadence + jitted body) ----------------
+
+    def due(self, count: int) -> bool:
+        return self._exchange_fn is not None and count % self.exchange_freq == 0
+
+    def exchange(self, recorder=None, count: int = 0) -> None:
+        if not self.due(count):
+            return
+        if recorder:
+            recorder.start()
+        self.model.step_state = self._exchange_fn(
+            self.model.step_state, self.model.next_exchange_key(), count)
+        if recorder:
+            jax.block_until_ready(self.model.step_state["params"])
+            recorder.end("comm")
+
+
+class BSP_Exchanger(Exchanger):
+    """Bulk-synchronous exchange (reference: ``BSP_Exchanger``).
+
+    ``mode='grads'`` (default): the selected strategy reduces gradients
+    inside the compiled step — comm fuses with compute, and N-worker training
+    is bit-equivalent to 1-worker training on the concatenated batch (the
+    defining BSP invariant, tested in ``tests/test_bsp_equivalence.py``).
+
+    ``mode='params'``: reference-exact cadence — local update then post-step
+    parameter averaging as a separate compiled collective, timed into the
+    recorder's ``t_comm`` bucket like the reference's exchange.
+    """
+
+    name = "bsp"
+
+    def __init__(self, config: Optional[dict] = None):
+        super().__init__(config)
+        self.mode = self.config.get("exch_mode", "grads")
+        self.strategy: Strategy = get_strategy(
+            self.config.get("exch_strategy", "allreduce"))
+
+    def prepare(self, mesh: Mesh, model) -> None:
+        super().prepare(mesh, model)
+        if self.mode == "params":
+            axis, n = WORKER_AXIS, self.size
+            state_spec = {k: P(axis) for k in
+                          ("params", "opt_state", "bn_state", "extra")}
+
+            def body(state, key, count):
+                params = steps.unbox(state["params"])
+                extra = steps.unbox(state["extra"])
+                strat_state = extra.get("strat", ())
+                params, strat_state = self.strategy(
+                    params, strat_state, axis=axis, size=n)
+                if "strat" in extra:
+                    extra = dict(extra, strat=strat_state)
+                return dict(state, params=steps.box(params),
+                            extra=steps.box(extra))
+
+            sm = jax.shard_map(body, mesh=mesh,
+                               in_specs=(state_spec, P(), P()),
+                               out_specs=state_spec)
+            self._exchange_fn = jax.jit(sm, donate_argnums=(0,))
+
+    def extra_state_template(self) -> Dict[str, Any]:
+        if self.strategy.stateful:
+            return {"strat": self.strategy.init_state(self.model.params)}
+        return {}
+
+    def step_update(self, params, opt_state, grads, extra, lr, *, axis, size,
+                    count):
+        if self.mode == "grads":
+            strat_state = extra.get("strat", ())
+            grads, strat_state = self.strategy(grads, strat_state,
+                                               axis=axis, size=size)
+            if "strat" in extra:
+                extra = dict(extra, strat=strat_state)
+        opt = self.model.opt
+        params, opt_state = opt.update(grads, opt_state, params, lr)
+        return params, opt_state, extra
+
+
+class EASGD_Exchanger(Exchanger):
+    """Elastic averaging (reference: ``EASGD_Exchanger``, server+worker modes;
+    SURVEY.md §3.2).
+
+    The reference ran a dedicated server process holding center parameters,
+    serving one worker at a time over CUDA-aware MPI Send/Recv.  Here the
+    center is a replicated pytree carried in the exchanger state — the
+    elastic update every ``sync_freq`` steps is, per the EASGD paper's
+    synchronous form:
+
+        worker_i ← worker_i − α (worker_i − center)
+        center   ← center  + α · mean_i (worker_i − center)
+    """
+
+    name = "easgd"
+
+    def __init__(self, config: Optional[dict] = None):
+        super().__init__(config)
+        self.alpha = float(self.config.get("alpha", 0.5))
+        self.exchange_freq = int(self.config.get("sync_freq", 4))
+
+    def extra_state_template(self) -> Dict[str, Any]:
+        return {"center": jax.tree.map(jnp.asarray, self.model.params)}
+
+    def prepare(self, mesh: Mesh, model) -> None:
+        super().prepare(mesh, model)
+        axis, alpha = WORKER_AXIS, self.alpha
+        state_spec = {k: P(axis) for k in
+                      ("params", "opt_state", "bn_state", "extra")}
+
+        def body(state, key, count):
+            params = steps.unbox(state["params"])
+            extra = steps.unbox(state["extra"])
+            center = extra["center"]
+            delta = jax.tree.map(lambda p, c: p - c, params, center)
+            mean_delta = jax.tree.map(lambda d: lax.pmean(d, axis), delta)
+            new_center = jax.tree.map(lambda c, d: c + alpha * d,
+                                      center, mean_delta)
+            new_params = jax.tree.map(lambda p, d: p - alpha * d, params, delta)
+            extra = dict(extra, center=new_center)
+            return dict(state, params=steps.box(new_params),
+                        extra=steps.box(extra))
+
+        sm = jax.shard_map(body, mesh=mesh, in_specs=(state_spec, P(), P()),
+                           out_specs=state_spec)
+        self._exchange_fn = jax.jit(sm, donate_argnums=(0,))
+
+    def canonical_params(self, state):
+        """Validation/checkpoint read the CENTER (the reference validated
+        against the server's center parameters)."""
+        return steps.unbox(state["extra"])["center"]
+
+
+class ASGD_Exchanger(Exchanger):
+    """Downpour-style push-pull (reference: ``ASGD_Exchanger`` — described
+    upstream as rudimentary, sharing the EASGD server scaffolding).
+
+    Workers train locally for ``sync_freq`` steps; at exchange the center
+    absorbs the SUM of worker deltas (downpour applies every worker's
+    accumulated update) and workers restart from the fresh center.
+    """
+
+    name = "asgd"
+
+    def __init__(self, config: Optional[dict] = None):
+        super().__init__(config)
+        self.exchange_freq = int(self.config.get("sync_freq", 1))
+
+    def extra_state_template(self) -> Dict[str, Any]:
+        return {"center": jax.tree.map(jnp.asarray, self.model.params)}
+
+    def prepare(self, mesh: Mesh, model) -> None:
+        super().prepare(mesh, model)
+        axis = WORKER_AXIS
+        state_spec = {k: P(axis) for k in
+                      ("params", "opt_state", "bn_state", "extra")}
+
+        def body(state, key, count):
+            params = steps.unbox(state["params"])
+            extra = steps.unbox(state["extra"])
+            center = extra["center"]
+            delta_sum = jax.tree.map(
+                lambda p, c: lax.psum(p - c, axis), params, center)
+            new_center = jax.tree.map(jnp.add, center, delta_sum)
+            extra = dict(extra, center=new_center)
+            return dict(state, params=steps.box(new_center),
+                        extra=steps.box(extra))
+
+        sm = jax.shard_map(body, mesh=mesh, in_specs=(state_spec, P(), P()),
+                           out_specs=state_spec)
+        self._exchange_fn = jax.jit(sm, donate_argnums=(0,))
+
+    def canonical_params(self, state):
+        return steps.unbox(state["extra"])["center"]
+
+
+class GOSGD_Exchanger(Exchanger):
+    """Gossip SGD (reference: ``GOSGD_Exchanger``; SURVEY.md §3.3).
+
+    Per exchange, each worker draws Bernoulli(p); senders ship
+    ``(α/2 · params, α/2)`` to a peer and halve their α; receivers merge by
+    weighted average and absorb the weight.  The peer assignment is a shared
+    random ring-shift ``s ∈ {1..N-1}`` applied with ``lax.ppermute`` —
+    decomposed into log₂N conditional power-of-two hops so the compiled
+    program is static.  Σα is conserved exactly (tested).
+    """
+
+    name = "gosgd"
+
+    def __init__(self, config: Optional[dict] = None):
+        super().__init__(config)
+        self.p_share = float(self.config.get("exch_prob", 0.25))
+        self.exchange_freq = 1
+
+    def extra_state_template(self) -> Dict[str, Any]:
+        return {"alpha": jnp.ones(())}
+
+    def prepare(self, mesh: Mesh, model) -> None:
+        super().prepare(mesh, model)
+        axis, n, p_share = WORKER_AXIS, self.size, self.p_share
+        state_spec = {k: P(axis) for k in
+                      ("params", "opt_state", "bn_state", "extra")}
+        n_bits = max(1, int(np.ceil(np.log2(max(n, 2)))))
+
+        def body(state, key, count):
+            params = steps.unbox(state["params"])
+            extra = steps.unbox(state["extra"])
+            alpha = extra["alpha"]
+            ridx = lax.axis_index(axis)
+            step_key = jax.random.fold_in(key, count)
+            # Shared shift (same on all workers: derived from the replicated key)
+            shift = jax.random.randint(step_key, (), 1, n) if n > 1 else jnp.ones((), jnp.int32)
+            # Per-worker Bernoulli send gate
+            send = jax.random.bernoulli(
+                jax.random.fold_in(step_key, ridx), p_share)
+            w_send = jnp.where(send, alpha * 0.5, 0.0)
+            w_keep = alpha - w_send
+            msg = jax.tree.map(lambda p: p * w_send, params)
+            payload = (msg, w_send)
+
+            def hop(payload, k):
+                stride = 1 << k
+                perm = [(i, (i + stride) % n) for i in range(n)]
+                moved = jax.tree.map(
+                    lambda x: lax.ppermute(x, axis, perm), payload)
+                take = ((shift >> k) & 1) == 1
+                return jax.tree.map(
+                    lambda a, b: jnp.where(take, a, b), moved, payload)
+
+            for k in range(n_bits):
+                payload = hop(payload, k)
+            recv_msg, w_recv = payload
+
+            new_alpha = w_keep + w_recv
+            new_params = jax.tree.map(
+                lambda p, m: (w_keep * p + m) / new_alpha, params, recv_msg)
+            extra = dict(extra, alpha=new_alpha)
+            return dict(state, params=steps.box(new_params),
+                        extra=steps.box(extra))
+
+        sm = jax.shard_map(body, mesh=mesh, in_specs=(state_spec, P(), P()),
+                           out_specs=state_spec)
+        self._exchange_fn = jax.jit(sm, donate_argnums=(0,))
+
+    def canonical_params(self, state):
+        """Consensus estimate: the α-weighted average of worker replicas."""
+        params = state["params"]   # boxed [n, ...]
+        alpha = state["extra"]["alpha"]  # [n]
+        total = jnp.sum(alpha)
+
+        def avg(x):
+            w = alpha.reshape((-1,) + (1,) * (x.ndim - 1))
+            return jnp.sum(x * w, axis=0) / total
+
+        return jax.tree.map(avg, params)
+
+
+EXCHANGERS = {
+    "bsp": BSP_Exchanger,
+    "easgd": EASGD_Exchanger,
+    "asgd": ASGD_Exchanger,
+    "gosgd": GOSGD_Exchanger,
+}
+
+
+def get_exchanger(name: str, config: Optional[dict] = None) -> Exchanger:
+    try:
+        return EXCHANGERS[name.lower()](config)
+    except KeyError:
+        raise ValueError(f"unknown exchanger {name!r}; have {sorted(EXCHANGERS)}")

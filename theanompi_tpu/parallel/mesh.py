@@ -1,0 +1,127 @@
+"""Device-mesh runtime core.
+
+TPU-native replacement for Theano-MPI's process/runtime core
+(reference: ``theanompi/lib/base.py`` — ``MPI_GPU_Process``: ``MPI.COMM_WORLD``
+rank/size discovery plus per-rank GPU binding via ``THEANO_FLAGS=device=cudaN``;
+see SURVEY.md §2.1).
+
+On TPU the topology model is inverted: there is ONE Python process per host
+driving all local chips, and the "communicator" is a named-axis
+:class:`jax.sharding.Mesh`.  What the reference calls a *rank* is a position
+along the ``'workers'`` mesh axis; what it does with ``mpirun -np N`` we do
+with a mesh of N devices (single host) or ``jax.distributed.initialize`` plus
+a global mesh (multi-host, DCN control plane / ICI data plane).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+WORKER_AXIS = "workers"
+
+
+def init_multihost(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> None:
+    """Bring up the multi-host control plane (replaces ``mpirun`` + MPI_Init).
+
+    Reference equivalent: OpenMPI's job bring-up performed by the ``mpirun``
+    command composed in ``theanompi/launcher.py`` (SURVEY.md §2.6).  On TPU
+    pods, `jax.distributed.initialize` discovers peers over DCN; collectives
+    inside compiled programs then ride ICI.
+
+    No-op when running single-process (the common single-host case) — mirrors
+    the reference's ability to run ``-np 1``.
+    """
+    if num_processes is not None and num_processes > 1:
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+        )
+    elif coordinator_address is not None:
+        # TPU pod slice: remaining args are auto-detected from the environment.
+        jax.distributed.initialize(coordinator_address=coordinator_address)
+
+
+def worker_mesh(
+    n_workers: Optional[int] = None,
+    devices: Optional[Sequence[jax.Device]] = None,
+    axis_name: str = WORKER_AXIS,
+) -> Mesh:
+    """Build the 1-D data-parallel mesh — the TPU-native "communicator".
+
+    Reference equivalent: the set of MPI ranks created by
+    ``mpirun -np N python -m theanompi.worker`` with one rank per GPU
+    (SURVEY.md §2.1, §2.6).  Theano-MPI's parallelism surface is pure data
+    parallelism in four flavors, so the canonical mesh is 1-D over
+    ``'workers'``.
+    """
+    if devices is None:
+        devices = jax.devices()
+    if n_workers is None:
+        n_workers = len(devices)
+    if n_workers > len(devices):
+        raise ValueError(
+            f"requested {n_workers} workers but only {len(devices)} devices "
+            f"are visible ({[str(d) for d in devices]})"
+        )
+    dev = np.asarray(devices[:n_workers])
+    return Mesh(dev, (axis_name,))
+
+
+def mesh_size(mesh: Mesh, axis_name: str = WORKER_AXIS) -> int:
+    return mesh.shape[axis_name]
+
+
+def batch_sharding(mesh: Mesh, axis_name: str = WORKER_AXIS) -> NamedSharding:
+    """Sharding for a global batch: leading dim split across workers.
+
+    Reference equivalent: each MPI rank loading its own shard of the
+    ``.hkl`` filename list (SURVEY.md §2.8) — here the split is expressed as
+    a sharding constraint and XLA moves nothing if each host fed its own
+    shard via ``make_per_host_array``.
+    """
+    return NamedSharding(mesh, P(axis_name))
+
+
+def replicated_sharding(mesh: Mesh) -> NamedSharding:
+    """Sharding for replicated state (BSP params, center params)."""
+    return NamedSharding(mesh, P())
+
+
+def worker_local_sharding(mesh: Mesh, axis_name: str = WORKER_AXIS) -> NamedSharding:
+    """Sharding for per-worker-divergent state (EASGD/ASGD/GoSGD params).
+
+    The async rules let each worker's parameters drift between syncs
+    (SURVEY.md §2.2).  On an SPMD mesh "per-worker state" is a pytree whose
+    leaves carry a leading ``[n_workers]`` axis sharded over ``'workers'`` —
+    each chip holds exactly its own replica, no replication cost.
+    """
+    return NamedSharding(mesh, P(axis_name))
+
+
+def shard_batch(mesh: Mesh, batch, axis_name: str = WORKER_AXIS):
+    """Place a host batch onto the mesh, split across workers."""
+    sh = batch_sharding(mesh, axis_name)
+    return jax.tree.map(lambda x: jax.device_put(x, sh), batch)
+
+
+def make_per_host_array(mesh: Mesh, local_batch, axis_name: str = WORKER_AXIS):
+    """Assemble a global array from per-host local shards (multi-host path).
+
+    Reference equivalent: there is none needed — each MPI rank simply owned
+    its slice.  Under single-controller JAX the per-host loader output is
+    stitched into one global ``jax.Array`` without copying across hosts.
+    """
+    sh = batch_sharding(mesh, axis_name)
+    return jax.tree.map(
+        lambda x: jax.make_array_from_process_local_data(sh, np.asarray(x)), local_batch
+    )

@@ -1,0 +1,194 @@
+"""Compiled SPMD train/val step assembly.
+
+This is where Theano-MPI's ``model.compile_iter_fns()`` → ``theano.function``
+train/val functions (SURVEY.md §2.5, §3.4) become ``jax.jit``-compiled SPMD
+programs.  The reference compiled one opaque native function per sub-batch
+(cuDNN fwd → loss → bwd → in-place momentum update); here the WHOLE hot
+iteration — microbatch ``lax.scan``, backward pass, cross-worker exchange,
+optimizer update — is one XLA program per step, so the collective fuses with
+compute and rides ICI with no host round-trip.
+
+State layout (uniform across all four rules — see SURVEY.md §2.2): every
+state leaf carries a leading ``[n_workers]`` axis sharded over the
+``'workers'`` mesh axis, so each chip holds exactly one replica.  For BSP the
+replicas stay bit-identical (the exchanger reduces gradients); for
+EASGD/ASGD/GoSGD they diverge between exchanges, which is the whole point of
+those rules.  A uniform "boxed" layout means one code path, no replication
+bookkeeping, and zero memory overhead versus replicated params.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from .mesh import WORKER_AXIS, batch_sharding, worker_local_sharding
+
+
+# ---------------------------------------------------------------------------
+# boxing helpers: [*shape] per-worker view <-> [n_workers, *shape] global
+# ---------------------------------------------------------------------------
+
+def box(tree):
+    """Add the local leading axis (inside shard_map: local shard is [1,...])."""
+    return jax.tree.map(lambda x: x[None], tree)
+
+
+def unbox(tree):
+    return jax.tree.map(lambda x: x[0], tree)
+
+
+def replicate_tree(tree, n: int, mesh: Mesh):
+    """Broadcast an unboxed pytree to the boxed [n_workers, ...] layout and
+    place it sharded over the workers axis (one replica per chip)."""
+    sh = worker_local_sharding(mesh)
+    return jax.tree.map(
+        lambda x: jax.device_put(
+            np.broadcast_to(np.asarray(x)[None], (n,) + np.asarray(x).shape), sh
+        ),
+        tree,
+    )
+
+
+# ---------------------------------------------------------------------------
+# microbatch gradient accumulation (reference: n_subb sub-batches, §3.4)
+# ---------------------------------------------------------------------------
+
+def _vary(x, axis: str):
+    """Mark a replicated value as device-varying for shard_map's vma type
+    system (scan carries that accumulate per-worker values need this)."""
+    try:
+        return lax.pcast(x, (axis,), to="varying")
+    except (AttributeError, TypeError):
+        return lax.pvary(x, (axis,))
+
+
+def _accumulate_grads(loss_and_metrics: Callable, params, bn_state, batch,
+                      rng, n_subb: int, axis: str = WORKER_AXIS):
+    """Grad accumulation over ``n_subb`` microbatches as a ``lax.scan``.
+
+    ``loss_and_metrics(params, bn_state, batch, rng, train=True)`` must
+    return ``(cost, (err, new_bn_state))``.  BN state threads sequentially
+    through microbatches (matching the reference's sequential sub-batch
+    execution).
+    """
+
+    def lf(p, bn, b, r):
+        return loss_and_metrics(p, bn, b, r, True)
+
+    if n_subb == 1:
+        (cost, (err, new_bn)), grads = jax.value_and_grad(lf, has_aux=True)(
+            params, bn_state, batch, rng)
+        return cost, err, grads, new_bn
+
+    def reshape(x):
+        assert x.shape[0] % n_subb == 0, (
+            f"batch dim {x.shape[0]} not divisible by n_subb={n_subb}")
+        return x.reshape((n_subb, x.shape[0] // n_subb) + x.shape[1:])
+
+    micro = jax.tree.map(reshape, batch)
+
+    def body(carry, mb):
+        acc_g, acc_c, acc_e, bn, key = carry
+        key, sub = jax.random.split(key)
+        (cost, (err, bn)), grads = jax.value_and_grad(lf, has_aux=True)(
+            params, bn, mb, sub)
+        acc_g = jax.tree.map(jnp.add, acc_g, grads)
+        return (acc_g, acc_c + cost, acc_e + err, bn, key), None
+
+    zero_g = jax.tree.map(jnp.zeros_like, params)
+    zero_c = _vary(jnp.zeros(()), axis)
+    (acc_g, acc_c, acc_e, new_bn, _), _ = lax.scan(
+        body, (zero_g, zero_c, zero_c, bn_state, rng), micro)
+    inv = 1.0 / n_subb
+    return acc_c * inv, acc_e * inv, jax.tree.map(lambda g: g * inv, acc_g), new_bn
+
+
+# ---------------------------------------------------------------------------
+# step builders
+# ---------------------------------------------------------------------------
+
+def build_train_step(mesh: Mesh, model, exchanger) -> Callable:
+    """Compile the training step.
+
+    Returns ``train_fn(state_dict, batch, lr, rng, count) ->
+    (state_dict, cost[n], err[n])`` where ``state_dict`` has boxed leaves and
+    is donated (params update in place in HBM, as the reference's in-place
+    Theano updates did).
+    """
+    axis = WORKER_AXIS
+    n = mesh.shape[axis]
+    n_subb = getattr(model, "n_subb", 1)
+
+    def per_worker(state, batch, lr, rng, count):
+        params = unbox(state["params"])
+        opt_state = unbox(state["opt_state"])
+        bn_state = unbox(state["bn_state"])
+        extra = unbox(state["extra"])
+        ridx = lax.axis_index(axis)
+        local_rng = jax.random.fold_in(jax.random.fold_in(rng, ridx), count)
+
+        cost, err, grads, new_bn = _accumulate_grads(
+            model.loss_and_metrics, params, bn_state, batch, local_rng, n_subb)
+
+        params, opt_state, extra = exchanger.step_update(
+            params, opt_state, grads, extra, lr, axis=axis, size=n, count=count)
+
+        new_state = {
+            "params": box(params),
+            "opt_state": box(opt_state),
+            "bn_state": box(new_bn),
+            "extra": box(extra),
+        }
+        return new_state, cost[None], err[None]
+
+    state_spec = {k: P(axis) for k in ("params", "opt_state", "bn_state", "extra")}
+    sm = jax.shard_map(
+        per_worker, mesh=mesh,
+        in_specs=(state_spec, P(axis), P(), P(), P()),
+        out_specs=(state_spec, P(axis), P(axis)),
+    )
+    return jax.jit(sm, donate_argnums=(0,))
+
+
+def build_val_step(mesh: Mesh, model) -> Callable:
+    """Compile the validation step: each worker evaluates its shard of the
+    val batch with its own replica (the reference's per-rank validation).
+
+    Returns ``val_fn(params_boxed, bn_boxed, batch) ->
+    (cost[n], err[n], err_top5[n])``.
+    """
+    axis = WORKER_AXIS
+
+    def per_worker(params, bn_state, batch):
+        params = unbox(params)
+        bn_state = unbox(bn_state)
+        cost, (err, err5) = model.val_metrics(params, bn_state, batch)
+        return cost[None], err[None], err5[None]
+
+    sm = jax.shard_map(
+        per_worker, mesh=mesh,
+        in_specs=(P(axis), P(axis), P(axis)),
+        out_specs=(P(axis), P(axis), P(axis)),
+    )
+    return jax.jit(sm)
+
+
+def put_batch(mesh: Mesh, batch):
+    """Place a host batch onto the mesh, split across workers.
+
+    Single-process: ``batch`` is the global batch, device_put shards it.
+    Multi-host: ``batch`` is this host's LOCAL shard; the global array is
+    stitched from per-process data without cross-host copies.
+    """
+    if jax.process_count() > 1:
+        from .mesh import make_per_host_array
+        return make_per_host_array(mesh, batch)
+    sh = batch_sharding(mesh)
+    return jax.tree.map(lambda x: jax.device_put(x, sh), batch)

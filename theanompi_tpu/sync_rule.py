@@ -1,0 +1,71 @@
+"""Sync-rule session API.
+
+TPU-native rebuild of Theano-MPI's ``theanompi/sync_rule.py``
+(SURVEY.md §2.6): the 3-call public API every reference session script used —
+
+    from theanompi import BSP
+    rule = BSP()
+    rule.init(devices=['cuda0', 'cuda1'])   # here: device count / list
+    rule.wait()
+
+The reference's ``init`` composed an ``mpirun`` command line (MPMD for
+EASGD's server+workers) and ``wait`` blocked on the spawned processes.  On
+TPU a single process drives every local chip through the mesh, so by default
+``wait()`` runs the training IN-PROCESS; multi-host launch command
+composition lives in :mod:`theanompi_tpu.launcher`.
+
+``devices`` accepts the reference's string form (``['cuda0', ...]`` — only
+the count matters now), an int, or None for all local chips.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Union
+
+from .worker import WORKERS
+
+
+class SyncRule:
+    rule = "bsp"
+
+    def __init__(self, config: Optional[dict] = None):
+        self.config = dict(config or {})
+        self.worker = None
+        self.model = None
+        self.recorder = None
+
+    def init(self, devices: Union[int, Sequence, None] = None,
+             modelfile: str = "theanompi_tpu.models.cifar10",
+             modelclass: str = "Cifar10_model", **kwargs) -> "SyncRule":
+        """Record topology + model selection (≙ reference ``rule.init``)."""
+        if devices is not None and not isinstance(devices, int):
+            devices = len(list(devices))
+        self.config.update(kwargs)
+        self.config["n_workers"] = devices
+        self.config["rule"] = self.rule
+        self.modelfile, self.modelclass = modelfile, modelclass
+        return self
+
+    def wait(self):
+        """Run training to completion (≙ reference ``rule.wait()`` blocking
+        on the mpirun job) and return the recorder."""
+        self.worker = WORKERS[self.rule](self.config)
+        self.model = self.worker.build_model(self.modelfile, self.modelclass)
+        self.recorder = self.worker.run(self.model)
+        return self.recorder
+
+
+class BSP(SyncRule):
+    rule = "bsp"
+
+
+class EASGD(SyncRule):
+    rule = "easgd"
+
+
+class ASGD(SyncRule):
+    rule = "asgd"
+
+
+class GOSGD(SyncRule):
+    rule = "gosgd"

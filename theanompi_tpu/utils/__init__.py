@@ -1,0 +1,2 @@
+"""Support libs: recorder, optimizers, buffer/serialization helpers,
+checkpointing (reference: theanompi/lib/, SURVEY.md §2.10)."""

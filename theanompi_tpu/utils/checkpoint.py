@@ -1,0 +1,88 @@
+"""Checkpoint / resume.
+
+Reference behavior (SURVEY.md §5 "Checkpoint / resume"): per-epoch
+``save_model`` dumped each layer's ``Weight`` to ``.npy`` files in a snapshot
+dir; resume loaded them at model-build time via a config flag; optimizer
+state was NOT saved.
+
+This rebuild keeps the per-epoch cadence and the "load at build" flow but
+checkpoints the FULL training state — params, optimizer state (velocity), BN
+running stats, RNG key, epoch/step counters — as an ``.npz`` bundle plus the
+reference-compatible per-leaf ``.npy`` params snapshot, so both resume paths
+work.  Everything is host-side numpy: on multi-host, rank 0 saves (as the
+reference did) since BSP state is replicated.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, Optional
+
+import jax
+import numpy as np
+
+from . import helper_funcs
+
+
+def save_checkpoint(ckpt_dir: str, step_state: Dict[str, Any], epoch: int,
+                    count: int, keep_params_npy: bool = True) -> str:
+    """``step_state`` is a dict of pytrees/scalars (params, opt_state, ...)."""
+    os.makedirs(ckpt_dir, exist_ok=True)
+    path = os.path.join(ckpt_dir, f"ckpt_epoch{epoch}")
+    flat: Dict[str, np.ndarray] = {}
+    for key, tree in step_state.items():
+        leaves, _ = jax.tree_util.tree_flatten(tree)
+        for i, leaf in enumerate(leaves):
+            flat[f"{key}__{i}"] = np.asarray(leaf)
+    np.savez(path + ".npz", **flat)
+    with open(path + ".json", "w") as f:
+        json.dump({"epoch": epoch, "count": count,
+                   "keys": sorted(step_state.keys())}, f)
+    if keep_params_npy and "params" in step_state:
+        helper_funcs.save_params(step_state["params"],
+                                 os.path.join(ckpt_dir, f"params_epoch{epoch}"))
+    _write_latest(ckpt_dir, epoch)
+    return path + ".npz"
+
+
+def load_checkpoint(ckpt_dir: str, template: Dict[str, Any],
+                    epoch: Optional[int] = None) -> Optional[Dict[str, Any]]:
+    """Restore state shaped like ``template``; returns None if no checkpoint."""
+    if epoch is None:
+        epoch = latest_epoch(ckpt_dir)
+        if epoch is None:
+            return None
+    path = os.path.join(ckpt_dir, f"ckpt_epoch{epoch}.npz")
+    if not os.path.exists(path):
+        return None
+    data = np.load(path)
+    out: Dict[str, Any] = {}
+    for key, tree in template.items():
+        leaves, treedef = jax.tree_util.tree_flatten(tree)
+        new_leaves = []
+        for i, leaf in enumerate(leaves):
+            arr = data[f"{key}__{i}"]
+            new_leaves.append(arr.astype(leaf.dtype) if hasattr(leaf, "dtype") else arr)
+        out[key] = jax.tree_util.tree_unflatten(treedef, new_leaves)
+    with open(os.path.join(ckpt_dir, f"ckpt_epoch{epoch}.json")) as f:
+        meta = json.load(f)
+    out["_meta"] = meta
+    return out
+
+
+def latest_epoch(ckpt_dir: str) -> Optional[int]:
+    latest = os.path.join(ckpt_dir, "LATEST")
+    if os.path.exists(latest):
+        with open(latest) as f:
+            return int(f.read().strip())
+    if not os.path.isdir(ckpt_dir):
+        return None
+    epochs = [int(f[len("ckpt_epoch"):-4]) for f in os.listdir(ckpt_dir)
+              if f.startswith("ckpt_epoch") and f.endswith(".npz")]
+    return max(epochs) if epochs else None
+
+
+def _write_latest(ckpt_dir: str, epoch: int) -> None:
+    with open(os.path.join(ckpt_dir, "LATEST"), "w") as f:
+        f.write(str(epoch))

@@ -1,0 +1,97 @@
+"""Buffer/serialization helpers.
+
+TPU-native rebuild of Theano-MPI's ``theanompi/lib/helper_funcs.py``
+(SURVEY.md §2.10): where the reference exposed raw ``bufint`` GPUArray views
+and a numpy↔MPI dtype map so mpi4py could address device memory, the
+TPU-native equivalents are pytree↔flat-vector packing (the ring/compressed
+exchanger strategies operate on one contiguous fp32 vector, like the
+reference's concatenated parameter buffer) and per-layer ``.npy``
+save/load (``save_model`` / ``load_model`` via ``Weight.save``).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Callable, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+# ---------------------------------------------------------------------------
+# pytree <-> flat vector  (reference: the contiguous GPUArray param buffer the
+# exchanger strategies walked with bufint views)
+# ---------------------------------------------------------------------------
+
+def tree_size(tree) -> int:
+    return sum(int(np.prod(l.shape)) for l in jax.tree.leaves(tree))
+
+
+def flatten_tree(tree, pad_to_multiple_of: int = 1) -> jnp.ndarray:
+    """Concatenate all leaves into one fp32 vector (optionally zero-padded).
+
+    Padding to a multiple of the worker count lets the ring strategies
+    reduce-scatter equal chunks — the same trick the reference's ``asa``
+    alltoall-sum-allgather strategy used on its concatenated buffer.
+    """
+    leaves = jax.tree.leaves(tree)
+    flat = jnp.concatenate([l.reshape(-1).astype(jnp.float32) for l in leaves])
+    if pad_to_multiple_of > 1:
+        pad = (-flat.shape[0]) % pad_to_multiple_of
+        if pad:
+            flat = jnp.concatenate([flat, jnp.zeros((pad,), jnp.float32)])
+    return flat
+
+
+def unflatten_like(tree, flat: jnp.ndarray):
+    """Inverse of :func:`flatten_tree` (ignores any zero padding)."""
+    leaves, treedef = jax.tree.flatten(tree)
+    out, ofs = [], 0
+    for l in leaves:
+        n = int(np.prod(l.shape))
+        out.append(flat[ofs:ofs + n].reshape(l.shape).astype(l.dtype))
+        ofs += n
+    return jax.tree.unflatten(treedef, out)
+
+
+# ---------------------------------------------------------------------------
+# save/load  (reference: save_model/load_model — per-layer .npy snapshots)
+# ---------------------------------------------------------------------------
+
+def _leaf_paths(tree):
+    flat, _ = jax.tree_util.tree_flatten_with_path(tree)
+    for path, leaf in flat:
+        name = "_".join(
+            str(getattr(p, "key", getattr(p, "idx", p))) for p in path
+        )
+        yield name, leaf
+
+
+def save_params(params, snapshot_dir: str) -> None:
+    """Save a parameter pytree as one ``.npy`` per leaf (reference format:
+    per-layer ``Weight.save`` into a snapshot dir)."""
+    os.makedirs(snapshot_dir, exist_ok=True)
+    for name, leaf in _leaf_paths(params):
+        np.save(os.path.join(snapshot_dir, f"{name}.npy"), np.asarray(leaf))
+
+
+def load_params(params_template, snapshot_dir: str):
+    """Load a pytree saved by :func:`save_params`, shaped like the template."""
+    flat, treedef = jax.tree_util.tree_flatten_with_path(params_template)
+    leaves = []
+    for path, leaf in flat:
+        name = "_".join(str(getattr(p, "key", getattr(p, "idx", p))) for p in path)
+        arr = np.load(os.path.join(snapshot_dir, f"{name}.npy"))
+        if arr.shape != leaf.shape:
+            raise ValueError(f"checkpoint leaf {name}: shape {arr.shape} != "
+                             f"expected {leaf.shape}")
+        leaves.append(jnp.asarray(arr, dtype=leaf.dtype))
+    return jax.tree_util.tree_unflatten(treedef, leaves)
+
+
+def tree_allclose(a, b, rtol=1e-5, atol=1e-6) -> bool:
+    oks = jax.tree.map(
+        lambda x, y: bool(np.allclose(np.asarray(x), np.asarray(y), rtol, atol)), a, b
+    )
+    return all(jax.tree.leaves(oks))

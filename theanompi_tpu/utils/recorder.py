@@ -1,0 +1,185 @@
+"""Wall-clock section timing + metric accumulation.
+
+TPU-native rebuild of Theano-MPI's ``theanompi/lib/recorder.py``
+(SURVEY.md §2.10): per-iteration section timers (``t_train`` / ``t_comm`` /
+``t_wait`` / ``t_load``), images/sec derivation, train cost/error and val
+top-1/top-5 accumulation, periodic console printing, and per-epoch dumps for
+offline plotting.  The paper's "time per 5120 images" tables come from this
+component, so the bucket names and the 5120-image accounting are preserved.
+
+Additions over the reference: JSONL record emission (alongside the ``.npy``
+dumps) and an images/sec/chip derivation — the north-star metric in
+``BASELINE.json``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from collections import defaultdict
+from typing import Dict, List, Optional
+
+import numpy as np
+
+# The reference reports "time per 5120 images" (40 batches of 128).
+IMAGES_PER_REPORT = 5120
+
+SECTIONS = ("train", "comm", "wait", "load", "val")
+
+
+class Recorder:
+    """Three-bucket (plus load/val) wall-clock recorder.
+
+    Usage mirrors the reference: the worker hot loop brackets each phase with
+    ``recorder.start()`` / ``recorder.end('train')``, accumulates metrics with
+    ``train_error`` / ``val_error``, and prints every ``printFreq`` iterations
+    with ``print_train_info(count)``.
+    """
+
+    def __init__(self, config: Optional[dict] = None):
+        config = config or {}
+        self.verbose: bool = config.get("verbose", True)
+        self.rank: int = config.get("rank", 0)
+        self.size: int = config.get("size", 1)
+        self.printFreq: int = config.get("printFreq", 40)
+        self.record_dir: str = config.get("record_dir", "./inc")
+
+        self._t0: Optional[float] = None
+        self.t_sec: Dict[str, float] = defaultdict(float)  # running, since last print
+        self.t_sec_total: Dict[str, float] = defaultdict(float)
+
+        self._train_cost: List[float] = []
+        self._train_error: List[float] = []
+        self._val_cost: List[float] = []
+        self._val_error: List[float] = []
+        self._val_error_top5: List[float] = []
+
+        self.n_images: int = 0  # images since last print
+        self.n_images_total: int = 0
+        self.epoch_records: List[dict] = []
+        self._all_records: List[dict] = []
+        self._wall_start = time.time()
+        self._last_print_wall = self._wall_start
+
+    # -- timing ------------------------------------------------------------
+
+    def start(self) -> None:
+        self._t0 = time.time()
+
+    def end(self, section: str) -> float:
+        assert self._t0 is not None, "Recorder.end() without start()"
+        dt = time.time() - self._t0
+        self.t_sec[section] += dt
+        self.t_sec_total[section] += dt
+        self._t0 = None
+        return dt
+
+    # -- metric accumulation ----------------------------------------------
+
+    def train_error(self, count: int, cost, error, n_images: int = 0) -> None:
+        """``cost``/``error`` may be host floats OR device scalars — they are
+        only materialized at print cadence, so async dispatch stays async."""
+        self._train_cost.append(cost)
+        self._train_error.append(error)
+        self.n_images += n_images
+        self.n_images_total += n_images
+
+    def val_error(self, count: int, cost: float, error: float, error_top5: float = 0.0) -> None:
+        self._val_cost.append(float(cost))
+        self._val_error.append(float(error))
+        self._val_error_top5.append(float(error_top5))
+
+    # -- reporting ---------------------------------------------------------
+
+    def images_per_sec(self) -> float:
+        """Throughput since the last print, from WALL time — honest whether
+        the hot loop dispatches asynchronously or blocks per iteration (the
+        section buckets only sum to wall time in blocking mode)."""
+        t = time.time() - self._last_print_wall
+        return self.n_images / t if t > 0 else 0.0
+
+    def time_per_5120(self) -> float:
+        """The reference's headline unit: seconds per 5120 images processed."""
+        ips = self.images_per_sec()
+        return IMAGES_PER_REPORT / ips if ips > 0 else float("inf")
+
+    def print_train_info(self, count: int) -> None:
+        if count % self.printFreq != 0:
+            return
+        k = self.printFreq
+        # materializing device scalars happens HERE, once per printFreq iters
+        cost = float(np.mean([np.asarray(c) for c in self._train_cost[-k:]])) \
+            if self._train_cost else float("nan")
+        err = float(np.mean([np.asarray(e) for e in self._train_error[-k:]])) \
+            if self._train_error else float("nan")
+        rec = {
+            "iter": count,
+            "cost": cost,
+            "error": err,
+            "t_train": self.t_sec["train"],
+            "t_comm": self.t_sec["comm"],
+            "t_wait": self.t_sec["wait"],
+            "t_load": self.t_sec["load"],
+            "images_per_sec": self.images_per_sec(),
+            "images_per_sec_per_chip": self.images_per_sec() / max(self.size, 1),
+            "time_per_5120": self.time_per_5120(),
+            "wall": time.time() - self._wall_start,
+        }
+        self._all_records.append(rec)
+        if self.verbose and self.rank == 0:
+            print(
+                f"iter {count}: cost {cost:.4f} err {err:.4f} | "
+                f"train {rec['t_train']:.3f}s comm {rec['t_comm']:.3f}s "
+                f"wait {rec['t_wait']:.3f}s load {rec['t_load']:.3f}s | "
+                f"{rec['images_per_sec']:.1f} img/s "
+                f"({rec['images_per_sec_per_chip']:.1f}/chip, "
+                f"{rec['time_per_5120']:.2f}s per 5120)",
+                flush=True,
+            )
+        for s in SECTIONS:
+            self.t_sec[s] = 0.0
+        self.n_images = 0
+        self._last_print_wall = time.time()
+
+    def print_val_info(self, count: int) -> dict:
+        rec = {
+            "iter": count,
+            "val_cost": float(np.mean(self._val_cost)) if self._val_cost else float("nan"),
+            "val_error": float(np.mean(self._val_error)) if self._val_error else float("nan"),
+            "val_error_top5": (
+                float(np.mean(self._val_error_top5)) if self._val_error_top5 else float("nan")
+            ),
+            "t_val": self.t_sec_total["val"],
+        }
+        self.epoch_records.append(rec)
+        if self.verbose and self.rank == 0:
+            print(
+                f"validation @ iter {count}: cost {rec['val_cost']:.4f} "
+                f"top-1 err {rec['val_error']:.4f} top-5 err {rec['val_error_top5']:.4f}",
+                flush=True,
+            )
+        self._val_cost, self._val_error, self._val_error_top5 = [], [], []
+        return rec
+
+    def clear_train_info(self) -> None:
+        self._train_cost, self._train_error = [], []
+
+    # -- persistence (reference dumps .npy records; we add JSONL) ----------
+
+    def save(self, record_dir: Optional[str] = None) -> None:
+        d = record_dir or self.record_dir
+        os.makedirs(d, exist_ok=True)
+        np.save(os.path.join(d, f"inforec_rank{self.rank}.npy"),
+                np.array(self._all_records, dtype=object))
+        with open(os.path.join(d, f"inforec_rank{self.rank}.jsonl"), "w") as f:
+            for rec in self._all_records:
+                f.write(json.dumps(rec) + "\n")
+            for rec in self.epoch_records:
+                f.write(json.dumps(rec) + "\n")
+
+    def load(self, record_dir: Optional[str] = None) -> None:
+        d = record_dir or self.record_dir
+        path = os.path.join(d, f"inforec_rank{self.rank}.npy")
+        if os.path.exists(path):
+            self._all_records = list(np.load(path, allow_pickle=True))

@@ -1,0 +1,136 @@
+"""Worker main loops.
+
+TPU-native rebuild of Theano-MPI's per-rule worker files
+(``theanompi/worker.py``, ``easgd_worker.py`` + ``easgd_server.py``,
+``gosgd_worker.py`` — SURVEY.md §2.5, §3.1–3.3): the epoch/batch driver that
+calls ``model.train_iter`` → ``exchanger.exchange`` → recorder, runs the
+per-epoch validation loop, ``adjust_hyperp``, and checkpointing.
+
+One class per rule, as in the reference; they differ only in which exchanger
+they construct and its cadence.  There is no separate EASGD *server* process:
+on SPMD TPU the center parameter store is replicated mesh state inside the
+EASGD exchanger (SURVEY.md §7 "asynchrony on SPMD hardware") — a chip is not
+burned on serving parameters.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Optional
+
+from .base import MeshProcess
+from .parallel.exchanger import get_exchanger
+from .utils.recorder import Recorder
+
+
+class Worker(MeshProcess):
+    """Generic rule-driven worker (≙ reference ``BSP_Worker`` et al.)."""
+
+    rule = "bsp"
+
+    def __init__(self, config: Optional[dict] = None):
+        super().__init__(config)
+        self.get_internode_comm()
+        self.init_device()
+        self.recorder = Recorder(self.config)
+        self.exchanger = get_exchanger(self.config.get("rule", self.rule),
+                                       self.config)
+
+    def run(self, model) -> Recorder:
+        """The reference's ``run(model)`` epoch/batch loop (SURVEY.md §3.1)."""
+        config = self.config
+        model.compile_iter_fns(self.exchanger)
+        if config.get("scale_lr", True) and self.size > 1:
+            model.scale_lr(self.size)
+
+        start_epoch = 0
+        ckpt_dir = config.get("ckpt_dir")
+        if ckpt_dir and config.get("resume", False):
+            restored = model.load(ckpt_dir)
+            if restored is not None:
+                start_epoch = restored + 1
+                if self.verbose:
+                    print(f"resumed from epoch {restored}", flush=True)
+
+        count = start_epoch * model.data.n_batch_train
+        epochs = config.get("epochs", model.epochs)
+        t0 = time.time()
+        for epoch in range(start_epoch, epochs):
+            model.adjust_hyperp(epoch)
+            model.data.shuffle_data(epoch + model.seed)
+            for _ in range(model.data.n_batch_train):
+                count += 1
+                model.train_iter(count, self.recorder)
+                self.exchanger.exchange(self.recorder, count)
+                self.recorder.print_train_info(count)
+
+            model.begin_val()
+            for _ in range(model.data.n_batch_val):
+                model.val_iter(count, self.recorder)
+            model.end_val()
+            self.recorder.print_val_info(count)
+
+            if ckpt_dir:
+                model.save(ckpt_dir, epoch, count)
+            if config.get("record_dir"):
+                self.recorder.save(config["record_dir"])
+        if self.verbose:
+            print(f"training finished in {time.time() - t0:.1f}s "
+                  f"({epochs - start_epoch} epochs)", flush=True)
+        return self.recorder
+
+
+class BSP_Worker(Worker):
+    rule = "bsp"
+
+
+class EASGD_Worker(Worker):
+    rule = "easgd"
+
+
+class ASGD_Worker(Worker):
+    rule = "asgd"
+
+
+class GOSGD_Worker(Worker):
+    rule = "gosgd"
+
+
+WORKERS = {
+    "bsp": BSP_Worker,
+    "easgd": EASGD_Worker,
+    "asgd": ASGD_Worker,
+    "gosgd": GOSGD_Worker,
+}
+
+
+def main(argv=None):
+    """CLI entry: ``python -m theanompi_tpu.worker <rule> <modelfile>
+    <modelclass> [key=value ...]`` — the per-rank command the reference's
+    launcher composed into its ``mpirun`` line (SURVEY.md §2.6)."""
+    import sys
+
+    argv = argv if argv is not None else sys.argv[1:]
+    if len(argv) < 3:
+        print("usage: python -m theanompi_tpu.worker <rule> <modelfile> "
+              "<modelclass> [key=value ...]")
+        return 1
+    rule, modelfile, modelclass = argv[:3]
+    config = {"rule": rule}
+    for kv in argv[3:]:
+        k, _, v = kv.partition("=")
+        try:
+            config[k] = int(v)
+        except ValueError:
+            try:
+                config[k] = float(v)
+            except ValueError:
+                config[k] = {"true": True, "false": False}.get(v.lower(), v)
+    worker = WORKERS[rule](config)
+    model = worker.build_model(modelfile, modelclass)
+    worker.run(model)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
