@@ -98,7 +98,8 @@ class ImageNet_data:
         self._synth_x = r.randint(0, 256,
                                   (self.global_batch, RAW, RAW, 3),
                                   dtype=np.uint8)
-        self._synth_y = r.randint(0, N_CLASS, self.global_batch).astype(
+        n_class = int(self.config.get("n_class", N_CLASS))
+        self._synth_y = r.randint(0, n_class, self.global_batch).astype(
             np.int32)
 
     # -- contract ------------------------------------------------------------

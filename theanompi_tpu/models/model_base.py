@@ -65,15 +65,14 @@ class ModelBase:
         self.seq: L.Sequential = None
         self.data = None
         self.build_model()            # subclass hook: set self.seq, self.data
-        assert self.seq is not None, "build_model() must set self.seq"
         if self.config.get("para_load", False) and self.data is not None:
             # reference's para_load=True flag → background parallel loader
             from .data.prefetch import PrefetchLoader
             self.data = PrefetchLoader(self.data)
 
         key = jax.random.key(self.seed)
-        self.params = self.seq.init(key)
-        self.bn_state = self.seq.init_state()
+        self.params = self.init_params(key)
+        self.bn_state = self.init_bn_state()
         self.opt = get_optimizer(self.optimizer, mu=self.momentum,
                                  weight_decay=self.weight_decay) \
             if self.optimizer in ("momentum", "nesterov") \
@@ -93,17 +92,32 @@ class ModelBase:
     def build_model(self) -> None:
         raise NotImplementedError
 
+    # Simple chain models set self.seq in build_model(); composite models
+    # (GoogLeNet's aux heads, ResNet's residual graph) override these three
+    # hooks instead and may leave self.seq unset.
+    def init_params(self, key):
+        assert self.seq is not None, "build_model() must set self.seq or " \
+                                     "override init_params/apply_model"
+        return self.seq.init(key)
+
+    def init_bn_state(self):
+        return self.seq.init_state() if self.seq is not None else {}
+
+    def apply_model(self, params, x, *, train, rng, state):
+        """Returns (logits, new_state)."""
+        return self.seq.apply(params, x, train=train, rng=rng, state=state)
+
     def loss_and_metrics(self, params, bn_state, batch, rng, train):
         """Default head: softmax cross-entropy + top-1 error."""
-        logits, new_bn = self.seq.apply(params, batch["x"], train=train,
-                                        rng=rng, state=bn_state)
+        logits, new_bn = self.apply_model(params, batch["x"], train=train,
+                                          rng=rng, state=bn_state)
         cost = L.softmax_cross_entropy(logits, batch["y"])
         err = L.errors(logits, batch["y"])
         return cost, (err, new_bn)
 
     def val_metrics(self, params, bn_state, batch):
-        logits, _ = self.seq.apply(params, batch["x"], train=False,
-                                   state=bn_state)
+        logits, _ = self.apply_model(params, batch["x"], train=False,
+                                     rng=None, state=bn_state)
         cost = L.softmax_cross_entropy(logits, batch["y"])
         return cost, (L.errors(logits, batch["y"]),
                       L.errors_top_x(logits, batch["y"], 5))

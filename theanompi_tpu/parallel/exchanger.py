@@ -94,6 +94,12 @@ class Exchanger:
         params, opt_state = opt.update(grads, opt_state, params, lr)
         return params, opt_state, extra
 
+    def sync_bn(self, bn_state, *, axis, size):
+        """How BatchNorm running stats relate across workers.  Async rules
+        keep them local (they are part of the divergent replica); BSP
+        averages them so replicas stay bit-identical."""
+        return bn_state
+
     # -- exchange collective (Python cadence + jitted body) ----------------
 
     def due(self, count: int) -> bool:
@@ -171,6 +177,11 @@ class BSP_Exchanger(Exchanger):
         opt = self.model.opt
         params, opt_state = opt.update(grads, opt_state, params, lr)
         return params, opt_state, extra
+
+    def sync_bn(self, bn_state, *, axis, size):
+        # Keep BSP replicas bit-identical: running stats are averaged every
+        # step (cheap — BN state is tiny next to params).
+        return jax.tree.map(lambda x: lax.pmean(x, axis), bn_state)
 
 
 class EASGD_Exchanger(Exchanger):

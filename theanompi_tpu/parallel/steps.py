@@ -139,6 +139,7 @@ def build_train_step(mesh: Mesh, model, exchanger) -> Callable:
 
         params, opt_state, extra = exchanger.step_update(
             params, opt_state, grads, extra, lr, axis=axis, size=n, count=count)
+        new_bn = exchanger.sync_bn(new_bn, axis=axis, size=n)
 
         new_state = {
             "params": box(params),
