@@ -144,10 +144,34 @@ def test_pack_unpack_roundtrip():
     r = np.random.RandomState(8)
     c = r.randn(4 * compress.PACK_ALIGN).astype(np.float32)
     packed = compress.pack_signs(jnp.asarray(c))
-    assert packed.dtype == jnp.uint8
-    assert packed.shape[0] == c.shape[0] // 8
+    assert packed.dtype == jnp.uint32
+    # 32 sign bits per uint32 word, rows of 128 lanes
+    assert packed.shape == (c.shape[0] // (32 * 128), 128)
     signs = np.asarray(compress.unpack_signs(packed))
     np.testing.assert_array_equal(signs, np.where(c >= 0, 1.0, -1.0))
+
+
+def test_pack_pallas_matches_jnp_oracle():
+    """The Pallas kernel pair (interpret mode here — compiled on TPU) and the
+    jnp oracle must produce bit-identical wire buffers."""
+    r = np.random.RandomState(13)
+    c = jnp.asarray(r.randn(2 * compress.PACK_ALIGN).astype(np.float32))
+    packed_pl = compress._pack_pallas(
+        c.reshape(-1, compress.LANES), interpret=True)
+    packed_jnp = compress.pack_signs_jnp(c)
+    np.testing.assert_array_equal(np.asarray(packed_pl),
+                                  np.asarray(packed_jnp))
+
+
+def test_unpack_weighted_sum_pallas_matches_jnp_oracle():
+    r = np.random.RandomState(14)
+    c = r.randn(4, compress.PACK_ALIGN).astype(np.float32)
+    scales = jnp.asarray(np.abs(r.randn(4)).astype(np.float32) + 0.1)
+    packed = jnp.stack([compress.pack_signs_jnp(jnp.asarray(ci)) for ci in c])
+    got = compress._unpack_wsum_pallas(packed, scales, interpret=True)
+    expect = compress.unpack_signs_weighted_sum_jnp(packed, scales)
+    np.testing.assert_allclose(np.asarray(got).reshape(-1),
+                               np.asarray(expect), rtol=1e-6, atol=1e-6)
 
 
 def test_unpack_weighted_sum_oracle():

@@ -4,59 +4,213 @@ TPU-native successor to the reference's in-repo native code: Theano-MPI's
 ``Exch_asa16``/``Exch_copper16`` compiled inline fp32↔fp16 CUDA kernels at
 runtime via ``pycuda.compiler.SourceModule`` to halve wire bandwidth
 (SURVEY.md §2.9, items N1/N2).  Here the compression is more aggressive —
-1 bit per element.  This module currently ships the portable jnp
-implementation (used on CPU tests and as the reference oracle); the Pallas
-TPU kernel pair (pack / unpack-accumulate) is the planned hot path and will
-slot in behind the same two functions.
+1 bit per element — and the kernels are **Pallas TPU kernels** (the TPU-native
+kernel language), with a pure-jnp implementation in the identical bit layout
+kept as the numerical oracle and as the dispatch target on non-TPU backends
+(and under ``THEANOMPI_TPU_NO_PALLAS=1``).  The kernel unit tests run the
+Pallas pair in interpret mode against the oracle bit-for-bit.
+
+Wire format (internal contract between :func:`pack_signs` and the unpackers —
+chosen for TPU tiling, NOT byte-compatible with anything external):
+
+* the fp32 input vector ``c`` of length ``n`` (``n % PACK_ALIGN == 0``) is
+  viewed as blocks of 256 sublanes × 128 lanes;
+* within a block, packed word ``[r, l]`` (r∈[0,8)) collects bit ``b`` from
+  input row ``8b + r`` — so every bit plane is a contiguous (8, 128) fp32
+  tile and every output tile is a full (8, 128) uint32 tile.  No intra-lane
+  shuffles anywhere.
+* packed shape: ``[n // 4096, 128]`` uint32 = n/8 bytes on the wire (32×
+  smaller than fp32).
 
 Layout contract: input length must be a multiple of :data:`PACK_ALIGN`
-(= 1024 = 8 bits × 128 lanes) so both the packed and unpacked views tile
-cleanly onto the VPU's (8, 128) registers.
+(= 32768 = 256 sublanes × 128 lanes) so every Pallas grid block is full.
 """
 
 from __future__ import annotations
 
 import functools
+import os
 
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
 
-# 8 bits/byte × 128 lanes: keeps packed rows lane-aligned on TPU.
-PACK_ALIGN = 1024
+# 256 fp32 sublanes × 128 lanes per grid block: packs to one (8, 128) uint32
+# tile, keeping both sides of the kernel exactly tile-aligned.
+BLOCK_ROWS = 256
+LANES = 128
+PACK_ALIGN = BLOCK_ROWS * LANES          # 32768 elements per grid block
+_WORDS_PER_BLOCK = 8                     # uint32 rows produced per block
 
-_POWERS = 2 ** np.arange(8, dtype=np.uint8)  # LSB-first bit order
 
+def _check_len(n: int) -> None:
+    assert n % PACK_ALIGN == 0, (
+        f"compressed exchange needs length % {PACK_ALIGN} == 0, got {n} "
+        "(flatten_tree(pad_to_multiple_of=PACK_ALIGN) upstream)")
+
+
+def _dispatch_pallas() -> bool:
+    """Compiled Pallas on TPU; elsewhere the jnp oracle (same bit layout,
+    equality-tested) — interpret-mode Pallas can't run inside shard_map's
+    vma-checked trace, so it is reserved for the direct kernel tests."""
+    if os.environ.get("THEANOMPI_TPU_NO_PALLAS", "0") == "1":
+        return False
+    return jax.default_backend() == "tpu"
+
+
+def _vma_of(*xs) -> frozenset:
+    """Union of the operands' varying-manual-axes, so pallas_call outputs
+    carry the right vma when traced inside ``shard_map(check_vma=True)``."""
+    vma: frozenset = frozenset()
+    for x in xs:
+        vma = vma | getattr(jax.typeof(x), "vma", frozenset())
+    return vma
+
+
+# ---------------------------------------------------------------------------
+# jnp reference implementations (oracle + fallback)
+# ---------------------------------------------------------------------------
+
+def pack_signs_jnp(c: jnp.ndarray) -> jnp.ndarray:
+    """Oracle: f32 [n] → uint32 [n//4096, 128] in the wire layout above."""
+    n = c.shape[0]
+    _check_len(n)
+    nb = n // PACK_ALIGN
+    bits = (c >= 0).astype(jnp.uint32).reshape(nb, 32, _WORDS_PER_BLOCK, LANES)
+    shifts = jnp.arange(32, dtype=jnp.uint32).reshape(1, 32, 1, 1)
+    # Bit positions are disjoint across the reduced axis, so sum == OR.
+    words = jnp.sum(bits << shifts, axis=1, dtype=jnp.uint32)
+    return words.reshape(nb * _WORDS_PER_BLOCK, LANES)
+
+
+def unpack_signs_jnp(packed: jnp.ndarray) -> jnp.ndarray:
+    """Oracle inverse: uint32 [m, 128] → f32 [32·m·128] of ±1."""
+    m = packed.shape[0]
+    nb = m // _WORDS_PER_BLOCK
+    p = packed.reshape(nb, 1, _WORDS_PER_BLOCK, LANES)
+    shifts = jnp.arange(32, dtype=jnp.uint32).reshape(1, 32, 1, 1)
+    bits = (p >> shifts) & jnp.uint32(1)
+    return (bits.astype(jnp.float32) * 2.0 - 1.0).reshape(-1)
+
+
+def unpack_signs_weighted_sum_jnp(all_packed: jnp.ndarray,
+                                  scales: jnp.ndarray) -> jnp.ndarray:
+    """Oracle: decode [w, m, 128] packed buffers → Σ_w scales[w]·signs[w]."""
+    w = all_packed.shape[0]
+    decoded = jax.vmap(unpack_signs_jnp)(all_packed)       # [w, n]
+    return jnp.sum(decoded * scales.reshape(w, 1), axis=0)
+
+
+# ---------------------------------------------------------------------------
+# Pallas kernels
+# ---------------------------------------------------------------------------
+
+def _pack_kernel(x_ref, out_ref):
+    """(256, 128) f32 block → (8, 128) uint32 block.
+
+    Bit plane b is the contiguous fp32 tile rows [8b, 8b+8); planes are OR'd
+    together after shifting — pure VPU work on full (8, 128) registers.
+    """
+    word = jnp.zeros((_WORDS_PER_BLOCK, LANES), jnp.uint32)
+    for b in range(32):
+        plane = x_ref[8 * b:8 * (b + 1), :]
+        word = word | ((plane >= 0).astype(jnp.uint32) << np.uint32(b))
+    out_ref[:] = word
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def _pack_pallas(x2d: jnp.ndarray, interpret: bool) -> jnp.ndarray:
+    nb = x2d.shape[0] // BLOCK_ROWS
+    return pl.pallas_call(
+        _pack_kernel,
+        grid=(nb,),
+        in_specs=[pl.BlockSpec((BLOCK_ROWS, LANES), lambda j: (j, 0),
+                               memory_space=pltpu.VMEM)],
+        out_specs=pl.BlockSpec((_WORDS_PER_BLOCK, LANES), lambda j: (j, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((nb * _WORDS_PER_BLOCK, LANES),
+                                       jnp.uint32, vma=_vma_of(x2d)),
+        interpret=interpret,
+    )(x2d)
+
+
+def _make_unpack_wsum_kernel(n_workers: int):
+    def kernel(packed_ref, scales_ref, out_ref):
+        """packed (W, 8, 128) u32 + scales (W,) → (256, 128) f32 of
+        Σ_w scale_w · sign_w  (decode fused with the weighted accumulate, so
+        the fp32 expansion never round-trips through HBM)."""
+        total = jnp.float32(0.0)
+        for w in range(n_workers):
+            total = total + scales_ref[w]
+        for b in range(32):
+            acc = jnp.zeros((_WORDS_PER_BLOCK, LANES), jnp.float32)
+            for w in range(n_workers):
+                bits = (packed_ref[w] >> np.uint32(b)) & np.uint32(1)
+                acc = acc + bits.astype(jnp.float32) * (2.0 * scales_ref[w])
+            # Σ scale·(2·bit − 1) = Σ 2·scale·bit − Σ scale
+            out_ref[8 * b:8 * (b + 1), :] = acc - total
+    return kernel
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def _unpack_wsum_pallas(all_packed: jnp.ndarray, scales: jnp.ndarray,
+                        interpret: bool) -> jnp.ndarray:
+    w, m, _ = all_packed.shape
+    nb = m // _WORDS_PER_BLOCK
+    kernel = _make_unpack_wsum_kernel(w)
+    return pl.pallas_call(
+        kernel,
+        grid=(nb,),
+        in_specs=[
+            pl.BlockSpec((w, _WORDS_PER_BLOCK, LANES), lambda j: (0, j, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+        ],
+        out_specs=pl.BlockSpec((BLOCK_ROWS, LANES), lambda j: (j, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((nb * BLOCK_ROWS, LANES), jnp.float32,
+                                       vma=_vma_of(all_packed, scales)),
+        interpret=interpret,
+    )(all_packed, scales)
+
+
+# ---------------------------------------------------------------------------
+# Public API (dispatching)
+# ---------------------------------------------------------------------------
 
 def pack_signs(c: jnp.ndarray) -> jnp.ndarray:
-    """Pack sign bits of ``c`` (>=0 → 1, <0 → 0) into a uint8 vector, 8/byte.
+    """Pack sign bits of ``c`` (>=0 → 1, <0 → 0), 32 per uint32 word.
 
-    ``c`` must be 1-D with length % PACK_ALIGN == 0.  Returns [len(c)//8]
-    uint8.
+    ``c`` must be 1-D with length % PACK_ALIGN == 0.  Returns
+    ``[len(c)//4096, 128]`` uint32 (= len(c)/8 bytes on the wire).
     """
     n = c.shape[0]
-    assert n % PACK_ALIGN == 0, f"pack_signs needs length % {PACK_ALIGN}, got {n}"
-    bits = (c >= 0).astype(jnp.uint8).reshape(n // 8, 8)
-    return (bits * _POWERS).sum(axis=1).astype(jnp.uint8)
+    _check_len(n)
+    if not _dispatch_pallas():
+        return pack_signs_jnp(c)
+    return _pack_pallas(c.reshape(n // LANES, LANES), False)
 
 
 def unpack_signs(packed: jnp.ndarray) -> jnp.ndarray:
-    """Inverse of :func:`pack_signs`: uint8 [m] → float32 [8m] of ±1."""
-    bits = (packed[:, None] >> jnp.arange(8, dtype=jnp.uint8)) & 1
-    return (bits.astype(jnp.float32) * 2.0 - 1.0).reshape(-1)
+    """Inverse of :func:`pack_signs`: uint32 [m, 128] → f32 [32·m·128] of ±1."""
+    if not _dispatch_pallas():
+        return unpack_signs_jnp(packed)
+    one = jnp.ones((1,), jnp.float32)
+    return _unpack_wsum_pallas(packed[None], one, False).reshape(-1)
 
 
 def unpack_signs_weighted_sum(all_packed: jnp.ndarray,
                               scales: jnp.ndarray) -> jnp.ndarray:
-    """Decode ``[n_workers, m]`` packed sign buffers and return
-    ``sum_w scales[w] * signs[w]`` as float32 ``[8m]``.
+    """Decode ``[n_workers, m, 128]`` packed sign buffers and return
+    ``sum_w scales[w] * signs[w]`` as float32 ``[32·m·128]``.
 
     This is the decode+accumulate half of the compressed allreduce: each
     worker runs it locally after the all-gather of packed bits, so only bits
     ever cross ICI.
     """
-    n_workers, m = all_packed.shape
-    bits = (all_packed[:, :, None] >> jnp.arange(8, dtype=jnp.uint8)) & 1
-    signs = bits.astype(jnp.float32) * 2.0 - 1.0          # [w, m, 8]
-    weighted = signs * scales[:, None, None]
-    return weighted.sum(axis=0).reshape(-1)
+    if not _dispatch_pallas():
+        return unpack_signs_weighted_sum_jnp(all_packed, scales)
+    return _unpack_wsum_pallas(
+        all_packed, scales.astype(jnp.float32), False).reshape(-1)

@@ -187,9 +187,9 @@ class OneBit(Strategy):
             tree, pad_to_multiple_of=compress_ops.PACK_ALIGN)
         c = flat + state
         scale = jnp.mean(jnp.abs(c)) + 1e-12
-        packed = compress_ops.pack_signs(c)           # uint8, P/8 bytes
+        packed = compress_ops.pack_signs(c)           # uint32 [P/4096, 128]
         new_state = c - scale * jnp.sign(jnp.where(c == 0, 1.0, c))
-        all_packed = lax.all_gather(packed, axis)      # [size, P/8] on the wire
+        all_packed = lax.all_gather(packed, axis)      # P/8 bytes/worker on the wire
         all_scales = lax.all_gather(scale, axis)       # [size]
         signs_sum = compress_ops.unpack_signs_weighted_sum(all_packed, all_scales)
         mean = signs_sum / size
