@@ -147,6 +147,41 @@ def test_prefetch_loader_surfaces_errors():
         w.next_train_batch(1)
 
 
+def test_hkl_batch_files_read_via_h5py(tmp_path):
+    """Reference data prep produces hickle .hkl files (HDF5 inside,
+    SURVEY.md §2.8); they must load without hickle installed."""
+    h5py = pytest.importorskip("h5py")
+    from theanompi_tpu.models.data.imagenet import (ImageNet_data,
+                                                    _load_batch_file)
+
+    rng = np.random.RandomState(7)
+    batch = rng.randint(0, 256, (4, 3, 256, 256), dtype=np.uint8)  # bc01
+    p = str(tmp_path / "0000.hkl")
+    with h5py.File(p, "w") as f:      # hickle v2/v3 layout: root 'data'
+        f.create_dataset("data", data=batch)
+    np.testing.assert_array_equal(_load_batch_file(p), batch)
+
+    # and a full ImageNet_data epoch over a tiny .hkl-backed data dir
+    d = tmp_path / "imagenet"
+    for sub in ("train_hkl", "val_hkl"):
+        (d / sub).mkdir(parents=True)
+        for i in range(2):
+            with h5py.File(str(d / sub / f"{i:04d}.hkl"), "w") as f:
+                f.create_dataset("data", data=batch)
+    np.save(str(d / "train_labels.npy"), np.arange(8) % 4)
+    np.save(str(d / "val_labels.npy"), np.arange(8) % 4)
+    np.save(str(d / "img_mean.npy"),
+            np.zeros((3, 256, 256), np.float32))
+    data = ImageNet_data({"size": 1, "data_dir": str(d)}, batch_size=4)
+    assert not data.synthetic
+    data.shuffle_data(0)
+    b = data.next_train_batch(0)
+    assert b["x"].shape == (4, 227, 227, 3)
+    assert b["x"].dtype == np.float32
+    v = data.next_val_batch(0)
+    assert v["y"].shape == (4,)
+
+
 # -- recorder ---------------------------------------------------------------
 
 def test_recorder_accounting(tmp_path):

@@ -27,10 +27,47 @@ CROP = 227      # AlexNet crop (VGG uses 224; configurable)
 N_CLASS = 1000
 
 
+def _load_hkl_h5py(path: str) -> np.ndarray:
+    """hickle ``.hkl`` files ARE HDF5 files: read the payload with h5py
+    directly (hickle itself is not in this environment).  All hickle versions
+    store the array as an HDF5 dataset — commonly named ``data`` or
+    ``data_0`` at the root (v1–v3, the era of the reference's files) or
+    nested under a group (v4+); take the first dataset found."""
+    import h5py
+
+    with h5py.File(path, "r") as f:
+        for name in ("data", "data_0"):
+            if name in f and isinstance(f[name], h5py.Dataset):
+                return np.asarray(f[name])
+        found = []
+
+        def visit(name, obj):
+            if isinstance(obj, h5py.Dataset):
+                found.append((obj.size, name))
+
+        f.visititems(visit)
+        if not found:
+            raise ValueError(f"{path}: no dataset inside the HDF5/.hkl file")
+        # v4+ nests the payload among small metadata datasets — the image
+        # batch is by far the largest one.
+        return np.asarray(f[max(found)[1]])
+
+
 def _load_batch_file(path: str) -> np.ndarray:
     if path.endswith(".hkl"):
-        import hickle  # optional dep, as in the reference
-        return np.asarray(hickle.load(path))
+        try:
+            import hickle  # optional dep, as in the reference
+            return np.asarray(hickle.load(path))
+        except ImportError:
+            return _load_hkl_h5py(path)
+        except Exception as hickle_err:
+            # File is HDF5 but not hickle-shaped (plain h5py-written batch
+            # files). If the h5py reader can't make sense of it either,
+            # surface the ORIGINAL hickle error, not the fallback's.
+            try:
+                return _load_hkl_h5py(path)
+            except Exception:
+                raise hickle_err
     if path.endswith(".npz"):
         with np.load(path) as z:
             return z[list(z.files)[0]]

@@ -1,0 +1,225 @@
+"""GAN family — WGAN and LSGAN.
+
+Reference: ``theanompi/models/wgan.py`` / ``lsgan.py`` (SURVEY.md §2.7) —
+added late upstream, each a two-function (G/D) training loop driven by the
+same worker contract as the CNN zoo.
+
+TPU-first re-design: instead of two separately compiled Theano functions
+called in alternation from Python, the G and D updates live in ONE compiled
+SPMD step over the combined ``{"G": ..., "D": ...}`` parameter pytree, using
+``stop_gradient`` to decouple the two objectives:
+
+* the critic loss sees generated images through ``stop_gradient`` (no grads
+  into G),
+* the generator loss sees the critic through ``stop_gradient``-ed critic
+  params (no grads into D),
+
+so one ``value_and_grad`` yields both gradient sets at the current params
+(simultaneous-SGD GAN training).  The reference's "train D for ``n_critic``
+iterations per G iteration" cadence is preserved by the
+:meth:`postprocess_update` hook, which on gated steps keeps G's OLD params
+and optimizer state (equivalent to the reference not calling the G update
+function at all — merely zeroing G's gradient would still let a stateful
+optimizer's momentum/weight-decay move G).  Traced ``jnp.where`` selection,
+so the step stays one static XLA program.  WGAN's weight clipping rides the
+same hook.
+
+Because the combined params are an ordinary pytree, all four exchange rules
+(BSP/EASGD/ASGD/GoSGD) and every wire strategy work on GANs unchanged.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from . import layers as L
+from .data.cifar10 import Cifar10_data
+from .model_base import ModelBase
+
+
+def _generator(z_dim: int, base: int, cd) -> L.Sequential:
+    """DCGAN-style: z → 4×4×(4·base) → 8×8 → 16×16 → 32×32×3 tanh."""
+    return L.Sequential([
+        L.FC(z_dim, 4 * 4 * base * 4, w_init=("normal", 0.02),
+             activation=None, compute_dtype=cd, name="proj"),
+        L.Reshape((4, 4, base * 4), name="reshape"),
+        L.BatchNorm(base * 4, name="bn0"),
+        L.Activation("relu", name="relu0"),
+        L.ConvTranspose(base * 4, base * 2, 5, stride=2, activation=None,
+                        compute_dtype=cd, name="up1"),
+        L.BatchNorm(base * 2, name="bn1"),
+        L.Activation("relu", name="relu1"),
+        L.ConvTranspose(base * 2, base, 5, stride=2, activation=None,
+                        compute_dtype=cd, name="up2"),
+        L.BatchNorm(base, name="bn2"),
+        L.Activation("relu", name="relu2"),
+        L.ConvTranspose(base, 3, 5, stride=2, activation="tanh",
+                        compute_dtype=cd, name="up3"),
+    ])
+
+
+def _critic(base: int, cd) -> L.Sequential:
+    """Strided-conv critic, LeakyReLU, no norm layers (weight-clipped WGAN
+    critics and plain LSGAN discriminators both work unnormalized here, and
+    keeping D stateless means its double application — real then fake —
+    threads no BN state)."""
+    return L.Sequential([
+        L.Conv(3, base, 5, stride=2, padding="SAME", w_init=("normal", 0.02),
+               activation="leaky_relu", compute_dtype=cd, name="c1"),
+        L.Conv(base, base * 2, 5, stride=2, padding="SAME",
+               w_init=("normal", 0.02), activation="leaky_relu",
+               compute_dtype=cd, name="c2"),
+        L.Conv(base * 2, base * 4, 5, stride=2, padding="SAME",
+               w_init=("normal", 0.02), activation="leaky_relu",
+               compute_dtype=cd, name="c3"),
+        L.Flatten(),
+        L.FC(4 * 4 * base * 4, 1, w_init=("normal", 0.02), activation=None,
+             compute_dtype=cd, name="score"),
+    ])
+
+
+class GAN_ModelBase(ModelBase):
+    """Shared G/D machinery; subclasses define the two losses."""
+
+    batch_size = 64
+    epochs = 50
+    n_subb = 1
+    learning_rate = 5e-5
+    weight_decay = 0.0
+    optimizer = "rmsprop"
+    z_dim = 128
+    base_width = 64
+    n_critic = 5          # D steps per G step (WGAN paper's cadence)
+
+    def build_model(self) -> None:
+        cd = self.config.get("compute_dtype", jnp.bfloat16)
+        self.z_dim = int(self.config.get("z_dim", self.z_dim))
+        self.n_critic = int(self.config.get("n_critic", self.n_critic))
+        base = int(self.config.get("base_width", self.base_width))
+        self.G = _generator(self.z_dim, base, cd)
+        self.D = _critic(base, cd)
+        self.data = Cifar10_data(self.config, self.batch_size)
+
+    # combined pytree: one params/state tree drives the whole step machinery
+    def init_params(self, key):
+        kg, kd = jax.random.split(key)
+        return {"G": self.G.init(kg), "D": self.D.init(kd)}
+
+    def init_bn_state(self):
+        return {"G": self.G.init_state()}
+
+    def generate(self, params, z, *, train=False, rng=None, bn_state=None):
+        """Sample images from the generator (returns (images, new_G_bn))."""
+        g_state = bn_state["G"] if bn_state else self.G.init_state()
+        return self.G.apply(params["G"], z, train=train, rng=rng,
+                            state=g_state)
+
+    # -- subclass hooks: the two objectives ---------------------------------
+
+    def d_loss(self, score_real, score_fake):
+        raise NotImplementedError
+
+    def g_loss(self, score_fake):
+        raise NotImplementedError
+
+    # -- the combined objective (see module docstring) ----------------------
+
+    def loss_and_metrics(self, params, bn_state, batch, rng, train):
+        rng_z, rng_g, rng_d = jax.random.split(rng, 3)
+        x_real = batch["x"]
+        n = x_real.shape[0]
+        z = jax.random.normal(rng_z, (n, self.z_dim))
+        fake, g_bn = self.G.apply(params["G"], z, train=train, rng=rng_g,
+                                  state=bn_state["G"])
+        fake = fake.astype(jnp.float32)
+
+        # critic objective: no grads into G.  D is stateless (no norm
+        # layers), so real and detached-fake share ONE critic pass.
+        both = jnp.concatenate([x_real, jax.lax.stop_gradient(fake)], axis=0)
+        scores = self.D.apply(params["D"], both, train=train,
+                              rng=rng_d)[0].astype(jnp.float32)
+        s_real, s_fake_d = scores[:n], scores[n:]
+        d_cost = self.d_loss(s_real, s_fake_d)
+
+        # generator objective: through a frozen critic
+        d_frozen = jax.lax.stop_gradient(params["D"])
+        s_fake_g = self.D.apply(d_frozen, fake, train=train,
+                                rng=rng_d)[0].astype(jnp.float32)
+        g_cost = self.g_loss(s_fake_g)
+
+        # The differentiated value must be the SUM (each term owns one
+        # gradient path).  Reported columns: cost = D+G combined, error =
+        # G loss — so the critic loss is (cost − error); the reference's
+        # GAN scripts printed both losses separately.
+        return d_cost + g_cost, (g_cost, {"G": g_bn})
+
+    def val_metrics(self, params, bn_state, batch):
+        rng = jax.random.key(0)
+        cost, (g_cost, _) = self.loss_and_metrics(params, bn_state, batch,
+                                                  rng, False)
+        return cost, (g_cost, g_cost)
+
+    # -- cadence + projection hooks -----------------------------------------
+
+    def postprocess_update(self, old_params, old_opt, new_params, new_opt,
+                           count):
+        """Off the critic cadence, keep G's old params AND optimizer state —
+        as if the G update function was never called (the reference
+        alternated two compiled functions).  ``opt_state`` may nest the
+        G/D split anywhere (momentum mirrors params; adam wraps it in
+        m/v/t), so gating selects any subtree under a ``"G"`` key."""
+        if self.n_critic <= 1:
+            return new_params, new_opt
+        g_on = count % self.n_critic == 0
+
+        def gate(new, old):
+            def pick(path, n_leaf, o_leaf):
+                in_g = any(getattr(k, "key", None) == "G" for k in path)
+                return jnp.where(g_on, n_leaf, o_leaf) if in_g else n_leaf
+            return jax.tree_util.tree_map_with_path(pick, new, old)
+
+        return gate(new_params, old_params), gate(new_opt, old_opt)
+
+
+class WGAN(GAN_ModelBase):
+    """Wasserstein GAN with weight clipping (Arjovsky et al. 2017), the
+    algorithm of the reference's ``wgan.py``."""
+
+    clip = 0.01
+
+    def build_model(self) -> None:
+        super().build_model()
+        self.clip = float(self.config.get("clip", self.clip))
+
+    def d_loss(self, s_real, s_fake):
+        # critic maximizes E[s_real] − E[s_fake]
+        return jnp.mean(s_fake) - jnp.mean(s_real)
+
+    def g_loss(self, s_fake):
+        return -jnp.mean(s_fake)
+
+    def postprocess_update(self, old_params, old_opt, new_params, new_opt,
+                           count):
+        new_params, new_opt = super().postprocess_update(
+            old_params, old_opt, new_params, new_opt, count)
+        c = self.clip
+        new_params = {"G": new_params["G"],
+                      "D": jax.tree.map(lambda p: jnp.clip(p, -c, c),
+                                        new_params["D"])}
+        return new_params, new_opt
+
+
+class LSGAN(GAN_ModelBase):
+    """Least-squares GAN (Mao et al. 2017), the algorithm of the reference's
+    ``lsgan.py`` — a=0, b=1, c=1 coding."""
+
+    learning_rate = 2e-4
+    optimizer = "adam"
+    n_critic = 1
+
+    def d_loss(self, s_real, s_fake):
+        return 0.5 * (jnp.mean((s_real - 1.0) ** 2) + jnp.mean(s_fake ** 2))
+
+    def g_loss(self, s_fake):
+        return 0.5 * jnp.mean((s_fake - 1.0) ** 2)

@@ -202,6 +202,45 @@ class Conv(Layer):
         return _activate(y, self.activation)
 
 
+class ConvTranspose(Layer):
+    """Transposed (fractionally-strided) convolution — the DCGAN-style
+    generator upsampler used by the reference's GAN models
+    (``theanompi/models/wgan.py`` / ``lsgan.py``, SURVEY.md §2.7).  Lowered
+    via ``lax.conv_transpose`` onto the MXU."""
+
+    def __init__(self, in_ch: int, out_ch: int, kernel: Union[int, Tuple[int, int]],
+                 stride: Union[int, Tuple[int, int]] = 2,
+                 padding: str = "SAME",
+                 w_init=("normal", 0.02), b_init=("constant", 0.0),
+                 activation: Optional[str] = "relu",
+                 compute_dtype=jnp.bfloat16, name: str = "deconv"):
+        self.in_ch, self.out_ch = in_ch, out_ch
+        self.kernel = (kernel, kernel) if isinstance(kernel, int) else tuple(kernel)
+        self.stride = (stride, stride) if isinstance(stride, int) else tuple(stride)
+        self.padding = padding
+        self.w_init, self.b_init = w_init, b_init
+        self.activation = activation
+        self.compute_dtype = compute_dtype
+        self.name = name
+
+    def init(self, key):
+        kh, kw = self.kernel
+        kw_key, b_key = jax.random.split(key)
+        w = init_weight(kw_key, (kh, kw, self.in_ch, self.out_ch), self.w_init)
+        b = init_weight(b_key, (self.out_ch,), self.b_init)
+        return {"w": w, "b": b}
+
+    def apply(self, params, x, *, train=False, rng=None, state=None):
+        cd = self.compute_dtype
+        y = jax.lax.conv_transpose(
+            x.astype(cd), params["w"].astype(cd),
+            strides=self.stride, padding=self.padding,
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        )
+        y = y + params["b"].astype(cd)
+        return _activate(y, self.activation)
+
+
 class FC(Layer):
     """Fully connected layer (reference: layers2.FC / Softmax head matmul)."""
 
@@ -335,6 +374,17 @@ class Flatten(Layer):
 
     def apply(self, params, x, *, train=False, rng=None, state=None):
         return x.reshape((x.shape[0], -1))
+
+
+class Reshape(Layer):
+    """Reshape trailing dims to ``shape`` (batch dim preserved)."""
+
+    def __init__(self, shape: Tuple[int, ...], name: str = "reshape"):
+        self.shape = tuple(shape)
+        self.name = name
+
+    def apply(self, params, x, *, train=False, rng=None, state=None):
+        return x.reshape((x.shape[0],) + self.shape)
 
 
 class Activation(Layer):

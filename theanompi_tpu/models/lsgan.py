@@ -1,0 +1,6 @@
+"""LSGAN — reference-path alias module (``theanompi/models/lsgan.py``,
+SURVEY.md §2.7).  Implementation in :mod:`theanompi_tpu.models.gan`."""
+
+from .gan import LSGAN
+
+__all__ = ["LSGAN"]

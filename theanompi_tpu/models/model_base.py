@@ -115,6 +115,18 @@ class ModelBase:
         err = L.errors(logits, batch["y"])
         return cost, (err, new_bn)
 
+    def postprocess_grads(self, grads, count):
+        """Traced hook before the exchange: transform gradients."""
+        return grads
+
+    def postprocess_update(self, old_params, old_opt, new_params, new_opt,
+                           count):
+        """Traced hook after the optimizer step: gate or project the update.
+        GAN models freeze the generator (params AND optimizer state) off the
+        critic cadence; WGAN clips critic weights.  Must return
+        ``(params, opt_state)``."""
+        return new_params, new_opt
+
     def val_metrics(self, params, bn_state, batch):
         logits, _ = self.apply_model(params, batch["x"], train=False,
                                      rng=None, state=bn_state)

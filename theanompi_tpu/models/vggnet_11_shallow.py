@@ -1,0 +1,13 @@
+"""VGG-11 "shallow" — reference-path alias module.
+
+Reference: ``theanompi/models/vggnet_11_shallow.py`` (SURVEY.md §2.7).  The
+model itself lives in :mod:`theanompi_tpu.models.vggnet_16` (the two VGG
+configurations share the stack builder); this module preserves the
+reference's import path so dotted-path configs
+(``theanompi_tpu.models.vggnet_11_shallow:VGGNet_11_shallow``) run
+unmodified.
+"""
+
+from .vggnet_16 import VGGNet_11_shallow
+
+__all__ = ["VGGNet_11_shallow"]

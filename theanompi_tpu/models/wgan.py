@@ -1,0 +1,6 @@
+"""WGAN — reference-path alias module (``theanompi/models/wgan.py``,
+SURVEY.md §2.7).  Implementation in :mod:`theanompi_tpu.models.gan`."""
+
+from .gan import WGAN
+
+__all__ = ["WGAN"]

@@ -137,8 +137,19 @@ def build_train_step(mesh: Mesh, model, exchanger) -> Callable:
         cost, err, grads, new_bn = _accumulate_grads(
             model.loss_and_metrics, params, bn_state, batch, local_rng, n_subb)
 
-        params, opt_state, extra = exchanger.step_update(
+        # Model hooks (traced, optional — models outside ModelBase need not
+        # define them): grad transform before the exchange, update gating /
+        # param projection after it (GAN n_critic cadence, WGAN clipping).
+        pg = getattr(model, "postprocess_grads", None)
+        if pg is not None:
+            grads = pg(grads, count)
+        new_params, new_opt, extra = exchanger.step_update(
             params, opt_state, grads, extra, lr, axis=axis, size=n, count=count)
+        pu = getattr(model, "postprocess_update", None)
+        if pu is not None:
+            new_params, new_opt = pu(params, opt_state, new_params, new_opt,
+                                     count)
+        params, opt_state = new_params, new_opt
         new_bn = exchanger.sync_bn(new_bn, axis=axis, size=n)
 
         new_state = {

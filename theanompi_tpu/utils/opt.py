@@ -84,10 +84,59 @@ def nesterov(mu: float = 0.9, weight_decay: float = 0.0001) -> OptPair:
     return OptPair(init, update)
 
 
+def rmsprop(decay: float = 0.9, eps: float = 1e-8,
+            weight_decay: float = 0.0) -> OptPair:
+    """RMSprop — the WGAN paper's optimizer of choice (the reference's GAN
+    models trained G/D with RMSprop, per-parameter adaptive scaling)."""
+
+    def init(params):
+        return _zeros_like_tree(params)
+
+    def update(grads, sq_avg, params, lr):
+        new_sq = jax.tree.map(
+            lambda s, g: decay * s + (1 - decay) * g * g, sq_avg, grads)
+        # weight decay is decoupled (outside the adaptive division), matching
+        # adam below — so the config key means the same thing across
+        # optimizers and doesn't vanish where gradient history is large.
+        new_params = jax.tree.map(
+            lambda p, g, s: p - lr * (g / (jnp.sqrt(s) + eps)
+                                      + weight_decay * p),
+            params, grads, new_sq)
+        return new_params, new_sq
+
+    return OptPair(init, update)
+
+
+def adam(b1: float = 0.9, b2: float = 0.999, eps: float = 1e-8,
+         weight_decay: float = 0.0) -> OptPair:
+    """Adam with bias correction (LSGAN-style training)."""
+
+    def init(params):
+        return {"m": _zeros_like_tree(params), "v": _zeros_like_tree(params),
+                "t": jnp.zeros((), jnp.int32)}
+
+    def update(grads, st, params, lr):
+        t = st["t"] + 1
+        m = jax.tree.map(lambda m_, g: b1 * m_ + (1 - b1) * g, st["m"], grads)
+        v = jax.tree.map(lambda v_, g: b2 * v_ + (1 - b2) * g * g, st["v"], grads)
+        tf = t.astype(jnp.float32)
+        bc1 = 1 - b1 ** tf
+        bc2 = 1 - b2 ** tf
+        new_params = jax.tree.map(
+            lambda p, m_, v_: p - lr * ((m_ / bc1) / (jnp.sqrt(v_ / bc2) + eps)
+                                        + weight_decay * p),
+            params, m, v)
+        return new_params, {"m": m, "v": v, "t": t}
+
+    return OptPair(init, update)
+
+
 OPTIMIZERS = {
     "sgd": sgd,
     "momentum": momentum,
     "nesterov": nesterov,
+    "rmsprop": rmsprop,
+    "adam": adam,
 }
 
 
