@@ -101,6 +101,15 @@ def test_n_critic_gate_holds_under_stateful_adam():
     p3 = g_side(steps.unbox(m.step_state["params"]))
     assert any((a != b).any() for (_, a), (_, b) in zip(p2, p3))
 
+    # Adam's bias-correction clock is per-leaf so the gate freezes it too:
+    # after 5 steps (counts 4..8) G updated twice (4, 8) while D updated on
+    # every step — "as if the G update function was never called" includes t.
+    opt = jax.device_get(steps.unbox(m.step_state["opt_state"]))
+    g_ts = {int(np.asarray(t)) for t in jax.tree.leaves(opt["t"]["G"])}
+    d_ts = {int(np.asarray(t)) for t in jax.tree.leaves(opt["t"]["D"])}
+    assert g_ts == {2}, g_ts
+    assert d_ts == {5}, d_ts
+
 
 def test_lsgan_loss_math():
     from theanompi_tpu.models.gan import LSGAN

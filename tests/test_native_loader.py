@@ -1,0 +1,72 @@
+"""Native C++ loader hot path vs the NumPy reference implementation.
+
+The native library (theanompi_tpu/native/loader.cc) must be bit-identical to
+the NumPy fallback for every supported mode: both compute
+``float32(uint8) - float32(mean)`` with no intermediate rounding, so exact
+equality is the correct assertion (not allclose).
+"""
+
+import numpy as np
+import pytest
+
+from theanompi_tpu import native
+
+
+def _params(rng, n, h, w, crop, per_image):
+    m = n if per_image else 1
+    oy = rng.randint(0, h - crop + 1, size=m).astype(np.int32)
+    ox = rng.randint(0, w - crop + 1, size=m).astype(np.int32)
+    flip = rng.randint(0, 2, size=m).astype(np.uint8)
+    return oy, ox, flip
+
+
+@pytest.mark.parametrize("per_image", [False, True])
+@pytest.mark.parametrize("layout", ["nhwc", "nchw"])
+@pytest.mark.parametrize("mean_kind", ["scalar", "image"])
+def test_native_matches_numpy(per_image, layout, mean_kind):
+    if not native.native_available():
+        pytest.skip("no native toolchain in this environment")
+    rng = np.random.RandomState(0)
+    n, h, w, c, crop = 7, 20, 24, 3, 13
+    x = rng.randint(0, 256, (n, h, w, c), dtype=np.uint8)
+    if layout == "nchw":
+        x = np.ascontiguousarray(x.transpose(0, 3, 1, 2))
+    oy, ox, flip = _params(rng, n, h, w, crop, per_image)
+    mean = (rng.randn(crop, crop, c).astype(np.float32) * 10
+            if mean_kind == "image" else None)
+    ms = 0.0 if mean_kind == "image" else 117.5
+
+    got = native.augment_batch(x, oy, ox, flip, crop, mean=mean,
+                               mean_scalar=ms)
+    want = native._augment_numpy(
+        x, np.broadcast_to(oy, (n,)), np.broadcast_to(ox, (n,)),
+        np.broadcast_to(flip, (n,)), crop, mean, ms)
+    assert got.shape == (n, crop, crop, c)
+    assert got.dtype == np.float32
+    np.testing.assert_array_equal(got, want)
+
+
+def test_single_thread_matches_multi():
+    if not native.native_available():
+        pytest.skip("no native toolchain in this environment")
+    rng = np.random.RandomState(1)
+    n, h, w, c, crop = 16, 32, 32, 3, 27
+    x = rng.randint(0, 256, (n, h, w, c), dtype=np.uint8)
+    oy, ox, flip = _params(rng, n, h, w, crop, True)
+    a = native.augment_batch(x, oy, ox, flip, crop, n_threads=1)
+    b = native.augment_batch(x, oy, ox, flip, crop, n_threads=8)
+    np.testing.assert_array_equal(a, b)
+
+
+def test_imagenet_data_uses_fused_pass():
+    """The ImageNet data object routes through augment_batch in both
+    synthetic and per-image modes and produces the contract shapes."""
+    from theanompi_tpu.models.data.imagenet import ImageNet_data
+
+    d = ImageNet_data({"size": 1, "synthetic_batches": 2, "n_class": 10,
+                       "aug_per_image": True}, batch_size=4)
+    b = d.next_train_batch(0)
+    assert b["x"].shape == (4, 227, 227, 3) and b["x"].dtype == np.float32
+    assert b["y"].shape == (4,) and b["y"].dtype == np.int32
+    v = d.next_val_batch(0)
+    assert v["x"].shape == (4, 227, 227, 3)

@@ -181,7 +181,8 @@ class ImageNet_data:
     @staticmethod
     def _to_nhwc(x: np.ndarray) -> np.ndarray:
         """Reference .hkl files are bc01 (N,C,H,W) or c01b; normalize."""
-        if x.ndim == 4 and x.shape[1] in (1, 3) and x.shape[-1] not in (1, 3):
+        from ... import native
+        if native.is_nchw(x):
             return np.ascontiguousarray(x.transpose(0, 2, 3, 1))
         if x.ndim == 4 and x.shape[0] in (1, 3):        # c01b legacy layout
             return np.ascontiguousarray(x.transpose(3, 1, 2, 0))
@@ -190,23 +191,48 @@ class ImageNet_data:
     def _augment(self, x: np.ndarray, y: np.ndarray,
                  train: bool) -> Dict[str, np.ndarray]:
         """Reference augmentation: random 256→crop window + horizontal
-        mirror at train time; center crop at val; mean subtraction."""
+        mirror at train time (one draw per batch, as the reference's
+        per-batch ``param_rand``); center crop at val; mean subtraction.
+        ``aug_per_image=True`` in config upgrades to independent per-image
+        draws.  The fused crop/mirror/mean/cast pass runs in the native C++
+        library when available (``theanompi_tpu.native``), NumPy otherwise.
+        """
+        from ... import native
         n, h, w = x.shape[0], x.shape[1], x.shape[2]
         c = self.crop
         if train:
-            oy = self.rng.randint(0, h - c + 1)
-            ox = self.rng.randint(0, w - c + 1)
-            flip = bool(self.rng.randint(2))
+            per_img = bool(self.config.get("aug_per_image", False))
+            m = n if per_img else 1
+            oy = self.rng.randint(0, h - c + 1, size=m).astype(np.int32)
+            ox = self.rng.randint(0, w - c + 1, size=m).astype(np.int32)
+            flip = self.rng.randint(0, 2, size=m).astype(np.uint8)
         else:
-            oy = (h - c) // 2
-            ox = (w - c) // 2
-            flip = False
-        out = x[:, oy:oy + c, ox:ox + c, :]
-        if flip:
-            out = out[:, :, ::-1, :]
-        mean = self.img_mean
-        if isinstance(mean, np.ndarray) and mean.ndim == 3:
-            mean = self._to_nhwc(mean[None])[0, oy:oy + c, ox:ox + c, :]
-        out = out.astype(np.float32) - mean
-        return {"x": np.ascontiguousarray(out, dtype=np.float32),
-                "y": np.ascontiguousarray(y, dtype=np.int32)}
+            oy = np.full(1, (h - c) // 2, np.int32)
+            ox = np.full(1, (w - c) // 2, np.int32)
+            flip = np.zeros(1, np.uint8)
+        mean, mean_scalar = None, 0.0
+        m_img = self.img_mean
+        if isinstance(m_img, np.ndarray) and m_img.size > 1:
+            if m_img.ndim == 3:
+                if oy.shape[0] == 1:
+                    full = self._to_nhwc(m_img[None])[0]
+                    mean = full[oy[0]:oy[0] + c, ox[0]:ox[0] + c, :]
+                else:
+                    # per-image windows: use the mean image's center crop for
+                    # all (window-exact per-image mean would defeat the fused
+                    # pass)
+                    cy, cx = (h - c) // 2, (w - c) // 2
+                    full = self._to_nhwc(m_img[None])[0]
+                    mean = full[cy:cy + c, cx:cx + c, :]
+            else:
+                # per-channel mean (shape (C,) or broadcastable): expand to
+                # the window shape the fused pass expects
+                n_chan = x.shape[-1]
+                mean = np.broadcast_to(
+                    np.asarray(m_img, np.float32).reshape(-1)[:n_chan],
+                    (c, c, n_chan))
+        else:
+            mean_scalar = float(m_img)
+        out = native.augment_batch(x, oy, ox, flip, c, mean=mean,
+                                   mean_scalar=mean_scalar)
+        return {"x": out, "y": np.ascontiguousarray(y, dtype=np.int32)}

@@ -112,20 +112,26 @@ def adam(b1: float = 0.9, b2: float = 0.999, eps: float = 1e-8,
     """Adam with bias correction (LSGAN-style training)."""
 
     def init(params):
+        # t mirrors the param tree (one counter per leaf) rather than being a
+        # single root scalar: consumers that gate optimizer-state subtrees by
+        # parameter path (the GAN n_critic cadence) must be able to freeze a
+        # sub-network's bias-correction clock along with its m/v.
         return {"m": _zeros_like_tree(params), "v": _zeros_like_tree(params),
-                "t": jnp.zeros((), jnp.int32)}
+                "t": jax.tree.map(lambda p: jnp.zeros((), jnp.int32), params)}
 
     def update(grads, st, params, lr):
-        t = st["t"] + 1
+        t = jax.tree.map(lambda t_: t_ + 1, st["t"])
         m = jax.tree.map(lambda m_, g: b1 * m_ + (1 - b1) * g, st["m"], grads)
         v = jax.tree.map(lambda v_, g: b2 * v_ + (1 - b2) * g * g, st["v"], grads)
-        tf = t.astype(jnp.float32)
-        bc1 = 1 - b1 ** tf
-        bc2 = 1 - b2 ** tf
-        new_params = jax.tree.map(
-            lambda p, m_, v_: p - lr * ((m_ / bc1) / (jnp.sqrt(v_ / bc2) + eps)
-                                        + weight_decay * p),
-            params, m, v)
+
+        def step(p, m_, v_, t_):
+            tf = t_.astype(jnp.float32)
+            bc1 = 1 - b1 ** tf
+            bc2 = 1 - b2 ** tf
+            return p - lr * ((m_ / bc1) / (jnp.sqrt(v_ / bc2) + eps)
+                             + weight_decay * p)
+
+        new_params = jax.tree.map(step, params, m, v, t)
         return new_params, {"m": m, "v": v, "t": t}
 
     return OptPair(init, update)
