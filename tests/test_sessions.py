@@ -1,0 +1,54 @@
+"""Session-API convergence smokes (SURVEY.md §4 item c + §2.6).
+
+The reference was exercised through user session scripts calling the 3-call
+rule API (``BSP().init(devices); rule.wait()``) on ``Cifar10_model`` for a
+few epochs.  These tests drive exactly that surface — launcher → worker loop
+→ model contract → exchanger — end-to-end on the simulated 8-device mesh,
+and assert the training cost actually falls (the reference only eyeballed
+curves)."""
+
+import numpy as np
+import pytest
+
+import theanompi_tpu as tmpi
+
+COMMON = dict(
+    modelfile="theanompi_tpu.models.cifar10",
+    modelclass="Cifar10_model",
+    epochs=2,
+    synthetic_train=192,
+    synthetic_val=64,
+    batch_size=8,
+    printFreq=1,
+    compute_dtype="float32",
+    learning_rate=0.005,
+    scale_lr=False,
+    verbose=False,
+)
+
+
+@pytest.mark.parametrize("rule_cls, extra", [
+    (tmpi.BSP, {}),
+    (tmpi.EASGD, {"sync_freq": 2}),
+    # downpour sums worker deltas into the center (an effective size× step),
+    # so the smoke needs plain SGD at a cooler lr to descend
+    (tmpi.ASGD, {"sync_freq": 2, "learning_rate": 0.005,
+                 "optimizer": "sgd"}),
+    (tmpi.GOSGD, {"exch_prob": 0.8}),
+])
+def test_cifar10_session_cost_falls(rule_cls, extra):
+    rule = rule_cls()
+    rule.init(devices=4, **{**COMMON, **extra})
+    rec = rule.wait()
+    costs = [r["cost"] for r in rec._all_records]
+    assert len(costs) >= 8          # 12 iters at printFreq=1
+    # tiny noisy batches: compare window means, not endpoints
+    assert np.mean(costs[-4:]) < np.mean(costs[:4]), costs
+    assert np.isfinite(rec.epoch_records[-1]["val_cost"])
+
+
+def test_session_devices_overcommit_raises():
+    rule = tmpi.BSP()
+    rule.init(devices=4096, **COMMON)
+    with pytest.raises(ValueError, match="devices"):
+        rule.wait()

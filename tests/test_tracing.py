@@ -1,0 +1,37 @@
+"""Profiler trace capture via the worker loop's trace_dir hook."""
+
+import glob
+import os
+
+import theanompi_tpu as tmpi
+
+
+def test_trace_dir_produces_a_capture(tmp_path):
+    trace_dir = str(tmp_path / "trace")
+    rule = tmpi.BSP()
+    rule.init(devices=2, modelfile="theanompi_tpu.models.cifar10",
+              modelclass="Cifar10_model", epochs=1, synthetic_train=64,
+              synthetic_val=16, batch_size=8, compute_dtype="float32",
+              verbose=False, scale_lr=False,
+              trace_dir=trace_dir, trace_start=2, trace_iters=2)
+    rule.wait()
+    # jax writes plugins/profile/<ts>/*.trace.json.gz (exact layout varies by
+    # jax version) — assert a trace artifact exists at all
+    found = glob.glob(os.path.join(trace_dir, "**", "*"), recursive=True)
+    assert any(os.path.isfile(f) for f in found), found
+
+
+def test_trace_window_outliving_training_still_flushes(tmp_path):
+    """A trace window extending past the last iteration must still be
+    stopped and flushed (regression: stop was an exact-count match)."""
+    trace_dir = str(tmp_path / "trace2")
+    rule = tmpi.BSP()
+    rule.init(devices=2, modelfile="theanompi_tpu.models.cifar10",
+              modelclass="Cifar10_model", epochs=1, synthetic_train=32,
+              synthetic_val=16, batch_size=8, compute_dtype="float32",
+              verbose=False, scale_lr=False,
+              # 2 train iters; window starts at 2 and wants 50 more
+              trace_dir=trace_dir, trace_start=2, trace_iters=50)
+    rule.wait()
+    found = glob.glob(os.path.join(trace_dir, "**", "*"), recursive=True)
+    assert any(os.path.isfile(f) for f in found), found

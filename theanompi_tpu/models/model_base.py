@@ -56,7 +56,8 @@ class ModelBase:
             # coherent when the model is constructed standalone (no Worker).
             self.config.setdefault("rank", self.rank)
             self.config["size"] = self.size
-        for k in ("batch_size", "epochs", "n_subb", "learning_rate", "seed"):
+        for k in ("batch_size", "epochs", "n_subb", "learning_rate", "seed",
+                  "optimizer", "momentum", "weight_decay"):
             if k in self.config:
                 setattr(self, k, self.config[k])
         self.seed = int(self.config.get("seed", self.seed))

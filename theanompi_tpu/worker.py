@@ -54,14 +54,40 @@ class Worker(MeshProcess):
 
         count = start_epoch * model.data.n_batch_train
         epochs = config.get("epochs", model.epochs)
+        # Timeline tracing (beyond the reference's wall-clock buckets,
+        # SURVEY.md §5): trace_dir enables a jax.profiler capture of
+        # trace_iters iterations starting at trace_start — view in
+        # TensorBoard / Perfetto.
+        trace_dir = config.get("trace_dir")
+        trace_start = int(config.get("trace_start", 5))
+        trace_iters = max(1, int(config.get("trace_iters", 5)))
+        trace_pending = trace_dir is not None
+        trace_stop_at = None
+
+        def _stop_trace():
+            nonlocal trace_stop_at
+            import jax
+            jax.block_until_ready(model.step_state["params"])
+            jax.profiler.stop_trace()
+            trace_stop_at = None
+            if self.verbose:
+                print(f"profiler trace saved to {trace_dir}", flush=True)
+
         t0 = time.time()
         for epoch in range(start_epoch, epochs):
             model.adjust_hyperp(epoch)
             model.data.shuffle_data(epoch + model.seed)
             for _ in range(model.data.n_batch_train):
                 count += 1
+                if trace_pending and count >= trace_start:
+                    import jax
+                    jax.profiler.start_trace(trace_dir)
+                    trace_pending = False
+                    trace_stop_at = count + trace_iters
                 model.train_iter(count, self.recorder)
                 self.exchanger.exchange(self.recorder, count)
+                if trace_stop_at is not None and count + 1 >= trace_stop_at:
+                    _stop_trace()
                 self.recorder.print_train_info(count)
 
             model.begin_val()
@@ -74,6 +100,8 @@ class Worker(MeshProcess):
                 model.save(ckpt_dir, epoch, count)
             if config.get("record_dir"):
                 self.recorder.save(config["record_dir"])
+        if trace_stop_at is not None:   # window outlived training: flush it
+            _stop_trace()
         if self.verbose:
             print(f"training finished in {time.time() - t0:.1f}s "
                   f"({epochs - start_epoch} epochs)", flush=True)
