@@ -6,6 +6,14 @@ AlexNet BSP configuration on the available hardware — the reference's
 headline metric (time per 5120 images, SURVEY.md §6) recast per-chip as
 ``BASELINE.json`` specifies.
 
+Env knobs: ``BENCH_MODEL`` (alexnet|googlenet|vgg16|resnet50|cifar10),
+``BENCH_RULE`` (bsp|easgd|asgd|gosgd — the BASELINE.json staged configs pair
+VGG-16 with EASGD and ResNet-50 with GoSGD), ``BENCH_ITERS``,
+``BENCH_WARMUP``, ``BENCH_BATCH`` (per-chip batch override),
+``BENCH_STRATEGY`` (exchange strategy string), ``BENCH_PRNG``
+(rbg|threefry — default rbg: the TPU hardware RNG, ~10% faster on AlexNet's
+dropout; dropout statistics are unaffected).
+
 The reference's published numbers are not retrievable this session
 (``BASELINE.md``): ``vs_baseline`` is computed against an ESTIMATED 1×K80
 AlexNet figure from the Theano-MPI era (~128 images/sec for batch-128
@@ -18,35 +26,55 @@ import os
 import sys
 import time
 
-import numpy as np
-
 K80_ALEXNET_IPS = 128.0   # estimated reference single-K80 AlexNet throughput
+
+MODELS = {
+    "alexnet": ("theanompi_tpu.models.alex_net", "AlexNet",
+                {"synthetic_batches": 4}),
+    "googlenet": ("theanompi_tpu.models.googlenet", "GoogLeNet",
+                  {"synthetic_batches": 4}),
+    "vgg16": ("theanompi_tpu.models.vggnet_16", "VGGNet_16",
+              {"synthetic_batches": 4}),
+    "resnet50": ("theanompi_tpu.models.resnet50", "ResNet50",
+                 {"synthetic_batches": 4}),
+    "cifar10": ("theanompi_tpu.models.cifar10", "Cifar10_model",
+                {"synthetic_train": 4096}),
+}
 
 
 def main() -> int:
     model_name = os.environ.get("BENCH_MODEL", "alexnet")
-    iters = int(os.environ.get("BENCH_ITERS", "20"))
-    warmup = int(os.environ.get("BENCH_WARMUP", "5"))
+    if model_name not in MODELS:
+        print(f"unknown BENCH_MODEL {model_name!r}; have {sorted(MODELS)}",
+              file=sys.stderr)
+        return 2
+    iters = max(1, int(os.environ.get("BENCH_ITERS", "20")))
+    warmup = max(1, int(os.environ.get("BENCH_WARMUP", "5")))
 
     import jax
-    from theanompi_tpu.parallel.exchanger import BSP_Exchanger
+    prng = os.environ.get("BENCH_PRNG", "rbg")
+    if prng:
+        jax.config.update("jax_default_prng_impl", prng)
+
+    from theanompi_tpu.parallel.exchanger import get_exchanger
     from theanompi_tpu.parallel.mesh import WORKER_AXIS, worker_mesh
     from theanompi_tpu.parallel import steps
+    import importlib
 
+    rule = os.environ.get("BENCH_RULE", "bsp")
     mesh = worker_mesh()
     n_chips = mesh.shape[WORKER_AXIS]
-    config = {"mesh": mesh, "size": n_chips, "rank": 0, "verbose": False}
+    modelfile, modelclass, extra = MODELS[model_name]
+    config = {"mesh": mesh, "size": n_chips, "rank": 0, "verbose": False,
+              **extra}
+    if os.environ.get("BENCH_BATCH"):
+        config["batch_size"] = int(os.environ["BENCH_BATCH"])
+    if os.environ.get("BENCH_STRATEGY"):
+        config["exch_strategy"] = os.environ["BENCH_STRATEGY"]
+    model = getattr(importlib.import_module(modelfile), modelclass)(config)
 
-    if model_name == "alexnet":
-        from theanompi_tpu.models.alex_net import AlexNet
-        config["synthetic_batches"] = 4
-        model = AlexNet(config)
-    else:
-        from theanompi_tpu.models.cifar10 import Cifar10_model
-        config["synthetic_train"] = 4096
-        model = Cifar10_model(config)
-
-    model.compile_iter_fns(BSP_Exchanger(config))
+    exchanger = get_exchanger(rule, config)
+    model.compile_iter_fns(exchanger)
     batch = model.data.next_train_batch(0)
     dev_batch = steps.put_batch(mesh, batch)
     n_images = int(batch["y"].shape[0])
@@ -56,26 +84,32 @@ def main() -> int:
     rng = jax.random.key(0)
 
     def step(i):
-        nonlocal dev_batch
         model.step_state, cost, err = model.train_fn(
             model.step_state, dev_batch, lr, rng, jnp.int32(i))
+        exchanger.exchange(None, i)     # rule cadence (no-op for BSP grads)
         return cost
 
+    def drain():
+        # block on the state, not the cost: the last exchange collective
+        # (non-BSP rules) reassigns step_state and would otherwise still be
+        # in flight when the clock stops
+        jax.block_until_ready(model.step_state["params"])
+
     for i in range(warmup):
-        cost = step(i)
-    jax.block_until_ready(cost)
+        step(i)
+    drain()
 
     t0 = time.time()
     for i in range(iters):
-        cost = step(warmup + i)
-    jax.block_until_ready(cost)
+        step(warmup + i)
+    drain()
     dt = time.time() - t0
 
     ips = n_images * iters / dt
     ips_chip = ips / n_chips
     out = {
         "metric": f"images_per_sec_per_chip ({model_name} batch "
-                  f"{model.batch_size} BSP, {n_chips} chip(s), "
+                  f"{model.batch_size} {rule.upper()}, {n_chips} chip(s), "
                   f"{jax.devices()[0].platform})",
         "value": round(ips_chip, 2),
         "unit": "images/sec/chip",

@@ -52,3 +52,16 @@ def test_session_devices_overcommit_raises():
     rule.init(devices=4096, **COMMON)
     with pytest.raises(ValueError, match="devices"):
         rule.wait()
+
+
+def test_prng_impl_config_applies():
+    import jax
+    from theanompi_tpu.base import MeshProcess
+
+    old = jax.config.jax_default_prng_impl
+    try:
+        p = MeshProcess({"prng_impl": "rbg", "verbose": False})
+        p.get_internode_comm()
+        assert jax.config.jax_default_prng_impl == "rbg"
+    finally:
+        jax.config.update("jax_default_prng_impl", old)

@@ -36,6 +36,13 @@ class MeshProcess:
     def get_internode_comm(self):
         """Bring up the communicator (≙ MPI_Init + COMM_WORLD): multi-host
         control plane if configured, then the 1-D workers mesh."""
+        impl = self.config.get("prng_impl")
+        if impl:
+            # 'rbg' uses the TPU hardware RNG for in-step randomness
+            # (dropout, GAN z draws) — measurably cheaper than threefry on
+            # AlexNet-sized dropout; default stays threefry (jax's default,
+            # fully deterministic across backends).
+            jax.config.update("jax_default_prng_impl", impl)
         init_multihost(
             coordinator_address=self.config.get("coordinator_address"),
             num_processes=self.config.get("num_processes"),
