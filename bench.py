@@ -11,8 +11,9 @@ Env knobs: ``BENCH_MODEL`` (alexnet|googlenet|vgg16|resnet50|cifar10),
 VGG-16 with EASGD and ResNet-50 with GoSGD), ``BENCH_ITERS``,
 ``BENCH_WARMUP``, ``BENCH_BATCH`` (per-chip batch override),
 ``BENCH_STRATEGY`` (exchange strategy string), ``BENCH_PRNG``
-(rbg|threefry — default rbg: the TPU hardware RNG, ~10% faster on AlexNet's
-dropout; dropout statistics are unaffected).
+(rbg|threefry2x32 — default rbg: the TPU hardware RNG, ~10% faster on
+AlexNet's dropout; dropout statistics are unaffected; the chosen impl is
+recorded in the metric string).
 
 The reference's published numbers are not retrievable this session
 (``BASELINE.md``): ``vs_baseline`` is computed against an ESTIMATED 1×K80
@@ -53,6 +54,7 @@ def main() -> int:
 
     import jax
     prng = os.environ.get("BENCH_PRNG", "rbg")
+    prng = {"threefry": "threefry2x32"}.get(prng, prng)  # accept the alias
     if prng:
         jax.config.update("jax_default_prng_impl", prng)
 
@@ -110,7 +112,7 @@ def main() -> int:
     out = {
         "metric": f"images_per_sec_per_chip ({model_name} batch "
                   f"{model.batch_size} {rule.upper()}, {n_chips} chip(s), "
-                  f"{jax.devices()[0].platform})",
+                  f"{jax.devices()[0].platform}, prng={prng or 'default'})",
         "value": round(ips_chip, 2),
         "unit": "images/sec/chip",
         "vs_baseline": round(ips_chip / K80_ALEXNET_IPS, 3),

@@ -1,0 +1,24 @@
+"""Shared bring-up for the example session scripts."""
+
+import os
+import sys
+
+# runnable from anywhere without installing the package
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def setup():
+    """Force the simulated CPU mesh when TMPI_FORCE_CPU=1 (for machines
+    without TPU chips) — must run before the first jax backend touch."""
+    if os.environ.get("TMPI_FORCE_CPU"):
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=8").strip()
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+
+
+def n_devices(default=None):
+    import jax
+    return default or len(jax.devices())

@@ -1,0 +1,51 @@
+#!/usr/bin/env python
+"""Wire-strategy sweep: one model, every exchange strategy, side by side.
+
+The TPU counterpart of the reference paper's strategy comparison tables
+(``Exch_allreduce`` vs ``asa32`` vs ``asa16`` vs NCCL — SURVEY.md §2.3/§6):
+trains a few iterations of CIFAR-10 BSP under each strategy and prints
+images/sec and the final cost so both the perf and the numerics are visible.
+"""
+
+import sys
+import time
+
+from _common import setup, n_devices
+
+setup()
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+from theanompi_tpu.models.cifar10 import Cifar10_model  # noqa: E402
+from theanompi_tpu.parallel import steps  # noqa: E402
+from theanompi_tpu.parallel.exchanger import BSP_Exchanger  # noqa: E402
+from theanompi_tpu.parallel.mesh import worker_mesh  # noqa: E402
+
+STRATEGIES = ["allreduce", "nccl16", "ring", "asa16", "onebit", "topk"]
+ITERS, WARMUP = 20, 5
+
+if __name__ == "__main__":
+    if len(sys.argv) > 1:       # e.g. python strategy_sweep.py ring onebit
+        STRATEGIES = sys.argv[1:]
+    mesh = worker_mesh(n_devices())
+    n = mesh.shape["workers"]
+    for name in STRATEGIES:
+        config = {"mesh": mesh, "size": n, "verbose": False,
+                  "synthetic_train": 4096, "exch_strategy": name}
+        model = Cifar10_model(config)
+        model.compile_iter_fns(BSP_Exchanger(config))
+        batch = model.data.next_train_batch(0)
+        dev = steps.put_batch(mesh, batch)
+        lr, rng = jnp.float32(model.current_lr), jax.random.key(0)
+        st = model.step_state
+        for i in range(WARMUP):
+            st, cost, err = model.train_fn(st, dev, lr, rng, jnp.int32(i))
+        jax.block_until_ready(st["params"])
+        t0 = time.time()
+        for i in range(ITERS):
+            st, cost, err = model.train_fn(st, dev, lr, rng,
+                                           jnp.int32(WARMUP + i))
+        jax.block_until_ready(st["params"])
+        ips = batch["y"].shape[0] * ITERS / (time.time() - t0)
+        print(f"{name:>10}: {ips:10.0f} img/s   cost {float(jnp.mean(cost)):.4f}")

@@ -1,0 +1,37 @@
+#!/usr/bin/env python
+"""BSP on the CIFAR-10 smoke-test CNN — the reference README's quick-start.
+
+Reference session-script shape (SURVEY.md §2.6):
+
+    from theanompi import BSP
+    rule = BSP()
+    rule.init(devices=['cuda0', 'cuda1'])
+    rule.wait()
+"""
+
+from _common import setup, n_devices
+
+setup()
+
+from theanompi_tpu import BSP  # noqa: E402
+
+if __name__ == "__main__":
+    rule = BSP()
+    rule.init(
+        devices=n_devices(),
+        modelfile="theanompi_tpu.models.cifar10",
+        modelclass="Cifar10_model",
+        # drop the two lines below to train on real CIFAR-10 via
+        # config['data_dir'] — synthetic keeps the example self-contained
+        synthetic_train=2048,
+        synthetic_val=512,
+        epochs=3,
+        printFreq=10,
+        # the reference's linear-LR-scaling contract multiplies the model's
+        # base lr by the worker count; at 8 workers that needs a cooler base
+        # (the reference tuned per-run — no warmup schedule existed in 2016)
+        learning_rate=0.01,
+        scale_lr=False,
+    )
+    rec = rule.wait()
+    print("final val:", rec.epoch_records[-1])

@@ -1,0 +1,36 @@
+#!/usr/bin/env python
+"""AlexNet ImageNet BSP — the paper's main benchmark configuration.
+
+Expects ``$IMAGENET_DIR`` (or edit data_dir below) with the reference's
+on-disk layout: ``train_hkl/`` and ``val_hkl/`` of 128-image uint8 batch
+files, ``train_labels.npy`` / ``val_labels.npy``, ``img_mean.npy``
+(SURVEY.md §2.8).  Without it, synthetic batches keep the script runnable
+for throughput measurement.
+"""
+
+import os
+
+from _common import setup, n_devices
+
+setup()
+
+from theanompi_tpu import BSP  # noqa: E402
+
+if __name__ == "__main__":
+    rule = BSP()
+    rule.init(
+        devices=n_devices(),
+        modelfile="theanompi_tpu.models.alex_net",
+        modelclass="AlexNet",
+        data_dir=os.environ.get("IMAGENET_DIR"),
+        para_load=True,              # background prefetch (≙ reference flag)
+        aug_per_image=True,          # upgrade over the per-batch ref augment
+        exch_strategy="allreduce",   # try: ring, asa16, onebit, topk
+        ckpt_dir="./snapshots/alexnet",
+        record_dir="./inc/alexnet",
+        prng_impl="rbg",
+        epochs=70,
+        printFreq=40,
+    )
+    rec = rule.wait()
+    print("final val:", rec.epoch_records[-1])

@@ -1,0 +1,31 @@
+#!/usr/bin/env python
+"""ResNet-50 with gossip SGD (GoSGD) — BASELINE.json staged config #4.
+
+Per iteration each worker draws Bernoulli(exch_prob); senders ship
+(α/2·params, α/2) to a random peer over a shared ``lax.ppermute`` ring-shift
+and receivers merge by weighted average.  No barrier, no server — the
+mixing-weight invariant Σα = n_workers is conserved exactly.
+"""
+
+import os
+
+from _common import setup, n_devices
+
+setup()
+
+from theanompi_tpu import GOSGD  # noqa: E402
+
+if __name__ == "__main__":
+    rule = GOSGD()
+    rule.init(
+        devices=n_devices(),
+        modelfile="theanompi_tpu.models.resnet50",
+        modelclass="ResNet50",
+        data_dir=os.environ.get("IMAGENET_DIR"),
+        exch_prob=0.25,
+        para_load=True,
+        epochs=90,
+        printFreq=20,
+    )
+    rec = rule.wait()
+    print("final val:", rec.epoch_records[-1])

@@ -1,0 +1,33 @@
+#!/usr/bin/env python
+"""VGG-16 with elastic averaging (EASGD) — BASELINE.json staged config #3.
+
+Every ``sync_freq`` iterations each worker does the elastic pairwise update
+with the center parameters (worker ← worker − α(worker − center);
+center ← center + α·mean(worker − center)); between syncs workers train
+independently on their shards, which is EASGD's exploration benefit.
+Validation scores the CENTER parameters, as the reference's server did.
+"""
+
+import os
+
+from _common import setup, n_devices
+
+setup()
+
+from theanompi_tpu import EASGD  # noqa: E402
+
+if __name__ == "__main__":
+    rule = EASGD()
+    rule.init(
+        devices=n_devices(),
+        modelfile="theanompi_tpu.models.vggnet_16",
+        modelclass="VGGNet_16",
+        data_dir=os.environ.get("IMAGENET_DIR"),
+        sync_freq=8,
+        alpha=0.5,
+        para_load=True,
+        epochs=70,
+        printFreq=20,
+    )
+    rec = rule.wait()
+    print("final val:", rec.epoch_records[-1])

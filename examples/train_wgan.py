@@ -1,0 +1,28 @@
+#!/usr/bin/env python
+"""WGAN on CIFAR-10 — the reference's late-added GAN family.
+
+The G/D pair trains as ONE compiled SPMD step (stop-gradient decoupled
+objectives); the critic's n_critic cadence and weight clipping ride the
+postprocess_update hook.  All four exchange rules work on GANs — this uses
+BSP so every chip's critic sees the full gradient signal.
+"""
+
+from _common import setup, n_devices
+
+setup()
+
+from theanompi_tpu import BSP  # noqa: E402
+
+if __name__ == "__main__":
+    rule = BSP()
+    rule.init(
+        devices=n_devices(),
+        modelfile="theanompi_tpu.models.wgan",
+        modelclass="WGAN",
+        epochs=25,
+        n_critic=5,
+        clip=0.01,
+        printFreq=20,
+    )
+    rec = rule.wait()
+    print("done; G loss column is 'error' in the records")

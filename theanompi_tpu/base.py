@@ -37,6 +37,7 @@ class MeshProcess:
         """Bring up the communicator (≙ MPI_Init + COMM_WORLD): multi-host
         control plane if configured, then the 1-D workers mesh."""
         impl = self.config.get("prng_impl")
+        impl = {"threefry": "threefry2x32"}.get(impl, impl)
         if impl:
             # 'rbg' uses the TPU hardware RNG for in-step randomness
             # (dropout, GAN z draws) — measurably cheaper than threefry on
