@@ -53,8 +53,8 @@ def main() -> int:
     warmup = max(1, int(os.environ.get("BENCH_WARMUP", "5")))
 
     import jax
-    prng = os.environ.get("BENCH_PRNG", "rbg")
-    prng = {"threefry": "threefry2x32"}.get(prng, prng)  # accept the alias
+    from theanompi_tpu.base import canonical_prng_impl
+    prng = canonical_prng_impl(os.environ.get("BENCH_PRNG", "rbg"))
     if prng:
         jax.config.update("jax_default_prng_impl", prng)
 

@@ -1,0 +1,90 @@
+"""Multi-host data sharding: each host's data object must emit exactly its
+contiguous sub-block of the global batch (disjoint, order-preserving), so
+``make_per_host_array`` can stitch them with no cross-host traffic.
+
+Simulated single-process by overriding process_count/process_index in config
+— the same override path a dry-run uses.
+"""
+
+import numpy as np
+
+from tests.conftest import SyntheticData
+from theanompi_tpu.models.data.imagenet import ImageNet_data
+
+
+def _collect(data, n_steps, val=False):
+    out = []
+    for i in range(n_steps):
+        b = data.next_val_batch(i) if val else data.next_train_batch(i)
+        out.append(b)
+    return out
+
+
+def test_database_host_slices_partition_global_batch():
+    cfg = {"size": 4, "seed": 0}
+    whole = SyntheticData({**cfg, "process_count": 1}, batch_size=8)
+    h0 = SyntheticData({**cfg, "process_count": 2, "process_index": 0},
+                       batch_size=8)
+    h1 = SyntheticData({**cfg, "process_count": 2, "process_index": 1},
+                       batch_size=8)
+    for d in (whole, h0, h1):
+        d.shuffle_data(123)
+    for _ in range(3):
+        g = whole.next_train_batch(0)
+        a, b = h0.next_train_batch(0), h1.next_train_batch(0)
+        assert a["x"].shape[0] == b["x"].shape[0] == g["x"].shape[0] // 2
+        np.testing.assert_array_equal(np.concatenate([a["x"], b["x"]]), g["x"])
+        np.testing.assert_array_equal(np.concatenate([a["y"], b["y"]]), g["y"])
+
+
+def test_database_val_slices_partition():
+    cfg = {"size": 4, "seed": 0}
+    whole = SyntheticData({**cfg, "process_count": 1}, batch_size=8)
+    parts = [SyntheticData({**cfg, "process_count": 2, "process_index": h},
+                           batch_size=8) for h in (0, 1)]
+    g = whole.next_val_batch(0)
+    a, b = (p.next_val_batch(0) for p in parts)
+    np.testing.assert_array_equal(np.concatenate([a["x"], b["x"]]), g["x"])
+
+
+def _imagenet_dir(tmp_path, n_files=8, bs=4):
+    d = tmp_path / "imgnet"
+    (d / "train_hkl").mkdir(parents=True)
+    (d / "val_hkl").mkdir()
+    r = np.random.RandomState(0)
+    for sub, n in (("train_hkl", n_files), ("val_hkl", n_files)):
+        for i in range(n):
+            np.save(str(d / sub / f"{i:04d}.npy"),
+                    r.randint(0, 256, (bs, 16, 16, 3), dtype=np.uint8))
+        np.save(str(d / f"{sub.split('_')[0]}_labels.npy"),
+                r.randint(0, 10, n * bs).astype(np.int64))
+    return str(d)
+
+
+def test_imagenet_host_file_slices_partition(tmp_path):
+    root = _imagenet_dir(tmp_path)
+    cfg = {"size": 4, "data_dir": root, "crop_size": 12, "seed": 7}
+    whole = ImageNet_data({**cfg, "process_count": 1}, batch_size=4, crop=12)
+    parts = [ImageNet_data({**cfg, "process_count": 2, "process_index": h},
+                           batch_size=4, crop=12) for h in (0, 1)]
+    for d in (whole, *parts):
+        d.shuffle_data(99)
+    for _ in range(2):
+        g = whole.next_train_batch(0)
+        a, b = (p.next_train_batch(0) for p in parts)
+        np.testing.assert_array_equal(np.concatenate([a["x"], b["x"]]), g["x"])
+        np.testing.assert_array_equal(np.concatenate([a["y"], b["y"]]), g["y"])
+    gv = whole.next_val_batch(0)
+    av, bv = (p.next_val_batch(0) for p in parts)
+    np.testing.assert_array_equal(np.concatenate([av["x"], bv["x"]]), gv["x"])
+
+
+def test_imagenet_synthetic_host_slices(tmp_path):
+    cfg = {"size": 4, "synthetic_batches": 2, "n_class": 10, "seed": 7}
+    whole = ImageNet_data({**cfg, "process_count": 1}, batch_size=4, crop=8)
+    parts = [ImageNet_data({**cfg, "process_count": 2, "process_index": h},
+                           batch_size=4, crop=8) for h in (0, 1)]
+    g = whole.next_train_batch(0)
+    a, b = (p.next_train_batch(0) for p in parts)
+    np.testing.assert_array_equal(np.concatenate([a["x"], b["x"]]), g["x"])
+    np.testing.assert_array_equal(np.concatenate([a["y"], b["y"]]), g["y"])

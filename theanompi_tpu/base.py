@@ -23,6 +23,12 @@ import jax
 from .parallel.mesh import WORKER_AXIS, init_multihost, worker_mesh
 
 
+def canonical_prng_impl(impl):
+    """Normalize user-facing PRNG names to jax's ('threefry' is accepted as
+    an alias for 'threefry2x32'). Shared by the worker path and bench.py."""
+    return {"threefry": "threefry2x32"}.get(impl, impl)
+
+
 class MeshProcess:
     """≙ reference ``MPI_GPU_Process``."""
 
@@ -36,8 +42,7 @@ class MeshProcess:
     def get_internode_comm(self):
         """Bring up the communicator (≙ MPI_Init + COMM_WORLD): multi-host
         control plane if configured, then the 1-D workers mesh."""
-        impl = self.config.get("prng_impl")
-        impl = {"threefry": "threefry2x32"}.get(impl, impl)
+        impl = canonical_prng_impl(self.config.get("prng_impl"))
         if impl:
             # 'rbg' uses the TPU hardware RNG for in-step randomness
             # (dropout, GAN z draws) — measurably cheaper than threefry on

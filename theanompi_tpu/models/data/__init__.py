@@ -20,13 +20,41 @@ from typing import Dict, Optional
 import numpy as np
 
 
+def _host_topology(config: dict):
+    """(process_count, process_index) — config overrides (tests, dry-runs)
+    win over the live ``jax.distributed`` topology.
+
+    Multi-host semantics (reference: each MPI rank loaded only its own shard
+    of the shuffled filename list, SURVEY.md §2.8): every host's data object
+    produces only the HOST-LOCAL slice of the global batch; the common-seed
+    permutation makes the slices disjoint, and
+    ``mesh.make_per_host_array`` stitches them into one global ``jax.Array``
+    with no cross-host copies.
+    """
+    procs = config.get("process_count")
+    proc_id = config.get("process_index")
+    # resolve each independently: a config that sets only process_count must
+    # not silently pin every host to index 0
+    if procs is None:
+        import jax
+        procs = jax.process_count()
+    if proc_id is None:
+        import jax
+        proc_id = jax.process_index()
+    procs, proc_id = int(procs or 1), int(proc_id or 0)
+    assert 0 <= proc_id < procs, (proc_id, procs)
+    return procs, proc_id
+
+
 class DataBase:
     """In-memory dataset with the reference's sharding/shuffle semantics.
 
     A "global batch" is ``size × batch_size`` samples (each worker consumed
     its own ``batch_size``-image file batch in the reference); the mesh
     splits it so chip *i* sees the *i*-th contiguous block — the stride-style
-    partition the reference used on its shuffled filename list.
+    partition the reference used on its shuffled filename list.  Under
+    multi-host each host emits only its contiguous sub-block (see
+    :func:`_host_topology`).
     """
 
     def __init__(self, config: Optional[dict] = None, batch_size: int = 128):
@@ -34,6 +62,11 @@ class DataBase:
         self.size = self.config.get("size", 1)
         self.batch_size = batch_size
         self.global_batch = self.size * batch_size
+        self.procs, self.proc_id = _host_topology(self.config)
+        # host sub-blocks must align with worker boundaries, or per-host data
+        # won't match the hosts' addressable shards
+        assert self.size % self.procs == 0, (
+            f"{self.size} workers not divisible by {self.procs} hosts")
         self.x_train = self.y_train = self.x_val = self.y_val = None
         self._perm = None
         self._train_ptr = 0
@@ -47,6 +80,11 @@ class DataBase:
         self._perm = np.arange(n_train)
         assert self.n_batch_train > 0, (
             f"{n_train} train samples < one global batch {self.global_batch}")
+        # single-host tolerates a short final val batch; multi-host cannot
+        # (per-process shards must be equal-sized to stitch)
+        assert self.procs == 1 or n_val >= self.global_batch, (
+            f"{n_val} val samples < one global batch {self.global_batch} "
+            f"with {self.procs} hosts")
 
     def shuffle_data(self, seed: int) -> None:
         """Common-seed shuffle (reference: identical RNG on all ranks so the
@@ -56,16 +94,24 @@ class DataBase:
         self._train_ptr = 0
         self._val_ptr = 0
 
+    def _local(self, lo: int) -> slice:
+        """This host's contiguous sub-block of the global batch starting at
+        global offset ``lo`` (device order in the mesh is process-grouped, so
+        block h of the global array belongs to host h)."""
+        per = self.global_batch // self.procs
+        start = lo + self.proc_id * per
+        return slice(start, start + per)
+
     def next_train_batch(self, count: int) -> Dict[str, np.ndarray]:
         i = self._train_ptr % self.n_batch_train
         self._train_ptr += 1
-        idx = self._perm[i * self.global_batch:(i + 1) * self.global_batch]
+        idx = self._perm[self._local(i * self.global_batch)]
         return self._make_batch(self.x_train[idx], self.y_train[idx], train=True)
 
     def next_val_batch(self, count: int) -> Dict[str, np.ndarray]:
         i = self._val_ptr % self.n_batch_val
         self._val_ptr += 1
-        sl = slice(i * self.global_batch, (i + 1) * self.global_batch)
+        sl = self._local(i * self.global_batch)
         return self._make_batch(self.x_val[sl], self.y_val[sl], train=False)
 
     def _make_batch(self, x, y, train: bool) -> Dict[str, np.ndarray]:

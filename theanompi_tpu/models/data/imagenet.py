@@ -84,10 +84,14 @@ class ImageNet_data:
 
     def __init__(self, config: Optional[dict] = None, batch_size: int = 128,
                  crop: int = CROP):
+        from . import _host_topology
         self.config = dict(config or {})
         self.size = self.config.get("size", 1)
         self.batch_size = batch_size
         self.global_batch = self.size * batch_size
+        self.procs, self.proc_id = _host_topology(self.config)
+        assert self.size % self.procs == 0, (
+            f"{self.size} workers not divisible by {self.procs} hosts")
         self.crop = int(self.config.get("crop_size", crop))
         self.rng = np.random.RandomState(self.config.get("seed", 42))
 
@@ -130,14 +134,22 @@ class ImageNet_data:
         self.n_batch_val = int(self.config.get("synthetic_val_batches", 4))
         self.train_files = self.val_files = []
         self.img_mean = np.float32(122.0)
-        # one cached uint8 megabatch, re-labeled per step (throughput only)
+        # One cached uint8 batch, re-used every step (throughput only).  Each
+        # host materializes ONLY its local rows — generated chunk-by-chunk so
+        # the RNG stream (and thus the data) is identical to a single big
+        # draw, without ever allocating the full global megabatch per host
+        # (at pod scale that's GBs of dead host RAM).
         r = np.random.RandomState(0)
-        self._synth_x = r.randint(0, 256,
-                                  (self.global_batch, RAW, RAW, 3),
-                                  dtype=np.uint8)
+        per = self.global_batch // self.procs
+        chunks = []
+        for h in range(self.procs):
+            c = r.randint(0, 256, (per, RAW, RAW, 3), dtype=np.uint8)
+            if h == self.proc_id:
+                chunks.append(c)
+        self._synth_x = chunks[0]
         n_class = int(self.config.get("n_class", N_CLASS))
-        self._synth_y = r.randint(0, n_class, self.global_batch).astype(
-            np.int32)
+        y = r.randint(0, n_class, self.global_batch).astype(np.int32)
+        self._synth_y = y[self.proc_id * per:(self.proc_id + 1) * per]
 
     # -- contract ------------------------------------------------------------
 
@@ -150,12 +162,20 @@ class ImageNet_data:
         self._train_ptr = 0
         self._val_ptr = 0
 
+    def _local_files(self, lo: int):
+        """This host's slice of the step's ``size`` batch files (each MPI
+        rank in the reference loaded only its own file — here each HOST
+        loads only its chips' files)."""
+        per = self.size // self.procs
+        start = lo + self.proc_id * per
+        return range(start, start + per)
+
     def next_train_batch(self, count: int) -> Dict[str, np.ndarray]:
-        if self.synthetic:
+        if self.synthetic:    # _synth_x/_synth_y are already host-local
             return self._augment(self._synth_x, self._synth_y, train=True)
         i = self._train_ptr % self.n_batch_train
         self._train_ptr += 1
-        idx = self._perm[i * self.size:(i + 1) * self.size]
+        idx = [self._perm[j] for j in self._local_files(i * self.size)]
         xs = np.concatenate([_load_batch_file(self.train_files[j])
                              for j in idx])
         ys = np.concatenate([self.train_labels[j * self.batch_size:
@@ -169,7 +189,7 @@ class ImageNet_data:
             return self._augment(self._synth_x, self._synth_y, train=False)
         i = self._val_ptr % self.n_batch_val
         self._val_ptr += 1
-        idx = range(i * self.size, (i + 1) * self.size)
+        idx = self._local_files(i * self.size)
         xs = np.concatenate([_load_batch_file(self.val_files[j])
                              for j in idx])
         ys = np.concatenate([self.val_labels[j * self.batch_size:
