@@ -1,0 +1,70 @@
+"""Fused LRN Pallas kernels vs the jnp oracle (interpret mode on CPU).
+
+The fwd kernel must match the reference formula; the bwd kernel must match
+``jax.grad`` of the oracle — including the cross-channel coupling terms and
+the window-truncated edge channels.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from theanompi_tpu.ops import lrn as lrn_ops
+
+SHAPES = [
+    (2, 5, 5, 96),       # AlexNet lrn1 channel count
+    (2, 3, 3, 256),      # lrn2 channel count
+    (4, 1, 1, 128),      # exactly one lane tile
+]
+
+
+@pytest.mark.parametrize("shape", SHAPES)
+@pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16])
+def test_fwd_kernel_matches_oracle(shape, dtype):
+    x = jax.random.normal(jax.random.key(0), shape, dtype)
+    want = lrn_ops.lrn_jnp(x, 5, 2.0, 1e-4, 0.75)
+    got = lrn_ops._lrn_fwd_pallas(x, 5, 2.0, 1e-4, 0.75, interpret=True)
+    np.testing.assert_allclose(
+        np.asarray(got, np.float32), np.asarray(want, np.float32),
+        rtol=2e-6, atol=2e-6)
+
+
+@pytest.mark.parametrize("shape", SHAPES[:2])
+def test_bwd_kernel_matches_oracle_grad(shape):
+    x = jax.random.normal(jax.random.key(1), shape, jnp.float32)
+    dy = jax.random.normal(jax.random.key(2), shape, jnp.float32)
+
+    def loss(x):
+        return jnp.vdot(lrn_ops.lrn_jnp(x, 5, 2.0, 1e-4, 0.75), dy)
+
+    want = jax.grad(loss)(x)
+    got = lrn_ops._lrn_bwd_pallas(x, dy, 5, 2.0, 1e-4, 0.75, interpret=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_ragged_row_blocks():
+    """Row count not a multiple of BLOCK_ROWS: padded blocks must not
+    corrupt real rows."""
+    x = jax.random.normal(jax.random.key(3), (3, 7, 11, 96), jnp.float32)
+    want = lrn_ops.lrn_jnp(x, 5, 2.0, 1e-4, 0.75)
+    got = lrn_ops._lrn_fwd_pallas(x, 5, 2.0, 1e-4, 0.75, interpret=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-6, atol=2e-6)
+
+
+def test_general_beta_branch():
+    x = jax.random.normal(jax.random.key(4), (2, 3, 3, 96), jnp.float32)
+    want = lrn_ops.lrn_jnp(x, 5, 1.0, 2e-4, 0.5)
+    got = lrn_ops._lrn_fwd_pallas(x, 5, 1.0, 2e-4, 0.5, interpret=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-6, atol=2e-6)
+
+
+def test_public_lrn_dispatches_to_oracle_off_tpu():
+    x = jax.random.normal(jax.random.key(5), (2, 3, 3, 96), jnp.bfloat16)
+    got = lrn_ops.lrn(x)
+    want = lrn_ops.lrn_jnp(x, 5, 2.0, 1e-4, 0.75)
+    np.testing.assert_array_equal(np.asarray(got, np.float32),
+                                  np.asarray(want, np.float32))

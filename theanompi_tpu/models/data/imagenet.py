@@ -126,6 +126,11 @@ class ImageNet_data:
         files_per_step = self.size
         self.n_batch_train = len(self.train_files) // files_per_step
         self.n_batch_val = max(1, len(self.val_files) // files_per_step)
+        # multi-host needs equal per-host file shards every val step (the
+        # max(1, ...) single-host fallback would index past the list)
+        assert self.procs == 1 or len(self.val_files) >= files_per_step, (
+            f"{len(self.val_files)} val files < {files_per_step} per step "
+            f"with {self.procs} hosts")
 
     # -- synthetic ----------------------------------------------------------
 
@@ -135,21 +140,14 @@ class ImageNet_data:
         self.train_files = self.val_files = []
         self.img_mean = np.float32(122.0)
         # One cached uint8 batch, re-used every step (throughput only).  Each
-        # host materializes ONLY its local rows — generated chunk-by-chunk so
-        # the RNG stream (and thus the data) is identical to a single big
-        # draw, without ever allocating the full global megabatch per host
-        # (at pod scale that's GBs of dead host RAM).
-        r = np.random.RandomState(0)
+        # host draws ONLY its local rows from a host-keyed stream — O(local)
+        # time and RAM (at pod scale the full global megabatch would be GBs
+        # of dead work per host); distinct hosts get distinct data.
         per = self.global_batch // self.procs
-        chunks = []
-        for h in range(self.procs):
-            c = r.randint(0, 256, (per, RAW, RAW, 3), dtype=np.uint8)
-            if h == self.proc_id:
-                chunks.append(c)
-        self._synth_x = chunks[0]
+        r = np.random.RandomState([0, self.proc_id])
+        self._synth_x = r.randint(0, 256, (per, RAW, RAW, 3), dtype=np.uint8)
         n_class = int(self.config.get("n_class", N_CLASS))
-        y = r.randint(0, n_class, self.global_batch).astype(np.int32)
-        self._synth_y = y[self.proc_id * per:(self.proc_id + 1) * per]
+        self._synth_y = r.randint(0, n_class, per).astype(np.int32)
 
     # -- contract ------------------------------------------------------------
 

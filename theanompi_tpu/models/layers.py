@@ -308,30 +308,19 @@ class LRN(Layer):
     """
 
     def __init__(self, n: int = 5, k: float = 2.0, alpha: float = 1e-4,
-                 beta: float = 0.75, name: str = "lrn"):
+                 beta: float = 0.75, impl: str = "band", name: str = "lrn"):
         self.n, self.k, self.alpha, self.beta = n, k, alpha, beta
+        self.impl = impl      # 'band' (XLA conv, default) | 'pallas' (fused)
         self.name = name
 
-    def _band(self, c: int):
-        half = self.n // 2
-        band = np.zeros((c, c), np.float32)
-        for i in range(c):
-            band[max(0, i - half):i + half + 1, i] = 1.0
-        return jnp.asarray(band.reshape(1, 1, c, c))
-
     def apply(self, params, x, *, train=False, rng=None, state=None):
-        c = x.shape[-1]
-        sq = jnp.square(x.astype(jnp.float32))
-        ssum = jax.lax.conv_general_dilated(
-            sq, self._band(c), (1, 1), "VALID",
-            dimension_numbers=("NHWC", "HWIO", "NHWC"))
-        d = self.k + (self.alpha / self.n) * ssum
-        if self.beta == 0.75:
-            inv = jax.lax.rsqrt(d)
-            scale = inv * jnp.sqrt(inv)
-        else:
-            scale = jnp.power(d, -self.beta)
-        return (x.astype(jnp.float32) * scale).astype(x.dtype)
+        # both implementations live in ops.lrn (single source of the math;
+        # the Pallas kernel is equality-tested against lrn_jnp)
+        if self.impl == "pallas":
+            from ..ops.lrn import lrn as lrn_fused
+            return lrn_fused(x, self.n, self.k, self.alpha, self.beta)
+        from ..ops.lrn import lrn_jnp
+        return lrn_jnp(x, self.n, self.k, self.alpha, self.beta)
 
 
 class Dropout(Layer):

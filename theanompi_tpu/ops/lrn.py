@@ -1,0 +1,208 @@
+"""Fused LRN (local response normalization) Pallas TPU kernels.
+
+AlexNet's LRN is the hot non-matmul op of the zoo's flagship model
+(reference: ``theanompi/models/layers2.py`` LRN over cuDNN/Theano — here the
+op itself is re-designed for TPU).  The XLA lowering (band-matrix conv +
+elementwise, ``models/layers.py``) materializes fp32 ``x²`` and the band sum
+in HBM between fusions; at AlexNet's lrn1 shape (128×55×55×96) that is ~5
+array passes forward+backward.  The fused kernels read ``x`` (and ``dy``)
+once and write the result once, with the 5-tap cross-channel sum done as a
+small matmul against a constant banded matrix on the MXU — the channel dim is
+the lane dim, where sliding-window ops are slow but matmuls are native.
+
+Math (β defaults to the AlexNet 0.75):
+
+    d = k + (α/n)·BandSum(x²)         s = d^(−β)         y = x·s
+    t = dy·x·s/d
+    dx = s·dy − 2·(α/n)·β · x · BandSum(t)      (band window is symmetric)
+
+Dispatch follows ``ops/compress.py``: compiled Pallas on TPU, the jnp
+reference (same formula, autodiff'd for bwd) elsewhere and under
+``THEANOMPI_TPU_NO_PALLAS=1``; interpret-mode kernels are equality-tested
+against the oracle in ``tests/test_lrn_pallas.py``.
+
+**Measured status (TPU, AlexNet lrn1 128×55×55×96 bf16):** this fused kernel
+runs 1.44 ms fwd / ~4 ms fwd+bwd, while XLA's band-matrix-conv lowering
+(``models/layers.py`` LRN, same math) measures 2.66 ms fwd+bwd — XLA's 1×1
+conv + fusion path wins, and in the full AlexNet step the gap widens (9.3 →
+18.3 ms/step: ``custom_vjp`` is a fusion barrier and the saved ``x``
+residual adds traffic).  So the band-conv stays the default and this kernel
+is the selectable alternative (``lrn_impl='pallas'`` model config), kept
+honest by the oracle tests.  (A lane-roll variant was also measured: ~1.8×
+slower than the in-kernel matmul — cross-lane rolls are expensive; the MXU
+band-matmul is the right TPU shape for a channel-window sum.)
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+BLOCK_ROWS = 512       # pixel rows per grid block — fastest of the measured
+                       # {256, 512, 1024, 2048} sweep at AlexNet shapes
+
+
+def _dispatch_pallas() -> bool:
+    if os.environ.get("THEANOMPI_TPU_NO_PALLAS", "0") == "1":
+        return False
+    return jax.default_backend() == "tpu"
+
+
+def _vma_of(*xs) -> frozenset:
+    vma: frozenset = frozenset()
+    for x in xs:
+        vma = vma | getattr(jax.typeof(x), "vma", frozenset())
+    return vma
+
+
+@functools.lru_cache(maxsize=None)
+def _band_np(c: int, n: int) -> np.ndarray:
+    half = n // 2
+    band = np.zeros((c, c), np.float32)
+    for i in range(c):
+        band[max(0, i - half):i + half + 1, i] = 1.0
+    return band
+
+
+def _scale_of(d, beta: float):
+    """d^(−β) on the VPU — rsqrt composition for the AlexNet β."""
+    if beta == 0.75:
+        inv = jax.lax.rsqrt(d)
+        return inv * jnp.sqrt(inv)
+    return jnp.exp(-beta * jnp.log(d))
+
+
+# ---------------------------------------------------------------------------
+# jnp reference (oracle + non-TPU fallback; autodiff provides its bwd)
+# ---------------------------------------------------------------------------
+
+def lrn_jnp(x: jnp.ndarray, n: int, k: float, alpha: float,
+            beta: float) -> jnp.ndarray:
+    """Reference formula, fp32 accumulation, band sum as 1×1 conv.
+
+    This is ALSO the production XLA path (``models/layers.py`` LRN delegates
+    here), so the conv runs on the input's native NHWC shape — reshaping to
+    a (1, M, 1, C) pseudo-image measures ~6× slower on TPU.
+    """
+    c = x.shape[-1]
+    x4 = x if x.ndim == 4 else x.reshape(1, -1, 1, c)
+    sq = jnp.square(x4.astype(jnp.float32))
+    ssum = jax.lax.conv_general_dilated(
+        sq, jnp.asarray(_band_np(c, n)).reshape(1, 1, c, c),
+        (1, 1), "VALID", dimension_numbers=("NHWC", "HWIO", "NHWC"))
+    d = k + (alpha / n) * ssum
+    y4 = (x4.astype(jnp.float32) * _scale_of(d, beta)).astype(x.dtype)
+    return y4.reshape(x.shape)
+
+
+# ---------------------------------------------------------------------------
+# Pallas kernels
+# ---------------------------------------------------------------------------
+
+def _make_fwd_kernel(n: int, k: float, alpha: float, beta: float):
+    def kernel(x_ref, band_ref, y_ref):
+        xf = x_ref[:].astype(jnp.float32)
+        ssum = jnp.dot(xf * xf, band_ref[:],
+                       preferred_element_type=jnp.float32)
+        d = k + (alpha / n) * ssum
+        y_ref[:] = (xf * _scale_of(d, beta)).astype(y_ref.dtype)
+    return kernel
+
+
+def _make_bwd_kernel(n: int, k: float, alpha: float, beta: float):
+    c2b = 2.0 * (alpha / n) * beta
+
+    def kernel(x_ref, dy_ref, band_ref, dx_ref):
+        xf = x_ref[:].astype(jnp.float32)
+        dyf = dy_ref[:].astype(jnp.float32)
+        band = band_ref[:]
+        ssum = jnp.dot(xf * xf, band, preferred_element_type=jnp.float32)
+        d = k + (alpha / n) * ssum
+        s = _scale_of(d, beta)
+        t = dyf * xf * s / d
+        back = jnp.dot(t, band, preferred_element_type=jnp.float32)
+        dx_ref[:] = (s * dyf - c2b * xf * back).astype(dx_ref.dtype)
+    return kernel
+
+
+def _rows_view(x):
+    c = x.shape[-1]
+    return x.reshape(-1, c), c
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("n", "k", "alpha", "beta", "interpret"))
+def _lrn_fwd_pallas(x, n, k, alpha, beta, interpret=False):
+    x2d, c = _rows_view(x)
+    m = x2d.shape[0]
+    band = jnp.asarray(_band_np(c, n))
+    y2d = pl.pallas_call(
+        _make_fwd_kernel(n, k, alpha, beta),
+        grid=(pl.cdiv(m, BLOCK_ROWS),),
+        in_specs=[
+            pl.BlockSpec((BLOCK_ROWS, c), lambda j: (j, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((c, c), lambda j: (0, 0), memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((BLOCK_ROWS, c), lambda j: (j, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((m, c), x.dtype, vma=_vma_of(x)),
+        interpret=interpret,
+    )(x2d, band)
+    return y2d.reshape(x.shape)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("n", "k", "alpha", "beta", "interpret"))
+def _lrn_bwd_pallas(x, dy, n, k, alpha, beta, interpret=False):
+    x2d, c = _rows_view(x)
+    dy2d, _ = _rows_view(dy)
+    m = x2d.shape[0]
+    band = jnp.asarray(_band_np(c, n))
+    spec = pl.BlockSpec((BLOCK_ROWS, c), lambda j: (j, 0),
+                        memory_space=pltpu.VMEM)
+    dx2d = pl.pallas_call(
+        _make_bwd_kernel(n, k, alpha, beta),
+        grid=(pl.cdiv(m, BLOCK_ROWS),),
+        in_specs=[spec, spec,
+                  pl.BlockSpec((c, c), lambda j: (0, 0),
+                               memory_space=pltpu.VMEM)],
+        out_specs=spec,
+        out_shape=jax.ShapeDtypeStruct((m, c), x.dtype, vma=_vma_of(x, dy)),
+        interpret=interpret,
+    )(x2d, dy2d, band)
+    return dx2d.reshape(x.shape)
+
+
+# ---------------------------------------------------------------------------
+# custom_vjp wrapper (public API)
+# ---------------------------------------------------------------------------
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2, 3, 4))
+def _lrn_tpu(x, n, k, alpha, beta):
+    return _lrn_fwd_pallas(x, n, k, alpha, beta)
+
+
+def _lrn_tpu_fwd(x, n, k, alpha, beta):
+    return _lrn_fwd_pallas(x, n, k, alpha, beta), x   # residual: x only
+
+
+def _lrn_tpu_bwd(n, k, alpha, beta, x, dy):
+    return (_lrn_bwd_pallas(x, dy, n, k, alpha, beta),)
+
+
+_lrn_tpu.defvjp(_lrn_tpu_fwd, _lrn_tpu_bwd)
+
+
+def lrn(x: jnp.ndarray, n: int = 5, k: float = 2.0, alpha: float = 1e-4,
+        beta: float = 0.75) -> jnp.ndarray:
+    """Fused cross-channel LRN over NHWC (Pallas on TPU, jnp elsewhere)."""
+    if _dispatch_pallas():
+        return _lrn_tpu(x, n, float(k), float(alpha), float(beta))
+    return lrn_jnp(x, n, k, alpha, beta)
