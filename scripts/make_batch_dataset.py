@@ -1,0 +1,133 @@
+#!/usr/bin/env python
+"""Pre-process an image-folder dataset into the framework's batch-file layout.
+
+The reference inherited its data prep from ``uoguelph-mlrg/theano_alexnet``:
+ImageNet resized offline to 256×256 and packed into hickle ``.hkl`` files of
+one uint8 batch each, plus a mean image (SURVEY.md §2.8).  This script
+produces the same on-disk contract from a ``class/img.jpg`` folder tree (or
+synthesizes one for pipeline testing):
+
+    out_dir/
+      train_hkl/0000.hkl ...     (or .npy without h5py)  [B, 256, 256, 3] u8
+      val_hkl/0000.hkl ...
+      train_labels.npy  val_labels.npy  img_mean.npy
+
+Usage:
+  python scripts/make_batch_dataset.py --src /data/imagenet_raw --out /data/imagenet
+  python scripts/make_batch_dataset.py --synthetic 16 --out /tmp/fake_imagenet
+"""
+
+import argparse
+import os
+
+import numpy as np
+
+RAW = 256
+
+
+def _iter_images(src):
+    """Yield (path, class_index) over a class-per-directory tree."""
+    classes = sorted(d for d in os.listdir(src)
+                     if os.path.isdir(os.path.join(src, d)))
+    idx = {c: i for i, c in enumerate(classes)}
+    for c in classes:
+        d = os.path.join(src, c)
+        for name in sorted(os.listdir(d)):
+            if name.lower().split(".")[-1] in ("jpg", "jpeg", "png", "bmp"):
+                yield os.path.join(d, name), idx[c]
+
+
+def _load_resized(path):
+    from PIL import Image
+    with Image.open(path) as im:
+        im = im.convert("RGB")
+        # reference prep: scale shorter side to 256, center crop 256×256
+        w, h = im.size
+        s = RAW / min(w, h)
+        im = im.resize((max(RAW, round(w * s)), max(RAW, round(h * s))))
+        w, h = im.size
+        ox, oy = (w - RAW) // 2, (h - RAW) // 2
+        im = im.crop((ox, oy, ox + RAW, oy + RAW))
+        return np.asarray(im, np.uint8)
+
+
+def _save_batch(path_base, batch):
+    try:
+        import h5py
+        with h5py.File(path_base + ".hkl", "w") as f:
+            f.create_dataset("data", data=batch)
+        return path_base + ".hkl"
+    except ImportError:
+        np.save(path_base + ".npy", batch)
+        return path_base + ".npy"
+
+
+def write_split(images, labels, out_sub, batch_size, mean_acc=None):
+    os.makedirs(out_sub, exist_ok=True)
+    n_batches = len(images) // batch_size
+    kept_labels = []
+    for b in range(n_batches):
+        chunk = images[b * batch_size:(b + 1) * batch_size]
+        batch = np.stack(chunk)
+        if mean_acc is not None:
+            mean_acc += batch.astype(np.float64).sum(axis=0)
+        _save_batch(os.path.join(out_sub, f"{b:04d}"), batch)
+        kept_labels.extend(labels[b * batch_size:(b + 1) * batch_size])
+    return np.asarray(kept_labels, np.int64), n_batches * batch_size
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--src", help="class-per-directory image tree")
+    p.add_argument("--out", required=True)
+    p.add_argument("--batch-size", type=int, default=128)
+    p.add_argument("--val-frac", type=float, default=0.05)
+    p.add_argument("--synthetic", type=int, default=0,
+                   help="instead of --src: write N synthetic train batches")
+    p.add_argument("--seed", type=int, default=0)
+    args = p.parse_args(argv)
+
+    os.makedirs(args.out, exist_ok=True)
+    bs = args.batch_size
+
+    if args.synthetic:
+        r = np.random.RandomState(args.seed)
+        n_train, n_val = args.synthetic * bs, max(bs, args.synthetic * bs // 8)
+        imgs = [r.randint(0, 256, (RAW, RAW, 3), dtype=np.uint8)
+                for _ in range(n_train + n_val)]
+        labels = list(r.randint(0, 1000, n_train + n_val))
+    else:
+        if not args.src:
+            p.error("--src or --synthetic required")
+        pairs = list(_iter_images(args.src))
+        r = np.random.RandomState(args.seed)
+        r.shuffle(pairs)
+        print(f"loading {len(pairs)} images from {args.src} ...")
+        imgs, labels = [], []
+        for path, y in pairs:
+            imgs.append(_load_resized(path))
+            labels.append(y)
+        n_val = max(bs, int(len(imgs) * args.val_frac) // bs * bs)
+        n_train = len(imgs) - n_val
+        if n_train < bs:
+            p.error(f"{len(imgs)} images is too few for batch size {bs} "
+                    f"(needs at least one train and one val batch: "
+                    f">= {2 * bs} images)")
+
+    mean_acc = np.zeros((RAW, RAW, 3), np.float64)
+    tr_labels, n_tr = write_split(imgs[:n_train], labels[:n_train],
+                                  os.path.join(args.out, "train_hkl"), bs,
+                                  mean_acc)
+    va_labels, _ = write_split(imgs[n_train:], labels[n_train:],
+                               os.path.join(args.out, "val_hkl"), bs)
+    np.save(os.path.join(args.out, "train_labels.npy"), tr_labels)
+    np.save(os.path.join(args.out, "val_labels.npy"), va_labels)
+    np.save(os.path.join(args.out, "img_mean.npy"),
+            (mean_acc / max(n_tr, 1)).astype(np.float32))
+    print(f"wrote {args.out}: {len(tr_labels)} train / {len(va_labels)} val "
+          f"images in {bs}-image batch files")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,77 @@
+#!/usr/bin/env python
+"""Plot training records — the reference's offline matplotlib plotting.
+
+Theano-MPI dumped per-rank ``inforec`` record files for offline plotting of
+cost/error/throughput curves (SURVEY.md §2.10, §5 'Metrics/observability');
+this reads this framework's ``inforec_rank*.jsonl`` (or ``.npy``) dumps from
+a record dir and writes PNG curves.
+
+Usage: python scripts/plot_records.py <record_dir> [out.png]
+"""
+
+import json
+import os
+import sys
+
+
+def load_records(record_dir):
+    recs = []
+    for name in sorted(os.listdir(record_dir)):
+        if name.startswith("inforec_rank") and name.endswith(".jsonl"):
+            with open(os.path.join(record_dir, name)) as f:
+                recs.extend(json.loads(line) for line in f if line.strip())
+    if not recs:
+        import numpy as np
+        for name in sorted(os.listdir(record_dir)):
+            if name.startswith("inforec_rank") and name.endswith(".npy"):
+                recs.extend(np.load(os.path.join(record_dir, name),
+                                    allow_pickle=True).tolist())
+    return recs
+
+
+def main(argv=None):
+    argv = argv if argv is not None else sys.argv[1:]
+    if not argv:
+        print(__doc__)
+        return 1
+    record_dir = argv[0]
+    out = argv[1] if len(argv) > 1 else os.path.join(record_dir, "curves.png")
+
+    recs = load_records(record_dir)
+    train = [r for r in recs if "cost" in r]
+    val = [r for r in recs if "val_cost" in r]
+    if not train and not val:
+        print(f"no records found in {record_dir}")
+        return 1
+
+    import matplotlib
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+
+    fig, axes = plt.subplots(1, 3, figsize=(15, 4))
+    if train:
+        it = [r["iter"] for r in train]
+        axes[0].plot(it, [r["cost"] for r in train], label="train cost")
+        axes[1].plot(it, [r["error"] for r in train], label="train err")
+        axes[2].plot(it, [r.get("images_per_sec", 0) for r in train],
+                     label="img/s")
+    if val:
+        it = [r["iter"] for r in val]
+        axes[0].plot(it, [r["val_cost"] for r in val], "o-", label="val cost")
+        axes[1].plot(it, [r["val_error"] for r in val], "o-",
+                     label="val top-1 err")
+        axes[1].plot(it, [r["val_error_top5"] for r in val], "s--",
+                     label="val top-5 err")
+    for ax, title in zip(axes, ("cost", "error", "throughput")):
+        ax.set_xlabel("iteration")
+        ax.set_title(title)
+        ax.legend()
+        ax.grid(True, alpha=0.3)
+    fig.tight_layout()
+    fig.savefig(out, dpi=120)
+    print(f"wrote {out} ({len(train)} train / {len(val)} val records)")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

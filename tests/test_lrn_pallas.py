@@ -62,7 +62,8 @@ def test_general_beta_branch():
                                rtol=2e-6, atol=2e-6)
 
 
-def test_public_lrn_dispatches_to_oracle_off_tpu():
+def test_public_lrn_dispatches_to_oracle_off_tpu(monkeypatch):
+    monkeypatch.setenv("THEANOMPI_TPU_NO_PALLAS", "1")   # force oracle path
     x = jax.random.normal(jax.random.key(5), (2, 3, 3, 96), jnp.bfloat16)
     got = lrn_ops.lrn(x)
     want = lrn_ops.lrn_jnp(x, 5, 2.0, 1e-4, 0.75)

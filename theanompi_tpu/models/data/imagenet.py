@@ -123,6 +123,9 @@ class ImageNet_data:
         self.img_mean = (np.load(mean_path).astype(np.float32)
                          if os.path.exists(mean_path) else
                          np.float32(122.0))
+        if isinstance(self.img_mean, np.ndarray) and self.img_mean.ndim == 3:
+            # normalize a reference c01 (CHW) mean to HWC once, not per batch
+            self.img_mean = self._mean_to_hwc(self.img_mean)
         files_per_step = self.size
         self.n_batch_train = len(self.train_files) // files_per_step
         self.n_batch_val = max(1, len(self.val_files) // files_per_step)
@@ -187,7 +190,10 @@ class ImageNet_data:
             return self._augment(self._synth_x, self._synth_y, train=False)
         i = self._val_ptr % self.n_batch_val
         self._val_ptr += 1
-        idx = self._local_files(i * self.size)
+        # single-host tolerates fewer val files than workers (short final
+        # batch still splits across the mesh); multi-host asserts at init
+        idx = [j for j in self._local_files(i * self.size)
+               if j < len(self.val_files)]
         xs = np.concatenate([_load_batch_file(self.val_files[j])
                              for j in idx])
         ys = np.concatenate([self.val_labels[j * self.batch_size:
@@ -202,9 +208,20 @@ class ImageNet_data:
         from ... import native
         if native.is_nchw(x):
             return np.ascontiguousarray(x.transpose(0, 2, 3, 1))
-        if x.ndim == 4 and x.shape[0] in (1, 3):        # c01b legacy layout
+        # c01b legacy layout (C,H,W,B): channel count leads AND the trailing
+        # dim is not a channel count (else it's a small NHWC batch)
+        if x.ndim == 4 and x.shape[0] in (1, 3) and x.shape[-1] not in (1, 3):
             return np.ascontiguousarray(x.transpose(3, 1, 2, 0))
         return x
+
+    @staticmethod
+    def _mean_to_hwc(m: np.ndarray) -> np.ndarray:
+        """Normalize a 3-D mean image to (H, W, C)."""
+        if m.shape[-1] in (1, 3):
+            return m
+        if m.shape[0] in (1, 3):      # CHW (the reference's c01 mean)
+            return np.ascontiguousarray(m.transpose(1, 2, 0))
+        return m
 
     def _augment(self, x: np.ndarray, y: np.ndarray,
                  train: bool) -> Dict[str, np.ndarray]:
@@ -232,15 +249,14 @@ class ImageNet_data:
         m_img = self.img_mean
         if isinstance(m_img, np.ndarray) and m_img.size > 1:
             if m_img.ndim == 3:
+                full = self._mean_to_hwc(m_img)
                 if oy.shape[0] == 1:
-                    full = self._to_nhwc(m_img[None])[0]
                     mean = full[oy[0]:oy[0] + c, ox[0]:ox[0] + c, :]
                 else:
                     # per-image windows: use the mean image's center crop for
                     # all (window-exact per-image mean would defeat the fused
                     # pass)
                     cy, cx = (h - c) // 2, (w - c) // 2
-                    full = self._to_nhwc(m_img[None])[0]
                     mean = full[cy:cy + c, cx:cx + c, :]
             else:
                 # per-channel mean (shape (C,) or broadcastable): expand to

@@ -1,0 +1,26 @@
+"""Shared dispatch/vma helpers for the Pallas op modules."""
+
+from __future__ import annotations
+
+import os
+
+import jax
+
+
+def dispatch_pallas() -> bool:
+    """Compiled Pallas on TPU; elsewhere the jnp oracle (same semantics,
+    equality-tested) — interpret-mode Pallas can't run inside shard_map's
+    vma-checked trace, so it is reserved for the direct kernel tests.
+    ``THEANOMPI_TPU_NO_PALLAS=1`` forces the oracle everywhere."""
+    if os.environ.get("THEANOMPI_TPU_NO_PALLAS", "0") == "1":
+        return False
+    return jax.default_backend() == "tpu"
+
+
+def vma_of(*xs) -> frozenset:
+    """Union of the operands' varying-manual-axes, so pallas_call outputs
+    carry the right vma when traced inside ``shard_map(check_vma=True)``."""
+    vma: frozenset = frozenset()
+    for x in xs:
+        vma = vma | getattr(jax.typeof(x), "vma", frozenset())
+    return vma

@@ -29,13 +29,15 @@ Layout contract: input length must be a multiple of :data:`PACK_ALIGN`
 from __future__ import annotations
 
 import functools
-import os
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
+
+from ._pallas_util import dispatch_pallas as _dispatch_pallas
+from ._pallas_util import vma_of as _vma_of
 
 # 256 fp32 sublanes × 128 lanes per grid block: packs to one (8, 128) uint32
 # tile, keeping both sides of the kernel exactly tile-aligned.
@@ -49,24 +51,6 @@ def _check_len(n: int) -> None:
     assert n % PACK_ALIGN == 0, (
         f"compressed exchange needs length % {PACK_ALIGN} == 0, got {n} "
         "(flatten_tree(pad_to_multiple_of=PACK_ALIGN) upstream)")
-
-
-def _dispatch_pallas() -> bool:
-    """Compiled Pallas on TPU; elsewhere the jnp oracle (same bit layout,
-    equality-tested) — interpret-mode Pallas can't run inside shard_map's
-    vma-checked trace, so it is reserved for the direct kernel tests."""
-    if os.environ.get("THEANOMPI_TPU_NO_PALLAS", "0") == "1":
-        return False
-    return jax.default_backend() == "tpu"
-
-
-def _vma_of(*xs) -> frozenset:
-    """Union of the operands' varying-manual-axes, so pallas_call outputs
-    carry the right vma when traced inside ``shard_map(check_vma=True)``."""
-    vma: frozenset = frozenset()
-    for x in xs:
-        vma = vma | getattr(jax.typeof(x), "vma", frozenset())
-    return vma
 
 
 # ---------------------------------------------------------------------------

@@ -36,7 +36,6 @@ band-matmul is the right TPU shape for a channel-window sum.)
 from __future__ import annotations
 
 import functools
-import os
 
 import jax
 import jax.numpy as jnp
@@ -44,21 +43,11 @@ import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from ._pallas_util import dispatch_pallas as _dispatch_pallas
+from ._pallas_util import vma_of as _vma_of
+
 BLOCK_ROWS = 512       # pixel rows per grid block — fastest of the measured
                        # {256, 512, 1024, 2048} sweep at AlexNet shapes
-
-
-def _dispatch_pallas() -> bool:
-    if os.environ.get("THEANOMPI_TPU_NO_PALLAS", "0") == "1":
-        return False
-    return jax.default_backend() == "tpu"
-
-
-def _vma_of(*xs) -> frozenset:
-    vma: frozenset = frozenset()
-    for x in xs:
-        vma = vma | getattr(jax.typeof(x), "vma", frozenset())
-    return vma
 
 
 @functools.lru_cache(maxsize=None)
