@@ -5,7 +5,8 @@ The reference inherited its data prep from ``uoguelph-mlrg/theano_alexnet``:
 ImageNet resized offline to 256×256 and packed into hickle ``.hkl`` files of
 one uint8 batch each, plus a mean image (SURVEY.md §2.8).  This script
 produces the same on-disk contract from a ``class/img.jpg`` folder tree (or
-synthesizes one for pipeline testing):
+synthesizes one for pipeline testing), streaming one batch at a time — RAM
+stays O(batch) no matter the dataset size (ImageNet-1k is ~250 GB decoded).
 
     out_dir/
       train_hkl/0000.hkl ...     (or .npy without h5py)  [B, 256, 256, 3] u8
@@ -62,18 +63,20 @@ def _save_batch(path_base, batch):
         return path_base + ".npy"
 
 
-def write_split(images, labels, out_sub, batch_size, mean_acc=None):
+def write_split(loader, items, out_sub, batch_size, mean_acc=None):
+    """Stream full batches of ``items`` through ``loader`` into batch files.
+    Returns the kept labels (partial trailing batch dropped, as the
+    reference's fixed-size batch files require)."""
     os.makedirs(out_sub, exist_ok=True)
-    n_batches = len(images) // batch_size
     kept_labels = []
-    for b in range(n_batches):
-        chunk = images[b * batch_size:(b + 1) * batch_size]
-        batch = np.stack(chunk)
+    for b in range(len(items) // batch_size):
+        chunk = items[b * batch_size:(b + 1) * batch_size]
+        batch = np.stack([loader(it) for it in chunk])
         if mean_acc is not None:
             mean_acc += batch.astype(np.float64).sum(axis=0)
         _save_batch(os.path.join(out_sub, f"{b:04d}"), batch)
-        kept_labels.extend(labels[b * batch_size:(b + 1) * batch_size])
-    return np.asarray(kept_labels, np.int64), n_batches * batch_size
+        kept_labels.extend(y for _, y in chunk)
+    return np.asarray(kept_labels, np.int64)
 
 
 def main(argv=None) -> int:
@@ -91,39 +94,42 @@ def main(argv=None) -> int:
     bs = args.batch_size
 
     if args.synthetic:
-        r = np.random.RandomState(args.seed)
         n_train, n_val = args.synthetic * bs, max(bs, args.synthetic * bs // 8)
-        imgs = [r.randint(0, 256, (RAW, RAW, 3), dtype=np.uint8)
-                for _ in range(n_train + n_val)]
-        labels = list(r.randint(0, 1000, n_train + n_val))
+        r = np.random.RandomState(args.seed)
+        labels = r.randint(0, 1000, n_train + n_val)
+        # items are (row_seed, label); loader synthesizes deterministically
+        items = [((args.seed, i), int(labels[i]))
+                 for i in range(n_train + n_val)]
+
+        def loader(item):
+            (seed, i), _ = item
+            return np.random.RandomState([seed, i]).randint(
+                0, 256, (RAW, RAW, 3), dtype=np.uint8)
     else:
         if not args.src:
             p.error("--src or --synthetic required")
-        pairs = list(_iter_images(args.src))
-        r = np.random.RandomState(args.seed)
-        r.shuffle(pairs)
-        print(f"loading {len(pairs)} images from {args.src} ...")
-        imgs, labels = [], []
-        for path, y in pairs:
-            imgs.append(_load_resized(path))
-            labels.append(y)
-        n_val = max(bs, int(len(imgs) * args.val_frac) // bs * bs)
-        n_train = len(imgs) - n_val
+        items = list(_iter_images(args.src))     # (path, label) — paths only
+        np.random.RandomState(args.seed).shuffle(items)
+        n_val = max(bs, int(len(items) * args.val_frac) // bs * bs)
+        n_train = len(items) - n_val
         if n_train < bs:
-            p.error(f"{len(imgs)} images is too few for batch size {bs} "
+            p.error(f"{len(items)} images is too few for batch size {bs} "
                     f"(needs at least one train and one val batch: "
                     f">= {2 * bs} images)")
+        print(f"streaming {len(items)} images from {args.src} ...")
+
+        def loader(item):
+            return _load_resized(item[0])
 
     mean_acc = np.zeros((RAW, RAW, 3), np.float64)
-    tr_labels, n_tr = write_split(imgs[:n_train], labels[:n_train],
-                                  os.path.join(args.out, "train_hkl"), bs,
-                                  mean_acc)
-    va_labels, _ = write_split(imgs[n_train:], labels[n_train:],
-                               os.path.join(args.out, "val_hkl"), bs)
+    tr_labels = write_split(loader, items[:n_train],
+                            os.path.join(args.out, "train_hkl"), bs, mean_acc)
+    va_labels = write_split(loader, items[n_train:],
+                            os.path.join(args.out, "val_hkl"), bs)
     np.save(os.path.join(args.out, "train_labels.npy"), tr_labels)
     np.save(os.path.join(args.out, "val_labels.npy"), va_labels)
     np.save(os.path.join(args.out, "img_mean.npy"),
-            (mean_acc / max(n_tr, 1)).astype(np.float32))
+            (mean_acc / max(len(tr_labels), 1)).astype(np.float32))
     print(f"wrote {args.out}: {len(tr_labels)} train / {len(va_labels)} val "
           f"images in {bs}-image batch files")
     return 0

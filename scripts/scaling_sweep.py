@@ -1,0 +1,123 @@
+#!/usr/bin/env python
+"""Scaling-efficiency sweep — the reference paper's table shape.
+
+Theano-MPI's headline results are "time per 5120 images" tables per worker
+count × exchange strategy (SURVEY.md §6).  This reproduces that table shape:
+for each worker count (powers of two up to the visible chips) and strategy,
+train a few steady-state iterations and report time-per-5120, images/sec,
+and scaling efficiency vs 1 worker.
+
+On real multi-chip TPU hardware this is the BASELINE.json scaling-efficiency
+measurement; on the CPU-simulated mesh (TMPI_FORCE_CPU=1) the numbers only
+demonstrate the harness, not hardware scaling.
+
+Usage:
+  python scripts/scaling_sweep.py [--model cifar10] [--strategies allreduce ring]
+       [--iters 20] [--batch-size 128]
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+if os.environ.get("TMPI_FORCE_CPU"):
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8").strip()
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+MODELS = {
+    "cifar10": ("theanompi_tpu.models.cifar10", "Cifar10_model",
+                {"synthetic_train": 8192}),
+    "alexnet": ("theanompi_tpu.models.alex_net", "AlexNet",
+                {"synthetic_batches": 4}),
+    "vgg16": ("theanompi_tpu.models.vggnet_16", "VGGNet_16",
+              {"synthetic_batches": 4}),
+}
+
+
+def measure(modelfile, modelclass, extra, n_workers, strategy, batch_size,
+            iters, warmup):
+    import importlib
+
+    import jax
+    import jax.numpy as jnp
+
+    from theanompi_tpu.parallel import steps
+    from theanompi_tpu.parallel.exchanger import BSP_Exchanger
+    from theanompi_tpu.parallel.mesh import worker_mesh
+
+    mesh = worker_mesh(n_workers)
+    config = {"mesh": mesh, "size": n_workers, "verbose": False,
+              "exch_strategy": strategy, "batch_size": batch_size, **extra}
+    model = getattr(importlib.import_module(modelfile), modelclass)(config)
+    model.compile_iter_fns(BSP_Exchanger(config))
+    batch = model.data.next_train_batch(0)
+    dev = steps.put_batch(mesh, batch)
+    n_images = int(batch["y"].shape[0])
+    lr, rng = jnp.float32(model.current_lr), jax.random.key(0)
+    st = model.step_state
+    for i in range(warmup):
+        st, c, e = model.train_fn(st, dev, lr, rng, jnp.int32(i))
+    jax.block_until_ready(st["params"])
+    t0 = time.time()
+    for i in range(iters):
+        st, c, e = model.train_fn(st, dev, lr, rng, jnp.int32(warmup + i))
+    jax.block_until_ready(st["params"])
+    dt = time.time() - t0
+    ips = n_images * iters / dt
+    return {"workers": n_workers, "strategy": strategy,
+            "images_per_sec": round(ips, 1),
+            "images_per_sec_per_chip": round(ips / n_workers, 1),
+            "time_per_5120": round(5120.0 / ips, 3)}
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--model", default="cifar10", choices=sorted(MODELS))
+    p.add_argument("--strategies", nargs="*",
+                   default=["allreduce", "ring", "nccl16"])
+    p.add_argument("--batch-size", type=int, default=128,
+                   help="per-worker batch (reference style)")
+    p.add_argument("--iters", type=int, default=20)
+    p.add_argument("--warmup", type=int, default=5)
+    p.add_argument("--json", action="store_true", help="JSONL output")
+    args = p.parse_args(argv)
+
+    import jax
+    n_dev = len(jax.devices())
+    counts = [c for c in (1, 2, 4, 8, 16, 32) if c <= n_dev]
+    modelfile, modelclass, extra = MODELS[args.model]
+
+    base_ips = {}
+    rows = []
+    for strategy in args.strategies:
+        for n in counts:
+            r = measure(modelfile, modelclass, extra, n, strategy,
+                        args.batch_size, args.iters, args.warmup)
+            key = strategy
+            if n == 1:
+                base_ips[key] = r["images_per_sec"]
+            eff = r["images_per_sec"] / (base_ips[key] * n) \
+                if base_ips.get(key) else float("nan")
+            r["scaling_efficiency"] = round(eff, 3)
+            rows.append(r)
+            if args.json:
+                print(json.dumps(r), flush=True)
+            else:
+                print(f"{args.model} {strategy:>10} x{n}: "
+                      f"{r['images_per_sec']:>9.1f} img/s "
+                      f"({r['images_per_sec_per_chip']:>8.1f}/chip) | "
+                      f"{r['time_per_5120']:>7.3f} s/5120 | "
+                      f"eff {eff:5.1%}", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -54,6 +54,26 @@ def test_session_devices_overcommit_raises():
         rule.wait()
 
 
+def test_warmup_ramps_scaled_lr():
+    """warmup_epochs linearly ramps the scale_lr factor; default (0) keeps
+    the reference's instant linear scaling."""
+    from tests.conftest import TinyModel
+
+    m = TinyModel({"verbose": False, "n_workers": 1, "warmup_epochs": 4,
+                   "learning_rate": 0.01})
+    m.scale_lr(8)
+    ramp = []
+    for e in range(5):
+        m.adjust_hyperp(e)
+        ramp.append(round(m.current_lr, 4))
+    assert ramp == [0.0275, 0.045, 0.0625, 0.08, 0.08], ramp
+
+    m2 = TinyModel({"verbose": False, "n_workers": 1, "learning_rate": 0.01})
+    m2.scale_lr(8)
+    m2.adjust_hyperp(0)
+    assert abs(m2.current_lr - 0.08) < 1e-9
+
+
 def test_prng_impl_config_applies():
     import jax
     from theanompi_tpu.base import MeshProcess

@@ -191,7 +191,8 @@ class ImageNet_data:
         i = self._val_ptr % self.n_batch_val
         self._val_ptr += 1
         # single-host tolerates fewer val files than workers (short final
-        # batch still splits across the mesh); multi-host asserts at init
+        # batch, trimmed below so it still splits across the mesh);
+        # multi-host asserts at init
         idx = [j for j in self._local_files(i * self.size)
                if j < len(self.val_files)]
         xs = np.concatenate([_load_batch_file(self.val_files[j])
@@ -199,8 +200,11 @@ class ImageNet_data:
         ys = np.concatenate([self.val_labels[j * self.batch_size:
                                              (j + 1) * self.batch_size]
                              for j in idx])
-        return self._augment(self._to_nhwc(xs), ys.astype(np.int32),
-                             train=False)
+        keep = (len(ys) // self.size) * self.size
+        assert keep > 0, (f"{len(ys)} val images can't split across "
+                          f"{self.size} workers")
+        return self._augment(self._to_nhwc(xs[:keep]),
+                             ys[:keep].astype(np.int32), train=False)
 
     @staticmethod
     def _to_nhwc(x: np.ndarray) -> np.ndarray:

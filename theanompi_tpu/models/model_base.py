@@ -217,12 +217,22 @@ class ModelBase:
 
     def adjust_hyperp(self, epoch: int) -> None:
         """Step LR decay (÷10 at the epochs in ``lr_adjust_epochs``) — the
-        schedule style every reference zoo model used."""
+        schedule style every reference zoo model used.
+
+        ``warmup_epochs`` (config, default 0 = reference behavior) ramps the
+        LR-scale factor linearly over the first epochs: the reference's
+        linear ``scale_lr(size)`` rule applied instantly, which at high
+        worker counts diverges before the first decay (Goyal et al.'s
+        gradual-warmup fix postdates it)."""
         lr = float(self.learning_rate)
         for e in self.lr_adjust_epochs:
             if epoch >= e:
                 lr /= 10.0
-        self.current_lr = lr * self._lr_scale
+        scale = self._lr_scale
+        warmup = int(self.config.get("warmup_epochs", 0))
+        if warmup > 0 and epoch < warmup and scale > 1.0:
+            scale = 1.0 + (scale - 1.0) * (epoch + 1) / warmup
+        self.current_lr = lr * scale
 
     _lr_scale: float = 1.0
 
