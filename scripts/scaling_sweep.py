@@ -82,7 +82,10 @@ def main(argv=None) -> int:
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--model", default="cifar10", choices=sorted(MODELS))
     p.add_argument("--strategies", nargs="*",
-                   default=["allreduce", "ring", "nccl16"])
+                   default=["allreduce", "ring", "nccl16"],
+                   choices=["allreduce", "ar", "nccl32", "nccl16", "bf16",
+                            "ring", "ring16", "asa32", "asa16", "copper",
+                            "copper16", "onebit", "compressed", "topk"])
     p.add_argument("--batch-size", type=int, default=128,
                    help="per-worker batch (reference style)")
     p.add_argument("--iters", type=int, default=20)
@@ -92,7 +95,10 @@ def main(argv=None) -> int:
 
     import jax
     n_dev = len(jax.devices())
-    counts = [c for c in (1, 2, 4, 8, 16, 32) if c <= n_dev]
+    counts, c = [], 1
+    while c <= n_dev:
+        counts.append(c)
+        c *= 2
     modelfile, modelclass, extra = MODELS[args.model]
 
     base_ips = {}

@@ -54,6 +54,20 @@ def test_session_devices_overcommit_raises():
         rule.wait()
 
 
+def test_reference_import_alias_runs_a_session():
+    """A reference-style session script — ``from theanompi import BSP`` with
+    a ``theanompi.models.*`` modelfile string — must run unmodified."""
+    from theanompi import BSP as RefBSP
+
+    rule = RefBSP()
+    rule.init(devices=2, modelfile="theanompi.models.cifar10",
+              modelclass="Cifar10_model", epochs=1, synthetic_train=64,
+              synthetic_val=32, batch_size=8, compute_dtype="float32",
+              verbose=False, scale_lr=False)
+    rec = rule.wait()
+    assert np.isfinite(rec.epoch_records[-1]["val_cost"])
+
+
 def test_warmup_ramps_scaled_lr():
     """warmup_epochs linearly ramps the scale_lr factor; default (0) keeps
     the reference's instant linear scaling."""

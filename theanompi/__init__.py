@@ -1,0 +1,11 @@
+"""``theanompi`` — drop-in import alias for ``theanompi_tpu``.
+
+The reference's session scripts start with ``from theanompi import BSP``
+(SURVEY.md §2.6); this alias package lets those scripts run against the
+TPU-native rebuild without edits.  Everything is re-exported from
+:mod:`theanompi_tpu` — see that package for the real implementation.
+"""
+
+from theanompi_tpu import ASGD, BSP, EASGD, GOSGD, SyncRule, __version__
+
+__all__ = ["BSP", "EASGD", "ASGD", "GOSGD", "SyncRule", "__version__"]

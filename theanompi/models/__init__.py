@@ -1,0 +1,22 @@
+"""Alias of :mod:`theanompi_tpu.models` so reference-style ``modelfile``
+strings (``'theanompi.models.alex_net'``) resolve via importlib.
+
+Registering the real modules in ``sys.modules`` under the alias names makes
+``importlib.import_module('theanompi.models.<m>')`` return them directly
+(the import system consults ``sys.modules`` before searching the package
+path)."""
+
+import importlib
+import sys
+
+_SUBMODULES = (
+    "model_base", "layers", "cifar10", "alex_net", "googlenet",
+    "vggnet_16", "vggnet_11_shallow", "resnet50", "gan", "wgan", "lsgan",
+    "data", "data.cifar10", "data.imagenet", "data.prefetch",
+)
+
+for _m in _SUBMODULES:
+    sys.modules[f"{__name__}.{_m}"] = importlib.import_module(
+        f"theanompi_tpu.models.{_m}")
+
+from theanompi_tpu.models import *          # noqa: F401,F403,E402

@@ -112,7 +112,13 @@ class DataBase:
         i = self._val_ptr % self.n_batch_val
         self._val_ptr += 1
         sl = self._local(i * self.global_batch)
-        return self._make_batch(self.x_val[sl], self.y_val[sl], train=False)
+        x, y = self.x_val[sl], self.y_val[sl]
+        # single-host short final batch: trim to a worker-divisible row count
+        # (the mesh splits axis 0 across `size` workers)
+        keep = (len(y) // self.size) * self.size
+        assert keep > 0, (f"{len(y)} val rows can't split across "
+                          f"{self.size} workers")
+        return self._make_batch(x[:keep], y[:keep], train=False)
 
     def _make_batch(self, x, y, train: bool) -> Dict[str, np.ndarray]:
         """Hook for augmentation; default: cast only."""
