@@ -1,0 +1,7 @@
+"""Alias: ``python -m theanompi.launcher`` ≙ ``theanompi_tpu.launcher``."""
+
+from theanompi_tpu.launcher import *          # noqa: F401,F403
+from theanompi_tpu.launcher import main       # noqa: F401
+
+if __name__ == "__main__":
+    raise SystemExit(main())

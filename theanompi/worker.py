@@ -1,0 +1,8 @@
+"""Alias: ``python -m theanompi.worker`` ≙ the reference's per-rank worker
+entry (``mpirun ... python -u -m theanompi.worker`` lines keep working)."""
+
+from theanompi_tpu.worker import *            # noqa: F401,F403
+from theanompi_tpu.worker import WORKERS, main  # noqa: F401
+
+if __name__ == "__main__":
+    raise SystemExit(main())
