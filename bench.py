@@ -112,7 +112,9 @@ def main() -> int:
     out = {
         "metric": f"images_per_sec_per_chip ({model_name} batch "
                   f"{model.batch_size} {rule.upper()}, {n_chips} chip(s), "
-                  f"{jax.devices()[0].platform}, prng={prng or 'default'})",
+                  f"{jax.devices()[0].platform}, prng={prng or 'default'}; "
+                  f"vs_baseline is vs ESTIMATED-K80 {K80_ALEXNET_IPS:.0f} "
+                  f"img/s, not a measured reference)",
         "value": round(ips_chip, 2),
         "unit": "images/sec/chip",
         "vs_baseline": round(ips_chip / K80_ALEXNET_IPS, 3),

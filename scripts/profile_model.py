@@ -1,0 +1,100 @@
+#!/usr/bin/env python
+"""Capture a TPU profiler trace of one model's training step and print the
+top HLO ops by self time.
+
+The reference's perf story was wall-clock section buckets (SURVEY.md §2.10);
+on TPU the per-op breakdown comes from XLA's profiler.  This script is the
+bottleneck-analysis harness behind BASELINE.md's MFU table.
+
+Usage: python scripts/profile_model.py [model] [batch] [iters]
+Env: PROFILE_DIR (default /tmp/tpu_profile)
+"""
+
+import glob
+import gzip
+import json
+import os
+import sys
+
+
+def main():
+    model_name = sys.argv[1] if len(sys.argv) > 1 else "resnet50"
+    batch = int(sys.argv[2]) if len(sys.argv) > 2 else 0
+    iters = int(sys.argv[3]) if len(sys.argv) > 3 else 10
+    trace_dir = os.environ.get("PROFILE_DIR", f"/tmp/tpu_profile_{model_name}")
+
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    import jax
+    import jax.numpy as jnp
+    import importlib
+    from bench import MODELS
+    from theanompi_tpu.parallel.exchanger import get_exchanger
+    from theanompi_tpu.parallel.mesh import WORKER_AXIS, worker_mesh
+    from theanompi_tpu.parallel import steps
+
+    jax.config.update("jax_default_prng_impl", "rbg")
+    mesh = worker_mesh()
+    modelfile, modelclass, extra = MODELS[model_name]
+    config = {"mesh": mesh, "size": mesh.shape[WORKER_AXIS], "rank": 0,
+              "verbose": False, **extra}
+    if batch:
+        config["batch_size"] = batch
+    model = getattr(importlib.import_module(modelfile), modelclass)(config)
+    exchanger = get_exchanger("bsp", config)
+    model.compile_iter_fns(exchanger)
+    dev_batch = steps.put_batch(mesh, model.data.next_train_batch(0))
+    lr = jnp.float32(model.current_lr)
+    rng = jax.random.key(0)
+
+    def step(i):
+        model.step_state, cost, err = model.train_fn(
+            model.step_state, dev_batch, lr, rng, jnp.int32(i))
+
+    for i in range(5):
+        step(i)
+    jax.block_until_ready(model.step_state["params"])
+
+    jax.profiler.start_trace(trace_dir)
+    for i in range(iters):
+        step(5 + i)
+    jax.block_until_ready(model.step_state["params"])
+    jax.profiler.stop_trace()
+
+    xplanes = glob.glob(os.path.join(trace_dir, "plugins/profile/*/*.xplane.pb"))
+    if not xplanes:
+        print("no xplane capture found", file=sys.stderr)
+        return 1
+    xplane = max(xplanes, key=os.path.getmtime)
+
+    from tensorboard_plugin_profile.convert import raw_to_tool_data as rtd
+    data, _ = rtd.xspace_to_tool_data([xplane], "framework_op_stats", {})
+    if isinstance(data, bytes):
+        data = data.decode()
+    table = json.loads(data)
+    # framework_op_stats: [ {…gviz table…} ] — rows of per-op totals
+    rows = []
+    for t in table:
+        cols = [c["label"] for c in t.get("cols", [])]
+        if "Total self-time (us)" not in cols and "total_self_time" not in str(cols).lower():
+            continue
+        for r in t.get("rows", []):
+            vals = [c.get("v") for c in r["c"]]
+            rows.append(dict(zip(cols, vals)))
+    if not rows:
+        # fallback: dump whatever structure came back
+        print(json.dumps(table)[:4000])
+        return 0
+    key = [c for c in rows[0] if "self-time" in c.lower() and "total" in c.lower()][0]
+    rows.sort(key=lambda r: -(r.get(key) or 0))
+    total = sum(r.get(key) or 0 for r in rows)
+    print(f"== {model_name} batch {model.batch_size}: top ops by self time "
+          f"({iters} steps, total {total/1e3:.1f} ms) ==")
+    namecol = [c for c in rows[0] if c.lower() in ("operation", "op name", "type")]
+    for r in rows[:25]:
+        name = " | ".join(str(r.get(c)) for c in rows[0] if isinstance(r.get(c), str))
+        print(f"{(r.get(key) or 0)/1e3:9.2f} ms  {100*(r.get(key) or 0)/max(total,1):5.1f}%  {name[:110]}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

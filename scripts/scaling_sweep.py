@@ -91,6 +91,9 @@ def main(argv=None) -> int:
     p.add_argument("--iters", type=int, default=20)
     p.add_argument("--warmup", type=int, default=5)
     p.add_argument("--json", action="store_true", help="JSONL output")
+    p.add_argument("--measure-comm", action="store_true",
+                   help="add a comm-share column per strategy (differences "
+                        "each fused step against the 'none' strategy)")
     args = p.parse_args(argv)
 
     import jax
@@ -100,6 +103,20 @@ def main(argv=None) -> int:
         counts.append(c)
         c *= 2
     modelfile, modelclass, extra = MODELS[args.model]
+
+    # measure_comm (the reference's t_train/t_comm decomposition, SURVEY §6):
+    # the fused BSP step hides the collective inside one XLA program, so
+    # comm share is recovered by differencing against the 'none' strategy
+    # (same elementwise work, no collective) at each worker count.
+    base_step = {}
+    if args.measure_comm:
+        for n in counts:
+            if n == 1:
+                base_step[n] = None     # no comm at 1 worker by definition
+                continue
+            r0 = measure(modelfile, modelclass, extra, n, "none",
+                         args.batch_size, args.iters, args.warmup)
+            base_step[n] = r0["time_per_5120"]
 
     base_ips = {}
     rows = []
@@ -113,6 +130,15 @@ def main(argv=None) -> int:
             eff = r["images_per_sec"] / (base_ips[key] * n) \
                 if base_ips.get(key) else float("nan")
             r["scaling_efficiency"] = round(eff, 3)
+            comm_txt = ""
+            if args.measure_comm:
+                if base_step.get(n):
+                    share = max(0.0, 1.0 - base_step[n] / r["time_per_5120"])
+                    r["comm_share"] = round(share, 3)
+                    comm_txt = f" | comm {share:5.1%}"
+                else:
+                    r["comm_share"] = 0.0
+                    comm_txt = " | comm   n/a"
             rows.append(r)
             if args.json:
                 print(json.dumps(r), flush=True)
@@ -121,7 +147,7 @@ def main(argv=None) -> int:
                       f"{r['images_per_sec']:>9.1f} img/s "
                       f"({r['images_per_sec_per_chip']:>8.1f}/chip) | "
                       f"{r['time_per_5120']:>7.3f} s/5120 | "
-                      f"eff {eff:5.1%}", flush=True)
+                      f"eff {eff:5.1%}{comm_txt}", flush=True)
     return 0
 
 

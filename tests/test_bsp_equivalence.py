@@ -55,16 +55,55 @@ def test_bsp_replicas_stay_identical():
             np.testing.assert_array_equal(leaf[w], leaf[0])
 
 
-def test_bsp_params_mode_matches_grads_mode_loosely():
-    """Post-step parameter averaging (reference-exact cadence) tracks the
-    fused-gradient mode to first order.  The two are NOT identical — params
-    mode keeps per-worker momentum — so the comparison is scale-relative."""
-    pg = _train(4, 8, exch_mode="grads")
-    pp = _train(4, 8, exch_mode="params")
-    for a, b in zip(jax.tree_util.tree_leaves(pg),
-                    jax.tree_util.tree_leaves(pp)):
-        scale = np.abs(a).mean() + 1e-6
-        assert np.abs(a - b).mean() / scale < 0.25
+def test_bsp_params_mode_exact_oracle():
+    """Pin params-mode semantics exactly: each worker takes a LOCAL momentum
+    step on its own shard's gradient, then parameters (not velocities) are
+    averaged across workers.  The oracle recomputes both steps independently
+    — per-worker grads via plain ``jax.grad`` (no mesh, no exchanger), the
+    momentum algebra and the average in NumPy."""
+    import jax.numpy as jnp
+    from tests.conftest import SyntheticData
+    from theanompi_tpu.models import layers as L
+
+    n, bs = 2, 8
+    mesh = worker_mesh(n)
+    config = {"mesh": mesh, "size": n, "rank": 0, "verbose": False,
+              "batch_size": bs, "exch_mode": "params"}
+    model = TinyModel(config)
+    exch = BSP_Exchanger(config)
+    model.compile_iter_fns(exch)
+    model.data.shuffle_data(0)
+
+    params0 = jax.device_get(model.params)
+    oracle = [jax.tree.map(np.array, params0) for _ in range(n)]
+    vel = [jax.tree.map(np.zeros_like, params0) for _ in range(n)]
+    data = SyntheticData({"size": n}, batch_size=bs)
+    data.shuffle_data(0)
+    lr, mu = model.current_lr, model.momentum
+    assert model.weight_decay == 0.0  # keeps the oracle algebra minimal
+
+    def loss_fn(p, x, y):
+        logits, _ = model.seq.apply(p, x, train=True, state={})
+        return L.softmax_cross_entropy(logits, y)
+
+    for step in range(1, 3):
+        batch = data.next_train_batch(step)
+        model.train_iter(step, None)
+        exch.exchange(None, step)
+        for w in range(n):
+            xw = jnp.asarray(batch["x"][w * bs:(w + 1) * bs])
+            yw = jnp.asarray(batch["y"][w * bs:(w + 1) * bs])
+            g = jax.device_get(jax.grad(loss_fn)(
+                jax.tree.map(jnp.asarray, oracle[w]), xw, yw))
+            vel[w] = jax.tree.map(lambda v, gg: mu * v - lr * gg, vel[w], g)
+            oracle[w] = jax.tree.map(lambda p, v: p + v, oracle[w], vel[w])
+        avg = jax.tree.map(lambda *xs: np.mean(np.stack(xs), axis=0), *oracle)
+        oracle = [jax.tree.map(np.array, avg) for _ in range(n)]
+
+    got = jax.device_get(steps.unbox(model.step_state["params"]))
+    for a, b in zip(jax.tree_util.tree_leaves(avg),
+                    jax.tree_util.tree_leaves(got)):
+        np.testing.assert_allclose(a, b, rtol=2e-6, atol=1e-7)
 
 
 def test_bsp_params_mode_replicas_identical_after_exchange():

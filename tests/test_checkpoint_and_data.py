@@ -44,10 +44,8 @@ def test_checkpoint_resume_roundtrip(tmp_path):
     for a, b in zip(jax.tree_util.tree_leaves(p_saved),
                     jax.tree_util.tree_leaves(p_loaded)):
         np.testing.assert_array_equal(a, b)
-    # resumed model must keep training identically to the original
-    # (align the data cursor — resume semantics are epoch-granular)
-    for _ in range(3):
-        m2.data.next_train_batch(0)
+    # resumed model must keep training identically to the original — the
+    # checkpoint carries the data cursor, so no manual realignment
     m1.train_iter(4, None)
     m2.train_iter(4, None)
     for a, b in zip(
@@ -56,6 +54,90 @@ def test_checkpoint_resume_roundtrip(tmp_path):
             jax.tree_util.tree_leaves(
                 jax.device_get(steps.unbox(m2.step_state["params"])))):
         np.testing.assert_allclose(a, b, rtol=1e-6, atol=1e-7)
+
+
+def _train_loop(m, exch, counts):
+    """Reference worker cadence: train_iter then the rule's exchange hook."""
+    for c in counts:
+        m.train_iter(c, None)
+        exch.exchange(None, c)
+
+
+@pytest.mark.parametrize("rule", ["bsp", "gosgd"])
+def test_exact_resume_across_kill(tmp_path, rule):
+    """Deterministic replay must survive a save/kill/resume boundary
+    bit-identically (VERDICT: checkpoint completeness) — including the
+    per-worker diverged replicas, GoSGD α, both PRNG keys, and the data
+    cursor, mid-epoch."""
+    from theanompi_tpu.parallel.exchanger import get_exchanger
+    d = str(tmp_path / "ckpt")
+    n = 4
+
+    def make():
+        mesh = worker_mesh(n)
+        config = {"mesh": mesh, "size": n, "rank": 0, "verbose": False,
+                  "batch_size": 8, "exch_prob": 1.0}
+        m = TinyModel(config)
+        exch = get_exchanger(rule, config)
+        m.compile_iter_fns(exch)
+        return m, exch
+
+    # uninterrupted run: 6 iterations
+    mA, eA = make()
+    mA.data.shuffle_data(0)
+    _train_loop(mA, eA, range(1, 7))
+    ref = jax.device_get(mA.step_state)
+
+    # interrupted run: 3 iterations, save mid-epoch, "kill", rebuild, resume
+    mB, eB = make()
+    mB.data.shuffle_data(0)
+    _train_loop(mB, eB, range(1, 4))
+    mB.save(d, epoch=0, count=3)
+    del mB, eB
+
+    mC, eC = make()
+    assert mC.load(d) == 0
+    _train_loop(mC, eC, range(4, 7))
+    got = jax.device_get(mC.step_state)
+    for key in ref:
+        for a, b in zip(jax.tree_util.tree_leaves(ref[key]),
+                        jax.tree_util.tree_leaves(got[key])):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_prefetch_cursor_tracks_consumer():
+    """The prefetch producer runs ahead; get_cursor must report the CONSUMED
+    position, and a fresh loader resumed from it continues identically."""
+    base = SyntheticData({"size": 2}, batch_size=8)
+    w = PrefetchLoader(SyntheticData({"size": 2}, batch_size=8))
+    base.shuffle_data(5)
+    w.shuffle_data(5)
+    for i in range(3):
+        np.testing.assert_array_equal(base.next_train_batch(i)["x"],
+                                      w.next_train_batch(i)["x"])
+    assert w.get_cursor()["train_ptr"] == base.get_cursor()["train_ptr"] == 3
+
+    w2 = PrefetchLoader(SyntheticData({"size": 2}, batch_size=8))
+    w2.set_cursor(w.get_cursor())
+    np.testing.assert_array_equal(base.next_train_batch(3)["x"],
+                                  w2.next_train_batch(3)["x"])
+
+
+def test_imagenet_cursor_restores_aug_stream():
+    """ImageNet augmentation draws from a stateful RandomState; the cursor
+    must capture it so crops/mirrors replay exactly after resume."""
+    from theanompi_tpu.models.data.imagenet import ImageNet_data
+    cfg = {"size": 1, "synthetic_batches": 4}
+    d1 = ImageNet_data(cfg, batch_size=4)
+    d1.shuffle_data(1)
+    for i in range(2):
+        d1.next_train_batch(i)
+    cur = d1.get_cursor()
+    a = d1.next_train_batch(2)
+    d2 = ImageNet_data(cfg, batch_size=4)
+    d2.set_cursor(cur)
+    b = d2.next_train_batch(2)
+    np.testing.assert_array_equal(a["x"], b["x"])
 
 
 def test_checkpoint_latest_and_missing(tmp_path):
@@ -136,6 +218,57 @@ def test_prefetch_loader_equivalence():
     assert wrapped.n_batch_train == direct.n_batch_train
 
 
+def test_prefetch_overlaps_slow_io_with_compute():
+    """The point of para_load (SURVEY.md §2.8): loader latency must hide
+    behind compute.  Producer costs 30ms/batch; consumer 'computes' 45ms;
+    with depth-2 prefetch the summed load-wait must be a fraction of the
+    serial 6×30ms."""
+    import time
+
+    class SlowData(SyntheticData):
+        def next_train_batch(self, count):
+            time.sleep(0.03)
+            return super().next_train_batch(count)
+
+    w = PrefetchLoader(SlowData({"size": 1}, batch_size=8))
+    w.shuffle_data(0)
+    t_load = 0.0
+    for i in range(6):
+        t0 = time.perf_counter()
+        w.next_train_batch(i + 1)
+        t_load += time.perf_counter() - t0
+        time.sleep(0.045)            # stand-in for the training step
+    # serial loading would cost 6×30ms = 180ms of load wait; require clear
+    # overlap but leave headroom for CI scheduler noise
+    assert t_load < 0.75 * 6 * 0.03, f"load wait {t_load:.3f}s — no overlap"
+
+
+def test_para_load_stages_batches_onto_device():
+    """With para_load=True the producer thread device_puts batches; the
+    training loop must consume device-resident arrays (t_load' covers only
+    the queue get) and still train correctly."""
+    import jax.numpy as jnp
+    m = _model(para_load=True)
+    r = Recorder({"verbose": False, "printFreq": 1})
+    m.data.shuffle_data(0)
+    b = m.data.next_train_batch(1)
+    assert isinstance(jax.tree_util.tree_leaves(b)[0], jax.Array)
+    m.data.set_cursor(m.data.get_cursor())   # restart producer at ptr=1
+    for i in range(2, 5):
+        m.train_iter(i, r)
+    assert np.isfinite(float(jnp.mean(np.asarray(m.current_info["cost"]))))
+    # equivalence with the unwrapped path
+    m2 = _model()
+    m2.data.shuffle_data(0)
+    m2.data.next_train_batch(1)
+    for i in range(2, 5):
+        m2.train_iter(i, None)
+    for a, b in zip(
+            jax.tree_util.tree_leaves(jax.device_get(m.step_state["params"])),
+            jax.tree_util.tree_leaves(jax.device_get(m2.step_state["params"]))):
+        np.testing.assert_array_equal(a, b)
+
+
 def test_prefetch_loader_surfaces_errors():
     class Boom(SyntheticData):
         def next_train_batch(self, count):
@@ -198,6 +331,18 @@ def test_recorder_accounting(tmp_path):
     assert rec["val_error"] == 0.4
     r.save()
     assert os.path.exists(os.path.join(str(tmp_path), "inforec_rank0.jsonl"))
+
+
+def test_sync_each_iter_writes_wait_bucket():
+    """In blocking mode t_train (dispatch) + t_wait (device-bound block) sum
+    to wall time — the wait bucket must actually be written (VERDICT: it had
+    no writer anywhere)."""
+    m = _model(sync_each_iter=True)
+    r = Recorder({"verbose": False, "printFreq": 1})
+    m.train_iter(1, r)
+    assert "wait" in r.t_sec_total
+    assert r.t_sec_total["wait"] >= 0.0
+    assert r.t_sec_total["train"] > 0.0
 
 
 def test_recorder_accepts_device_scalars():

@@ -86,10 +86,11 @@ def test_asgd_pull_resets_workers_to_center():
             np.testing.assert_allclose(pl[w], cl, rtol=1e-6, atol=1e-7)
 
 
-def test_gosgd_alpha_sum_conserved():
+@pytest.mark.parametrize("peers", ["perm", "shift"])
+def test_gosgd_alpha_sum_conserved(peers):
     """GoSGD's Σα invariant (mixing weights are redistributed, never created
-    or destroyed)."""
-    model, exch = _setup(GOSGD_Exchanger, exch_prob=0.9)
+    or destroyed) — in both peer-assignment modes."""
+    model, exch = _setup(GOSGD_Exchanger, exch_prob=0.9, gosgd_peers=peers)
     for i in range(6):
         model.train_iter(i + 1, None)
         exch.exchange(None, i + 1)
@@ -97,6 +98,23 @@ def test_gosgd_alpha_sum_conserved():
             jax.device_get(model.step_state["extra"]["alpha"]))
         np.testing.assert_allclose(alpha.sum(), 8.0, rtol=1e-5)
         assert (alpha > 0).all()
+
+
+def test_gosgd_perm_mode_routes_bijectively():
+    """Every exchange must deliver each sent message to exactly one receiver
+    — conservation of the α-weighted params sum under pure gossip (no
+    training steps between exchanges)."""
+    model, exch = _setup(GOSGD_Exchanger, exch_prob=1.0, gosgd_peers="perm")
+    def weighted_sum(state):
+        a = np.asarray(jax.device_get(state["extra"]["alpha"]))
+        leaves = jax.tree_util.tree_leaves(jax.device_get(state["params"]))
+        return sum((l * a.reshape((-1,) + (1,) * (l.ndim - 1))).sum(0).sum()
+                   for l in leaves)
+    before = weighted_sum(model.step_state)
+    for i in range(4):
+        exch.exchange(None, i + 1)
+    after = weighted_sum(model.step_state)
+    np.testing.assert_allclose(after, before, rtol=1e-4)
 
 
 def test_gosgd_gossip_mixes_replicas():

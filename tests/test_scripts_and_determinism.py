@@ -47,6 +47,26 @@ def test_prep_train_plot_chain(tmp_path):
     assert os.path.exists(os.path.join(rec_dir, "curves.png"))
 
 
+def test_scaling_sweep_comm_share(tmp_path):
+    """--measure-comm must surface a comm_share column per strategy row by
+    differencing the fused step against the 'none' strategy (the reference's
+    t_train/t_comm table decomposition, SURVEY.md §6)."""
+    import json
+    env = dict(os.environ, TMPI_FORCE_CPU="1")
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts/scaling_sweep.py"),
+         "--model", "cifar10", "--strategies", "allreduce",
+         "--iters", "2", "--warmup", "1", "--batch-size", "8",
+         "--json", "--measure-comm"],
+        capture_output=True, text=True, env=env)
+    assert r.returncode == 0, r.stderr
+    rows = [json.loads(l) for l in r.stdout.splitlines()
+            if l.startswith("{")]
+    assert rows, r.stdout
+    assert all("comm_share" in row for row in rows)
+    assert any(row["workers"] > 1 for row in rows)
+
+
 def test_deterministic_replay():
     """Two runs with identical seeds/config must be bit-identical — the
     deterministic-replay guarantee the reference could not make."""

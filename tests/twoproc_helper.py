@@ -1,0 +1,41 @@
+"""Subprocess body for the 2-process jax.distributed test (not a test file).
+
+Each process owns 2 virtual CPU devices; together they form a 4-worker
+global mesh.  Exercises the REAL multi-host path end to end:
+``init_multihost`` (jax.distributed bring-up), per-host data loading
+(``DataBase`` slices by ``jax.process_index()``), ``make_per_host_array``
+stitching inside ``steps.put_batch``, one compiled BSP train step, and the
+multi-host checkpoint gather (``steps.tree_to_host``).
+
+Prints one JSON line with a params fingerprint; the parent test asserts both
+processes agree AND match a single-process 4-worker oracle run.
+"""
+
+import json
+import os
+import sys
+
+
+def main() -> int:
+    proc_id = int(sys.argv[1])
+    port = sys.argv[2]
+
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    from theanompi_tpu.parallel.mesh import init_multihost
+
+    init_multihost(f"localhost:{port}", 2, proc_id)
+    assert jax.process_count() == 2, jax.process_count()
+    assert len(jax.devices()) == 4
+
+    from tests.twoproc_model import fingerprint_after_steps
+    fp = fingerprint_after_steps(n_workers=4)
+    print("FP " + json.dumps({"proc": proc_id, **fp}), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,65 @@
+"""Shared model body for the 2-process distributed test (not a test file).
+
+Standalone on purpose: must be importable from the spawned subprocesses
+WITHOUT pulling in ``tests.conftest`` (which pins 8 virtual devices and
+single-process mode).
+"""
+
+from __future__ import annotations
+
+
+def fingerprint_after_steps(n_workers: int, n_steps: int = 2) -> dict:
+    """Run ``n_steps`` BSP iterations on a tiny MLP over ``n_workers`` and
+    return a params fingerprint (per-leaf sums + first elements) computed
+    from the gathered global state."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from theanompi_tpu.models import layers as L
+    from theanompi_tpu.models.data import DataBase
+    from theanompi_tpu.models.model_base import ModelBase
+    from theanompi_tpu.parallel import steps
+    from theanompi_tpu.parallel.exchanger import BSP_Exchanger
+    from theanompi_tpu.parallel.mesh import worker_mesh
+
+    class Data(DataBase):
+        def __init__(self, config=None, batch_size=8):
+            super().__init__(config, batch_size)
+            r = np.random.RandomState(7)
+            w = r.randn(12)
+            rr = np.random.RandomState(11)
+            x = rr.randn(128, 12).astype(np.float32)
+            self.x_train = x
+            self.y_train = (x @ w > 0).astype(np.int32)
+            self.x_val, self.y_val = self.x_train, self.y_train
+            self._finalize()
+
+    class M(ModelBase):
+        batch_size = 8
+        n_subb = 1
+        learning_rate = 0.05
+        momentum = 0.9
+        weight_decay = 0.0
+        seed = 3
+
+        def build_model(self):
+            self.seq = L.Sequential([
+                L.FC(12, 16, w_init="he", compute_dtype=jnp.float32,
+                     name="fc1"),
+                L.FC(16, 2, w_init=("normal", 0.01), activation=None,
+                     compute_dtype=jnp.float32, name="out"),
+            ])
+            self.data = Data(self.config, self.batch_size)
+
+    mesh = worker_mesh(n_workers)
+    config = {"mesh": mesh, "size": n_workers, "rank": 0, "verbose": False}
+    m = M(config)
+    m.compile_iter_fns(BSP_Exchanger(config))
+    m.data.shuffle_data(0)
+    for i in range(1, n_steps + 1):
+        m.train_iter(i, None)
+    host = steps.tree_to_host(m.step_state["params"])
+    leaves = jax.tree_util.tree_leaves(jax.device_get(host))
+    return {"sums": [float(np.asarray(l).sum()) for l in leaves],
+            "first": [float(np.asarray(l).reshape(-1)[0]) for l in leaves]}

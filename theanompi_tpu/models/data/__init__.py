@@ -71,6 +71,7 @@ class DataBase:
         self._perm = None
         self._train_ptr = 0
         self._val_ptr = 0
+        self._shuffle_seed = None
 
     # subclasses populate x/y arrays then call _finalize()
     def _finalize(self) -> None:
@@ -91,8 +92,23 @@ class DataBase:
         strided shards are disjoint)."""
         rng = np.random.RandomState(seed)
         self._perm = rng.permutation(len(self.y_train))
+        self._shuffle_seed = int(seed)
         self._train_ptr = 0
         self._val_ptr = 0
+
+    # -- checkpoint cursor (SURVEY.md §5: resume must replay the data stream)
+    def get_cursor(self) -> Dict:
+        """Everything needed to resume the data stream exactly: the shuffle
+        seed regenerates the permutation, the pointers reposition it."""
+        return {"shuffle_seed": self._shuffle_seed,
+                "train_ptr": int(self._train_ptr),
+                "val_ptr": int(self._val_ptr)}
+
+    def set_cursor(self, cursor: Dict) -> None:
+        if cursor.get("shuffle_seed") is not None:
+            self.shuffle_data(int(cursor["shuffle_seed"]))
+        self._train_ptr = int(cursor.get("train_ptr", 0))
+        self._val_ptr = int(cursor.get("val_ptr", 0))
 
     def _local(self, lo: int) -> slice:
         """This host's contiguous sub-block of the global batch starting at

@@ -104,6 +104,7 @@ class ImageNet_data:
             self.synthetic = True
         self._train_ptr = 0
         self._val_ptr = 0
+        self._shuffle_seed = None
         self._perm = np.arange(len(self.train_files)) if not self.synthetic \
             else None
 
@@ -160,8 +161,34 @@ class ImageNet_data:
         if not self.synthetic:
             self._perm = np.random.RandomState(seed).permutation(
                 len(self.train_files))
+        self._shuffle_seed = int(seed)
         self._train_ptr = 0
         self._val_ptr = 0
+
+    # -- checkpoint cursor --------------------------------------------------
+    def get_cursor(self) -> Dict:
+        """Shuffle seed + batch pointers + augmentation RNG state: enough to
+        resume the exact sample/crop/mirror stream mid-epoch."""
+        keys, pos, has_gauss, cached = self.rng.get_state()[1:]
+        return {"shuffle_seed": self._shuffle_seed,
+                "train_ptr": int(self._train_ptr),
+                "val_ptr": int(self._val_ptr),
+                "aug_rng_keys": np.asarray(keys),
+                "aug_rng_pos": int(pos),
+                "aug_rng_has_gauss": int(has_gauss),
+                "aug_rng_cached": float(cached)}
+
+    def set_cursor(self, cursor: Dict) -> None:
+        if cursor.get("shuffle_seed") is not None:
+            self.shuffle_data(int(cursor["shuffle_seed"]))
+        self._train_ptr = int(cursor.get("train_ptr", 0))
+        self._val_ptr = int(cursor.get("val_ptr", 0))
+        if "aug_rng_keys" in cursor:
+            self.rng.set_state(("MT19937",
+                                np.asarray(cursor["aug_rng_keys"], np.uint32),
+                                int(cursor["aug_rng_pos"]),
+                                int(cursor["aug_rng_has_gauss"]),
+                                float(cursor["aug_rng_cached"])))
 
     def _local_files(self, lo: int):
         """This host's slice of the step's ``size`` batch files (each MPI

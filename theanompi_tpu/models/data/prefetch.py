@@ -35,8 +35,11 @@ class PrefetchLoader:
         self._device_put_fn = device_put_fn  # optional: stage host→device too
         self._q: Optional[queue.Queue] = None
         self._thread: Optional[threading.Thread] = None
-        self._stop = threading.Event()
-        self._epoch_batches = 0
+        # per-producer stop event: a timed-out old producer must keep seeing
+        # ITS stop flag set after a restart (a shared cleared Event would
+        # revive it against the new queue / shared data object)
+        self._stop: Optional[threading.Event] = None
+        self._consumed_cursor: dict = {}
 
     # duck-typed passthrough surface ---------------------------------------
     @property
@@ -60,10 +63,43 @@ class PrefetchLoader:
         for one epoch's worth of train batches."""
         self._shutdown()
         self._data.shuffle_data(seed)
+        self._restart_producer()
+
+    # -- checkpoint cursor --------------------------------------------------
+    # The producer runs AHEAD of training, so the wrapped data object's
+    # cursor is up to ``depth`` batches past what the trainer has consumed.
+    # Each queue item therefore carries the wrapped cursor as of *after* that
+    # batch was generated; get_cursor reports the last consumed one, making
+    # mid-epoch save/resume exact even with para_load on.
+
+    def get_cursor(self):
+        c = dict(self._consumed_cursor)
+        # val batches are served synchronously on the consumer thread, so the
+        # wrapped object's val_ptr is live and authoritative — the producer
+        # snapshot only tracks the train stream
+        if hasattr(self._data, "get_cursor"):
+            c["val_ptr"] = self._data.get_cursor().get("val_ptr", 0)
+        return c
+
+    def set_cursor(self, cursor) -> None:
+        self._shutdown()
+        if hasattr(self._data, "set_cursor"):
+            self._data.set_cursor(cursor)
+        # else: cursor-less duck-typed data — resume degrades gracefully to
+        # wherever the wrapped object stands (same contract as get_cursor's
+        # empty dict)
+        self._restart_producer()
+
+    def _restart_producer(self) -> None:
+        self._consumed_cursor = self._data.get_cursor() \
+            if hasattr(self._data, "get_cursor") else {}
+        n = self._data.n_batch_train
+        # batches left in the current epoch (ptr%n == 0 → a fresh epoch)
+        remaining = n - int(self._consumed_cursor.get("train_ptr", 0)) % n
         self._q = queue.Queue(maxsize=self.depth)
-        self._stop.clear()
+        self._stop = threading.Event()
         self._thread = threading.Thread(
-            target=self._producer, args=(self._data.n_batch_train,),
+            target=self._producer, args=(remaining, self._q, self._stop),
             daemon=True)
         self._thread.start()
 
@@ -73,7 +109,8 @@ class PrefetchLoader:
         item = self._q.get()
         if isinstance(item, BaseException):
             raise item
-        return item
+        batch, self._consumed_cursor = item
+        return batch
 
     def next_val_batch(self, count: int):
         # Validation is per-epoch and cheap relative to training — served
@@ -81,15 +118,23 @@ class PrefetchLoader:
         return self._maybe_put(self._data.next_val_batch(count))
 
     # producer -------------------------------------------------------------
-    def _producer(self, n_batches: int) -> None:
+    def _producer(self, n_batches: int, q: queue.Queue,
+                  stop: threading.Event) -> None:
+        # q/stop are THIS producer's own (not read from self): a restart
+        # swaps self._q/_stop, and a slow old producer must neither feed the
+        # new queue nor be revived by the new (cleared) event
         try:
             for i in range(n_batches):
-                if self._stop.is_set():
+                if stop.is_set():
                     return
                 batch = self._maybe_put(self._data.next_train_batch(i + 1))
-                self._q.put(batch)
+                cursor = self._data.get_cursor() \
+                    if hasattr(self._data, "get_cursor") else {}
+                if stop.is_set():     # restart raced the load: drop, don't put
+                    return
+                q.put((batch, cursor))
         except BaseException as e:    # surface loader errors in the consumer
-            self._q.put(e)
+            q.put(e)
 
     def _maybe_put(self, batch):
         return self._device_put_fn(batch) if self._device_put_fn else batch
@@ -102,6 +147,9 @@ class PrefetchLoader:
                     self._q.get_nowait()
             except queue.Empty:
                 pass
+            # Best effort: a producer stuck >5s in one load stays orphaned,
+            # but its own stop event is set and it holds the OLD queue, so it
+            # can neither feed the restarted pipeline nor be revived.
             self._thread.join(timeout=5)
         self._thread = None
         self._q = None

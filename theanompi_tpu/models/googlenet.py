@@ -106,14 +106,24 @@ class GoogLeNet(ModelBase):
                  compute_dtype=cd, name="softmax"),
         ])
 
+        # the aux taps sit after four stride-2 stages (conv1, pool1, pool2,
+        # pool3 — all ceil-mode), so their spatial side is crop/16 rounded
+        # up, and the aux 5×5/3 VALID avg-pool shrinks it again; 224 → 14 → 4
+        crop = int(self.config.get("crop_size", 224))
+        s = crop
+        for _ in range(4):
+            s = (s + 1) // 2
+        aux_sp = (s - 5) // 3 + 1
+        assert aux_sp >= 1, f"crop {crop} too small for the aux heads"
+
         def aux_head(in_ch, name):
             # avgpool 5×5/3 → 1×1 conv 128 → FC 1024 → dropout .7 → FC nc
             return L.Sequential([
                 L.Pool(5, 3, mode="avg", name=f"{name}_pool"),
                 L.Conv(in_ch, 128, 1, name=f"{name}_conv", **k),
                 L.Flatten(name=f"{name}_flat"),
-                L.FC(128 * 4 * 4, 1024, w_init="he", compute_dtype=cd,
-                     name=f"{name}_fc"),
+                L.FC(128 * aux_sp * aux_sp, 1024, w_init="he",
+                     compute_dtype=cd, name=f"{name}_fc"),
                 L.Dropout(0.7, name=f"{name}_drop"),
                 L.FC(1024, nc, w_init=("normal", 0.01), activation=None,
                      compute_dtype=cd, name=f"{name}_out"),

@@ -67,9 +67,16 @@ class ModelBase:
         self.data = None
         self.build_model()            # subclass hook: set self.seq, self.data
         if self.config.get("para_load", False) and self.data is not None:
-            # reference's para_load=True flag → background parallel loader
+            # reference's para_load=True flag → background parallel loader.
+            # The producer thread stages batches onto the mesh itself
+            # (device_put_fn), double-buffered — the TPU analogue of the
+            # reference's loader child writing into the trainer's GPU buffer
+            # via CUDA IPC: train_iter consumes device-resident batches and
+            # the host→device copy overlaps compute.
             from .data.prefetch import PrefetchLoader
-            self.data = PrefetchLoader(self.data)
+            self.data = PrefetchLoader(
+                self.data,
+                device_put_fn=lambda b: steps.put_batch(self.mesh, b))
 
         key = jax.random.key(self.seed)
         self.params = self.init_params(key)
@@ -164,19 +171,32 @@ class ModelBase:
         if recorder:
             recorder.end("load")
             recorder.start()
-        dev_batch = steps.put_batch(self.mesh, batch)
+        dev_batch = batch if steps.is_device_batch(batch) \
+            else steps.put_batch(self.mesh, batch)
         self.step_state, cost, err = self.train_fn(
             self.step_state, dev_batch, jnp.float32(self.current_lr),
             self._step_rng, jnp.int32(count))
         cost, err = jnp.mean(cost), jnp.mean(err)
+        if recorder:
+            recorder.end("train")
         if self.config.get("sync_each_iter", False):
-            # Reference-style blocking loop: section buckets = wall time.
+            # Reference-style blocking loop: t_train above is the host
+            # dispatch, and the device-bound remainder lands in the ``wait``
+            # bucket (≙ the reference's MPI-wait time) — together they sum
+            # to wall time per iteration.
+            if recorder:
+                recorder.start()
             cost, err = float(cost), float(err)
+            if recorder:
+                recorder.end("wait")
         # else: device scalars flow to the recorder and materialize at print
         # cadence, keeping dispatch asynchronous (device queue stays full).
         if recorder:
-            recorder.end("train")
+            # local rows, consistently: a device-resident (para_load-staged)
+            # batch has the GLOBAL shape, a host batch the per-host shape
             n_images = int(batch["y"].shape[0])
+            if steps.is_device_batch(batch):
+                n_images //= jax.process_count()
             recorder.train_error(count, cost, err, n_images)
         self.current_info.update(cost=cost, error=err)
 
@@ -189,9 +209,18 @@ class ModelBase:
                                                   "canonical_params"):
             canon = self.exchanger.canonical_params(self.step_state)
             self._val_params_boxed = steps.replicate_tree(canon, n, self.mesh)
+            # Consistent statistics for the consensus model: score the center
+            # with the replica-MEAN running stats, not each worker's divergent
+            # local ones (the reference's server validated its own center
+            # model end to end).  BN state is tiny — host round-trip is fine
+            # (tree_to_host: plain device_get can't span hosts).
+            bn = steps.tree_to_host(self.step_state["bn_state"])
+            bn_mean = jax.tree.map(lambda x: np.mean(np.asarray(x), axis=0),
+                                   bn)
+            self._val_bn_boxed = steps.replicate_tree(bn_mean, n, self.mesh)
         else:
             self._val_params_boxed = self.step_state["params"]
-        self._val_bn_boxed = self.step_state["bn_state"]
+            self._val_bn_boxed = self.step_state["bn_state"]
 
     def val_iter(self, count: int, recorder=None) -> None:
         if self._val_params_boxed is None:
@@ -199,7 +228,8 @@ class ModelBase:
         if recorder:
             recorder.start()
         batch = self.data.next_val_batch(count)
-        dev_batch = steps.put_batch(self.mesh, batch)
+        dev_batch = batch if steps.is_device_batch(batch) \
+            else steps.put_batch(self.mesh, batch)
         cost, err, err5 = self.val_fn(self._val_params_boxed,
                                       self._val_bn_boxed, dev_batch)
         cost = float(np.mean(jax.device_get(cost)))
@@ -248,29 +278,79 @@ class ModelBase:
     # -- contract: persistence --------------------------------------------
 
     def save(self, ckpt_dir: str, epoch: int, count: int = 0) -> str:
-        # Replica 0 of each boxed tree (BSP replicas are identical; for async
-        # rules the canonical params are saved below, like the reference
-        # saving the server's center).
-        state = {k: jax.device_get(steps.unbox(v))
-                 for k, v in self.step_state.items()}
-        # For async rules the canonical params are worth keeping too.
+        """Checkpoint the FULL boxed state (every worker's replica + the
+        exchanger's extras — diverged async-rule replicas and GoSGD α survive
+        a resume), both PRNG keys, and the data cursor.  The reference-style
+        per-leaf ``.npy`` snapshot holds the canonical params (the EASGD
+        center / GoSGD consensus, ≙ the reference saving the server's
+        center; replica 0 for BSP, where replicas are identical)."""
+        state = {k: steps.tree_to_host(v) for k, v in self.step_state.items()}
         if hasattr(self.exchanger, "canonical_params"):
-            state["params"] = jax.device_get(
-                self.exchanger.canonical_params(self.step_state))
-        return ckpt_lib.save_checkpoint(ckpt_dir, state, epoch, count)
+            # canonical_params is pure tree algebra (unbox / weighted mean) —
+            # feed it the GATHERED host state: the device step_state spans
+            # non-addressable shards on multi-host
+            params_npy = jax.device_get(
+                self.exchanger.canonical_params(state))
+        else:
+            params_npy = steps.unbox(state["params"])
+        if getattr(self.exchanger, "replicas_identical", False):
+            # BSP grads-mode replicas are bit-identical — persist ONE replica
+            # instead of n copies (an 8-chip VGG-16 checkpoint shrinks 8×);
+            # load() re-replicates from the meta flag.
+            state = {k: steps.unbox(v) for k, v in state.items()}
+            params_npy = state["params"]
+        cursor = self.data.get_cursor() \
+            if hasattr(self.data, "get_cursor") else None
+        if jax.process_index() != 0:
+            # rank 0 writes, as the reference did — concurrent writers on a
+            # shared filesystem would corrupt the archive
+            import os
+            return os.path.join(ckpt_dir, f"ckpt_epoch{epoch}.npz")
+        return ckpt_lib.save_checkpoint(
+            ckpt_dir, state, epoch, count,
+            rng_keys={"step": self._step_rng, "exch": self._exch_key},
+            cursor=cursor, params_npy=params_npy,
+            extra_meta={"boxed": not getattr(self.exchanger,
+                                             "replicas_identical", False)})
 
     def load(self, ckpt_dir: str, epoch: Optional[int] = None) -> Optional[int]:
         """Restore state (call after ``compile_iter_fns``). Returns the epoch
-        restored from, or None."""
+        restored from, or None.  Restores the boxed per-worker state, the
+        PRNG keys, and the data cursor, so training replays bit-identically
+        from the save point (tested for BSP and GoSGD)."""
         n = self.mesh.shape[WORKER_AXIS]
-        template = {k: steps.unbox(jax.device_get(v))
-                    for k, v in self.step_state.items()}
+
+        def shape_of(x, boxed):
+            shape = x.shape if boxed else x.shape[1:]
+            return jax.ShapeDtypeStruct(shape, x.dtype)
+
+        # peek at the meta to learn the stored layout (boxed per-worker state
+        # vs one BSP replica) before shaping the template
+        peek = ckpt_lib.peek_meta(ckpt_dir, epoch)
+        if peek is None:
+            return None
+        # legacy checkpoints (no 'boxed' flag) were always saved unboxed
+        boxed = bool(peek.get("boxed", False))
+        template = {
+            k: jax.tree.map(lambda x: shape_of(x, boxed), v)
+            for k, v in self.step_state.items()}
         restored = ckpt_lib.load_checkpoint(ckpt_dir, template, epoch)
         if restored is None:
             return None
         meta = restored.pop("_meta")
-        self.step_state = {k: steps.replicate_tree(v, n, self.mesh)
-                           for k, v in restored.items()}
+        rngs = restored.pop("_rng_keys", None)
+        cursor = restored.pop("_cursor", None)
+        if boxed:
+            self.step_state = {k: steps.place_boxed(v, self.mesh)
+                               for k, v in restored.items()}
+        else:
+            self.step_state = {k: steps.replicate_tree(v, n, self.mesh)
+                               for k, v in restored.items()}
+        if rngs:
+            self._step_rng = rngs.get("step", self._step_rng)
+            self._exch_key = rngs.get("exch", self._exch_key)
+        if cursor and hasattr(self.data, "set_cursor"):
+            self.data.set_cursor(cursor)
         return int(meta["epoch"])
 
     @property

@@ -1,0 +1,261 @@
+"""Genuinely asynchronous EASGD — worker islands around a host-side center.
+
+The reference's EASGD (SURVEY.md §3.2) ran a dedicated *server process*
+holding center parameters; each worker exchanged with it over MPI Send/Recv
+at its own pace — the defining property being that a straggler never blocks
+the others.  The in-step :class:`~.exchanger.EASGD_Exchanger` keeps the
+update algebra but runs at a synchronous cadence (every chip participates in
+one lockstep program), so that property has no analogue there.
+
+This module restores it TPU-natively: the device mesh is partitioned into
+**islands** — disjoint sub-meshes, each running its OWN compiled SPMD train
+step from its own host thread — and the center lives host-side behind a
+lock (:class:`ElasticCenter`, ≙ the reference's server).  Every
+``sync_freq`` local steps an island pulls the center, applies the elastic
+pairwise update on-device, and pushes its α-scaled delta back.  Islands
+never rendezvous with each other: a deliberately slowed island lags while
+the rest keep training (tested in ``tests/test_async_easgd.py``).
+
+Update algebra per island exchange (EASGD paper, round-robin form):
+
+    delta_i  = worker_i − center_snapshot        (per worker in the island)
+    worker_i ← worker_i − α·delta_i
+    center   ← center + α·mean_i delta_i         (atomic, possibly stale)
+
+The center absorbs the island-MEAN delta (the same pmean algebra as the
+synchronous exchanger): the reference applied each worker's α·delta one at
+a time, which for an island of k workers against one snapshot would give an
+effective gain of k·α and diverge for k·α > 1.
+
+Staleness of ``center_snapshot`` between pull and push is inherent to — and
+the point of — asynchronous EASGD.
+
+Config surface (run via :class:`AsyncEASGDTrainer` or the ``EASGD`` rule
+with ``easgd_mode='async'``): ``async_islands`` (number of islands),
+``alpha``, ``sync_freq``.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .mesh import WORKER_AXIS
+
+
+class ElasticCenter:
+    """Host-side center parameter store (≙ the reference's EASGD server).
+
+    Thread-safe: islands call :meth:`pull` / :meth:`push_delta` at their own
+    cadence; the lock serializes center updates exactly like the reference
+    server serving one worker at a time.
+    """
+
+    def __init__(self, params=None, alpha: float = 0.5):
+        self.alpha = float(alpha)
+        self._center = None if params is None else \
+            jax.tree.map(lambda x: np.array(x, np.float32), params)
+        self._lock = threading.Lock()
+        self.n_updates = 0            # exchanges absorbed (all islands)
+        self.updates_by_island: Dict[int, int] = {}
+
+    def ensure_init(self, params) -> None:
+        """Lazy init from the first island to arrive — all islands share the
+        model seed, so their initial params (and hence the center) agree;
+        avoids building a throwaway probe model just to read its params."""
+        with self._lock:
+            if self._center is None:
+                self._center = jax.tree.map(
+                    lambda x: np.array(x, np.float32), params)
+
+    def pull(self):
+        with self._lock:
+            assert self._center is not None, "center not initialized yet"
+            return jax.tree.map(np.array, self._center)
+
+    def push_delta(self, delta_mean, island: int) -> None:
+        """center += α·mean_i delta_i for one island's workers."""
+        a = self.alpha
+        with self._lock:
+            self._center = jax.tree.map(
+                lambda c, d: c + a * np.asarray(d, np.float32),
+                self._center, delta_mean)
+            self.n_updates += 1
+            self.updates_by_island[island] = \
+                self.updates_by_island.get(island, 0) + 1
+
+
+class IslandRunner(threading.Thread):
+    """One island: a sub-mesh, its own compiled train step, its own pace.
+
+    ``model_factory(config) -> model`` builds the island's model; the island
+    config carries its sub-``mesh``, its ``size``, and a distinct ``seed`` so
+    islands consume different data streams (the reference's workers likewise
+    each walked their own shard).
+    """
+
+    def __init__(self, island_id: int, model_factory: Callable, config: dict,
+                 center: ElasticCenter, sync_freq: int,
+                 stop_event: threading.Event,
+                 throttle_s: float = 0.0):
+        super().__init__(daemon=True)
+        self.island_id = island_id
+        self.config = config
+        self.center = center
+        self.sync_freq = int(sync_freq)
+        self.stop_event = stop_event
+        self.throttle_s = float(throttle_s)   # test hook: deliberate straggler
+        self.steps_done = 0
+        self.exchanges_done = 0
+        self.error: Optional[BaseException] = None
+        self._model_factory = model_factory
+
+    def run(self) -> None:
+        try:
+            self._run()
+        except BaseException as e:      # surfaced by AsyncEASGDTrainer.join
+            self.error = e
+
+    def _run(self) -> None:
+        from .exchanger import Exchanger
+
+        model = self._model_factory(self.config)
+        self.center.ensure_init(jax.device_get(model.params))
+        # Local-only updates inside the island: the base Exchanger's
+        # step_update is exactly the local optimizer step.
+        exch = Exchanger(self.config)
+        model.compile_iter_fns(exch)
+        model.data.shuffle_data(int(self.config.get("data_seed", 0)))
+        mesh = model.mesh
+        n = mesh.shape[WORKER_AXIS]
+        alpha = self.center.alpha
+
+        # Jitted elastic update: (boxed params, replicated center) ->
+        # (boxed new params, boxed per-worker deltas summed on host later).
+        def elastic(params_boxed, center):
+            delta = jax.tree.map(lambda p, c: p - c[None], params_boxed, center)
+            new_params = jax.tree.map(lambda p, d: p - alpha * d,
+                                      params_boxed, delta)
+            delta_mean = jax.tree.map(lambda d: jnp.mean(d, axis=0), delta)
+            return new_params, delta_mean
+
+        elastic_fn = jax.jit(elastic)
+
+        count = 0
+        while not self.stop_event.is_set():
+            count += 1
+            model.train_iter(count, None)
+            self.steps_done += 1
+            if self.throttle_s:
+                time.sleep(self.throttle_s)
+            if count % self.sync_freq == 0:
+                center = self.center.pull()
+                new_params, delta_mean = elastic_fn(
+                    model.step_state["params"], center)
+                model.step_state["params"] = new_params
+                self.center.push_delta(jax.device_get(delta_mean),
+                                       self.island_id)
+                self.exchanges_done += 1
+
+
+class AsyncEASGDTrainer:
+    """Partition the visible devices into islands and train asynchronously.
+
+    ≙ the reference's ``EASGD`` launcher topology (server + independent
+    workers), with islands of chips instead of single GPUs and a host-side
+    center instead of a server rank.
+    """
+
+    def __init__(self, model_factory: Callable, config: Optional[dict] = None):
+        from .mesh import worker_mesh
+        self.config = dict(config or {})
+        self.n_islands = int(self.config.get("async_islands", 2))
+        self.alpha = float(self.config.get("alpha", 0.5))
+        self.sync_freq = int(self.config.get("sync_freq", 4))
+        devices = self.config.get("devices")
+        if devices is None:
+            devices = jax.devices()
+            n_workers = self.config.get("n_workers")
+            if n_workers:
+                devices = devices[:int(n_workers)]
+        assert len(devices) % self.n_islands == 0, (
+            f"{len(devices)} devices not divisible into {self.n_islands} islands")
+        per = len(devices) // self.n_islands
+        self._island_devices = [devices[i * per:(i + 1) * per]
+                                for i in range(self.n_islands)]
+        self.model_factory = model_factory
+        self.stop_event = threading.Event()
+        self.islands: List[IslandRunner] = []
+
+        # Center initializes lazily from the first island's params
+        # (ElasticCenter.ensure_init): all islands share the model seed, so
+        # their initial params — and hence the center — agree at t=0.
+        self.center = ElasticCenter(alpha=self.alpha)
+
+    def _island_config(self, i: int) -> dict:
+        from jax.sharding import Mesh
+        devs = np.asarray(self._island_devices[i])
+        cfg = dict(self.config)
+        cfg["mesh"] = Mesh(devs, (WORKER_AXIS,))
+        cfg["size"] = len(devs)
+        cfg["rank"] = 0
+        # distinct data stream per island; identical param init (model seeds
+        # params from 'seed' via the factory — keep that shared)
+        cfg["data_seed"] = int(cfg.get("seed", 0)) + i
+        return cfg
+
+    def start(self, throttle: Optional[Dict[int, float]] = None) -> None:
+        throttle = throttle or {}
+        for i in range(self.n_islands):
+            r = IslandRunner(i, self.model_factory, self._island_config(i),
+                             self.center, self.sync_freq, self.stop_event,
+                             throttle_s=throttle.get(i, 0.0))
+            self.islands.append(r)
+            r.start()
+
+    def stop_and_join(self, timeout: float = 60.0) -> None:
+        self.stop_event.set()
+        for r in self.islands:
+            r.join(timeout=timeout)
+        for r in self.islands:
+            if r.error is not None:
+                raise r.error
+
+    def run_for(self, seconds: float,
+                throttle: Optional[Dict[int, float]] = None) -> None:
+        self.start(throttle)
+        time.sleep(seconds)
+        self.stop_and_join()
+
+    @property
+    def center_params(self):
+        return self.center.pull()
+
+    # -- recorder-compatible surface ----------------------------------------
+    # ``EASGD(...).wait()`` returns this trainer in async mode; session
+    # scripts that call ``rec.save(record_dir)`` / read ``epoch_records``
+    # keep working (they get island/center progress stats instead of
+    # per-iteration curves — the islands run headless threads).
+
+    def stats(self) -> dict:
+        return {"islands": [{"island": r.island_id, "steps": r.steps_done,
+                             "exchanges": r.exchanges_done}
+                            for r in self.islands],
+                "center_updates": self.center.n_updates}
+
+    @property
+    def epoch_records(self):
+        return [self.stats()]
+
+    def save(self, record_dir: Optional[str] = None) -> None:
+        import json
+        import os
+        d = record_dir or self.config.get("record_dir", "./inc")
+        os.makedirs(d, exist_ok=True)
+        with open(os.path.join(d, "async_easgd_stats.jsonl"), "w") as f:
+            f.write(json.dumps(self.stats()) + "\n")

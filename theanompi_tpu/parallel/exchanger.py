@@ -65,6 +65,10 @@ class Exchanger:
     """
 
     name = "exchanger"
+    # True only when every worker's FULL step_state stays bit-identical (so
+    # checkpoints may persist one replica instead of n) — BSP grads mode with
+    # a stateless strategy; never async rules or per-worker EF state.
+    replicas_identical = False
 
     def __init__(self, config: Optional[dict] = None):
         self.config = dict(config or {})
@@ -137,6 +141,15 @@ class BSP_Exchanger(Exchanger):
         self.mode = self.config.get("exch_mode", "grads")
         self.strategy: Strategy = get_strategy(
             self.config.get("exch_strategy", "allreduce"))
+
+    @property
+    def replicas_identical(self) -> bool:
+        # grads mode: every worker applies the same reduced gradient; params
+        # mode keeps per-worker momentum; stateful strategies carry
+        # per-worker error feedback; the measurement-only 'none' strategy
+        # skips the collective entirely — all of those break replica identity.
+        return (self.mode == "grads" and not self.strategy.stateful
+                and self.strategy.name != "none")
 
     def prepare(self, mesh: Mesh, model) -> None:
         super().prepare(mesh, model)
@@ -285,10 +298,28 @@ class GOSGD_Exchanger(Exchanger):
 
     Per exchange, each worker draws Bernoulli(p); senders ship
     ``(α/2 · params, α/2)`` to a peer and halve their α; receivers merge by
-    weighted average and absorb the weight.  The peer assignment is a shared
-    random ring-shift ``s ∈ {1..N-1}`` applied with ``lax.ppermute`` —
-    decomposed into log₂N conditional power-of-two hops so the compiled
-    program is static.  Σα is conserved exactly (tested).
+    weighted average and absorb the weight.  Σα is conserved exactly
+    (tested).  Two peer-assignment modes (``gosgd_peers`` config):
+
+    * ``'perm'`` (default): a random DERANGEMENT drawn per exchange from
+      ``gosgd_n_perms`` (default 16) statically compiled candidates — a
+      traced replicated index picks one ``lax.switch`` branch, each a single
+      full-payload ``lax.ppermute``.  Peer choices decorrelate across
+      senders (knowing one sender's peer no longer determines all others,
+      the round-1 fidelity gap vs the reference's independent draws) at P
+      wire bytes per exchange.  ``scripts/gosgd_mixing.py`` measures the
+      mixing rates: statistically equal to ``'shift'`` at the reference's
+      p=0.25 — the default is chosen on fidelity and wire cost (P vs
+      P·log₂N), not mixing speed.
+    * ``'shift'``: the shared random ring-shift ``s ∈ {1..N-1}`` decomposed
+      into log₂N conditional power-of-two hops (every sender shifts by the
+      same ``s``; P·log₂N wire bytes).
+
+    Exact-collision fidelity note: the reference's iid peer draws allow two
+    senders to hit one receiver (multi-message merge); a derangement cannot.
+    The merge algebra is collision-ready (weighted average over arbitrary
+    inbound weight), only the routing restricts to bijections — the price of
+    static SPMD programs.
     """
 
     name = "gosgd"
@@ -296,10 +327,30 @@ class GOSGD_Exchanger(Exchanger):
     def __init__(self, config: Optional[dict] = None):
         super().__init__(config)
         self.p_share = float(self.config.get("exch_prob", 0.25))
+        self.peers_mode = str(self.config.get("gosgd_peers", "perm"))
+        self.n_perms = int(self.config.get("gosgd_n_perms", 16))
         self.exchange_freq = 1
 
     def extra_state_template(self) -> Dict[str, Any]:
         return {"alpha": jnp.ones(())}
+
+    @staticmethod
+    def _derangements(n: int, k: int, seed: int = 0x605) -> np.ndarray:
+        """k distinct random derangements of range(n) (static, seeded)."""
+        rng = np.random.RandomState(seed)
+        out, seen = [], set()
+        guard = 0
+        while len(out) < k and guard < 10000:
+            guard += 1
+            p = rng.permutation(n)
+            if n > 1 and (p == np.arange(n)).any():
+                continue
+            key = tuple(p)
+            if key in seen:
+                continue
+            seen.add(key)
+            out.append(p)
+        return np.asarray(out)
 
     def prepare(self, mesh: Mesh, model) -> None:
         super().prepare(mesh, model)
@@ -307,22 +358,14 @@ class GOSGD_Exchanger(Exchanger):
         state_spec = {k: P(axis) for k in
                       ("params", "opt_state", "bn_state", "extra")}
         n_bits = max(1, int(np.ceil(np.log2(max(n, 2)))))
+        if self.peers_mode == "perm":
+            perms = self._derangements(n, self.n_perms)
+        mode = self.peers_mode
 
-        def body(state, key, count):
-            params = steps.unbox(state["params"])
-            extra = steps.unbox(state["extra"])
-            alpha = extra["alpha"]
-            ridx = lax.axis_index(axis)
-            step_key = jax.random.fold_in(key, count)
-            # Shared shift (same on all workers: derived from the replicated key)
-            shift = jax.random.randint(step_key, (), 1, n) if n > 1 else jnp.ones((), jnp.int32)
-            # Per-worker Bernoulli send gate
-            send = jax.random.bernoulli(
-                jax.random.fold_in(step_key, ridx), p_share)
-            w_send = jnp.where(send, alpha * 0.5, 0.0)
-            w_keep = alpha - w_send
-            msg = jax.tree.map(lambda p: p * w_send, params)
-            payload = (msg, w_send)
+        def route_shift(payload, step_key):
+            """Shared ring-shift: log₂N conditional power-of-two hops."""
+            shift = jax.random.randint(step_key, (), 1, n) if n > 1 \
+                else jnp.ones((), jnp.int32)
 
             def hop(payload, k):
                 stride = 1 << k
@@ -335,6 +378,36 @@ class GOSGD_Exchanger(Exchanger):
 
             for k in range(n_bits):
                 payload = hop(payload, k)
+            return payload
+
+        def route_perm(payload, step_key):
+            """One of K static derangements, picked by a replicated index."""
+            if n == 1:
+                return payload
+            kidx = jax.random.randint(step_key, (), 0, len(perms))
+
+            def mk(perm):
+                pairs = [(i, int(perm[i])) for i in range(n)]
+                return lambda p: jax.tree.map(
+                    lambda x: lax.ppermute(x, axis, pairs), p)
+
+            return lax.switch(kidx, [mk(p) for p in perms], payload)
+
+        def body(state, key, count):
+            params = steps.unbox(state["params"])
+            extra = steps.unbox(state["extra"])
+            alpha = extra["alpha"]
+            ridx = lax.axis_index(axis)
+            step_key = jax.random.fold_in(key, count)
+            # Per-worker Bernoulli send gate
+            send = jax.random.bernoulli(
+                jax.random.fold_in(step_key, ridx), p_share)
+            w_send = jnp.where(send, alpha * 0.5, 0.0)
+            w_keep = alpha - w_send
+            msg = jax.tree.map(lambda p: p * w_send, params)
+            payload = (msg, w_send)
+            payload = (route_perm if mode == "perm" else route_shift)(
+                payload, step_key)
             recv_msg, w_recv = payload
 
             new_alpha = w_keep + w_recv

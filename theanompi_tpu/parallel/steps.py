@@ -44,6 +44,22 @@ def unbox(tree):
     return jax.tree.map(lambda x: x[0], tree)
 
 
+def place_boxed(tree, mesh: Mesh):
+    """Place an already-boxed ``[n_workers, ...]`` host pytree onto the mesh
+    (checkpoint restore: per-worker replicas round-trip without collapsing)."""
+    sh = worker_local_sharding(mesh)
+    return jax.tree.map(lambda x: jax.device_put(np.asarray(x), sh), tree)
+
+
+def tree_to_host(tree):
+    """Materialize a (possibly multi-host-sharded) pytree as host numpy with
+    GLOBAL shapes — rank 0 can then save it, as the reference's rank-0 save."""
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+        return multihost_utils.process_allgather(tree, tiled=True)
+    return jax.device_get(tree)
+
+
 def replicate_tree(tree, n: int, mesh: Mesh):
     """Broadcast an unboxed pytree to the boxed [n_workers, ...] layout and
     place it sharded over the workers axis (one replica per chip)."""
@@ -190,6 +206,13 @@ def build_val_step(mesh: Mesh, model) -> Callable:
         out_specs=(P(axis), P(axis), P(axis)),
     )
     return jax.jit(sm)
+
+
+def is_device_batch(batch) -> bool:
+    """True if the batch is already mesh-resident (staged by the parallel
+    loader's producer thread) — ``train_iter`` then skips ``put_batch``."""
+    leaves = jax.tree_util.tree_leaves(batch)
+    return bool(leaves) and isinstance(leaves[0], jax.Array)
 
 
 def put_batch(mesh: Mesh, batch):

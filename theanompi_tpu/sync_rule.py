@@ -60,7 +60,28 @@ class BSP(SyncRule):
 
 
 class EASGD(SyncRule):
+    """``easgd_mode='sync'`` (default): in-mesh synchronous-cadence elastic
+    averaging.  ``easgd_mode='async'``: genuinely asynchronous worker islands
+    around a host-side center (``parallel.async_easgd``) — ``async_islands``
+    and ``sync_freq`` control the topology/cadence, ``run_seconds`` the
+    wall-clock budget."""
+
     rule = "easgd"
+
+    def wait(self):
+        if self.config.get("easgd_mode", "sync") != "async":
+            return super().wait()
+        import importlib
+
+        from .parallel.async_easgd import AsyncEASGDTrainer
+
+        mod = importlib.import_module(self.modelfile)
+        cls = getattr(mod, self.modelclass)
+        cfg = dict(self.config)
+        cfg.pop("mesh", None)
+        self.trainer = AsyncEASGDTrainer(cls, cfg)
+        self.trainer.run_for(float(cfg.get("run_seconds", 60.0)))
+        return self.trainer
 
 
 class ASGD(SyncRule):

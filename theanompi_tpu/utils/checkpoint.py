@@ -6,11 +6,16 @@ dir; resume loaded them at model-build time via a config flag; optimizer
 state was NOT saved.
 
 This rebuild keeps the per-epoch cadence and the "load at build" flow but
-checkpoints the FULL training state — params, optimizer state (velocity), BN
-running stats, RNG key, epoch/step counters — as an ``.npz`` bundle plus the
-reference-compatible per-leaf ``.npy`` params snapshot, so both resume paths
-work.  Everything is host-side numpy: on multi-host, rank 0 saves (as the
-reference did) since BSP state is replicated.
+checkpoints the FULL training state: the *boxed* ``[n_workers, ...]`` state
+trees (params, optimizer state, BN stats, exchanger extras — so diverged
+async-rule replicas and per-worker GoSGD α survive a resume), the training
+and exchange PRNG keys, and the data cursor (shuffle seed + batch pointers +
+augmentation RNG state), as an ``.npz`` bundle plus the reference-compatible
+per-leaf ``.npy`` params snapshot.  Deterministic replay is therefore
+bit-identical across a save/kill/resume boundary (tested in
+``tests/test_checkpoint_and_data.py``).  Everything is host-side numpy: on
+multi-host, rank 0 saves (as the reference did) after an all-gather of the
+boxed state.
 """
 
 from __future__ import annotations
@@ -26,8 +31,20 @@ from . import helper_funcs
 
 
 def save_checkpoint(ckpt_dir: str, step_state: Dict[str, Any], epoch: int,
-                    count: int, keep_params_npy: bool = True) -> str:
-    """``step_state`` is a dict of pytrees/scalars (params, opt_state, ...)."""
+                    count: int, rng_keys: Optional[Dict[str, Any]] = None,
+                    cursor: Optional[Dict[str, Any]] = None,
+                    params_npy: Optional[Any] = None,
+                    extra_meta: Optional[Dict[str, Any]] = None) -> str:
+    """``step_state`` is a dict of pytrees (boxed or not — shapes round-trip
+    through the ``template`` given to :func:`load_checkpoint`).
+
+    ``rng_keys``: dict name → jax typed PRNG key; stored as raw key data plus
+    the impl name, restored with ``jax.random.wrap_key_data``.
+    ``cursor``: json-able scalars/strings plus numpy arrays (arrays go into
+    the ``.npz``, the rest into the sidecar ``.json``).
+    ``params_npy``: optional unboxed params pytree for the reference-style
+    per-leaf ``.npy`` snapshot dir.
+    """
     os.makedirs(ckpt_dir, exist_ok=True)
     path = os.path.join(ckpt_dir, f"ckpt_epoch{epoch}")
     flat: Dict[str, np.ndarray] = {}
@@ -35,12 +52,30 @@ def save_checkpoint(ckpt_dir: str, step_state: Dict[str, Any], epoch: int,
         leaves, _ = jax.tree_util.tree_flatten(tree)
         for i, leaf in enumerate(leaves):
             flat[f"{key}__{i}"] = np.asarray(leaf)
+
+    meta: Dict[str, Any] = {"epoch": epoch, "count": count,
+                            "keys": sorted(step_state.keys())}
+    if extra_meta:
+        meta.update(extra_meta)
+    if rng_keys:
+        meta["rng_impl"] = {}
+        for name, k in rng_keys.items():
+            flat[f"_rngkey__{name}"] = np.asarray(jax.random.key_data(k))
+            meta["rng_impl"][name] = str(jax.random.key_impl(k))
+    if cursor:
+        meta_cursor: Dict[str, Any] = {}
+        for k, v in cursor.items():
+            if isinstance(v, np.ndarray):
+                flat[f"_cursor__{k}"] = v
+            else:
+                meta_cursor[k] = v
+        meta["cursor"] = meta_cursor
+
     np.savez(path + ".npz", **flat)
     with open(path + ".json", "w") as f:
-        json.dump({"epoch": epoch, "count": count,
-                   "keys": sorted(step_state.keys())}, f)
-    if keep_params_npy and "params" in step_state:
-        helper_funcs.save_params(step_state["params"],
+        json.dump(meta, f)
+    if params_npy is not None:
+        helper_funcs.save_params(params_npy,
                                  os.path.join(ckpt_dir, f"params_epoch{epoch}"))
     _write_latest(ckpt_dir, epoch)
     return path + ".npz"
@@ -48,7 +83,12 @@ def save_checkpoint(ckpt_dir: str, step_state: Dict[str, Any], epoch: int,
 
 def load_checkpoint(ckpt_dir: str, template: Dict[str, Any],
                     epoch: Optional[int] = None) -> Optional[Dict[str, Any]]:
-    """Restore state shaped like ``template``; returns None if no checkpoint."""
+    """Restore state shaped like ``template``; returns None if no checkpoint.
+
+    The returned dict carries the state trees plus ``_meta`` (the sidecar
+    json), ``_rng_keys`` (name → wrapped typed key) and ``_cursor`` (merged
+    scalar + array cursor entries) when those were saved.
+    """
     if epoch is None:
         epoch = latest_epoch(ckpt_dir)
         if epoch is None:
@@ -63,12 +103,45 @@ def load_checkpoint(ckpt_dir: str, template: Dict[str, Any],
         new_leaves = []
         for i, leaf in enumerate(leaves):
             arr = data[f"{key}__{i}"]
+            want = getattr(leaf, "shape", None)
+            if want is not None and tuple(arr.shape) != tuple(want):
+                raise ValueError(
+                    f"incompatible checkpoint: '{key}' leaf {i} has shape "
+                    f"{tuple(arr.shape)}, expected {tuple(want)} — the "
+                    f"checkpoint was written by a different layout/worker "
+                    f"count or an older format")
             new_leaves.append(arr.astype(leaf.dtype) if hasattr(leaf, "dtype") else arr)
         out[key] = jax.tree_util.tree_unflatten(treedef, new_leaves)
     with open(os.path.join(ckpt_dir, f"ckpt_epoch{epoch}.json")) as f:
         meta = json.load(f)
     out["_meta"] = meta
+    if "rng_impl" in meta:
+        out["_rng_keys"] = {
+            name: jax.random.wrap_key_data(data[f"_rngkey__{name}"], impl=impl)
+            for name, impl in meta["rng_impl"].items()}
+    if "cursor" in meta:
+        cursor = dict(meta["cursor"])
+        prefix = "_cursor__"
+        for k in data.files:
+            if k.startswith(prefix):
+                cursor[k[len(prefix):]] = data[k]
+        out["_cursor"] = cursor
     return out
+
+
+def peek_meta(ckpt_dir: str,
+              epoch: Optional[int] = None) -> Optional[Dict[str, Any]]:
+    """Read just the sidecar json (layout flags, epoch/count) — lets a loader
+    shape its template before touching the arrays."""
+    if epoch is None:
+        epoch = latest_epoch(ckpt_dir)
+        if epoch is None:
+            return None
+    path = os.path.join(ckpt_dir, f"ckpt_epoch{epoch}.json")
+    if not os.path.exists(path):
+        return None
+    with open(path) as f:
+        return json.load(f)
 
 
 def latest_epoch(ckpt_dir: str) -> Optional[int]:
