@@ -73,13 +73,21 @@ def main() -> int:
         config["batch_size"] = int(os.environ["BENCH_BATCH"])
     if os.environ.get("BENCH_STRATEGY"):
         config["exch_strategy"] = os.environ["BENCH_STRATEGY"]
+    if os.environ.get("BENCH_SPC"):
+        config["steps_per_call"] = int(os.environ["BENCH_SPC"])
     model = getattr(importlib.import_module(modelfile), modelclass)(config)
 
     exchanger = get_exchanger(rule, config)
     model.compile_iter_fns(exchanger)
-    batch = model.data.next_train_batch(0)
-    dev_batch = steps.put_batch(mesh, batch)
-    n_images = int(batch["y"].shape[0])
+    spc = int(config.get("steps_per_call", 1))
+    if spc > 1:
+        batches = [model.data.next_train_batch(j) for j in range(spc)]
+        dev_batch = steps.put_batch_stack(mesh, batches)
+        n_images = int(batches[0]["y"].shape[0]) * spc
+    else:
+        batch = model.data.next_train_batch(0)
+        dev_batch = steps.put_batch(mesh, batch)
+        n_images = int(batch["y"].shape[0])
 
     import jax.numpy as jnp
     lr = jnp.float32(model.current_lr)
@@ -112,7 +120,8 @@ def main() -> int:
     out = {
         "metric": f"images_per_sec_per_chip ({model_name} batch "
                   f"{model.batch_size} {rule.upper()}, {n_chips} chip(s), "
-                  f"{jax.devices()[0].platform}, prng={prng or 'default'}; "
+                  f"{jax.devices()[0].platform}, prng={prng or 'default'}"
+                  f"{', spc=' + str(spc) if spc > 1 else ''}; "
                   f"vs_baseline is vs ESTIMATED-K80 {K80_ALEXNET_IPS:.0f} "
                   f"img/s, not a measured reference)",
         "value": round(ips_chip, 2),

@@ -1,0 +1,39 @@
+#!/usr/bin/env python
+"""AlexNet with GENUINELY asynchronous EASGD — worker islands.
+
+``easgd_mode='async'`` partitions the visible chips into independent
+islands, each running its own compiled SPMD program from its own host
+thread; the elastic center lives host-side (the TPU-native analogue of the
+reference's EASGD *server process*, ``easgd_server.py``).  A straggler
+island never blocks the others — the property the in-mesh synchronous
+cadence cannot express.
+
+``wait()`` returns the AsyncEASGDTrainer (island/center progress stats)
+rather than a per-iteration Recorder: the islands run headless.
+"""
+
+import os
+
+from _common import setup, n_devices
+
+setup()
+
+from theanompi_tpu import EASGD  # noqa: E402
+
+if __name__ == "__main__":
+    rule = EASGD()
+    rule.init(
+        devices=n_devices(),
+        modelfile="theanompi_tpu.models.alex_net",
+        modelclass="AlexNet",
+        data_dir=os.environ.get("IMAGENET_DIR"),
+        easgd_mode="async",
+        async_islands=2,        # islands of n_devices/2 chips each
+        sync_freq=8,            # local steps between island<->center syncs
+        alpha=0.5,
+        run_seconds=float(os.environ.get("RUN_SECONDS", 300)),
+        batch_size=128,
+    )
+    trainer = rule.wait()
+    print(trainer.stats())
+    trainer.save("./inc")

@@ -125,6 +125,59 @@ def test_bsp_params_mode_replicas_identical_after_exchange():
             np.testing.assert_array_equal(leaf[w], leaf[0])
 
 
+def test_steps_per_call_matches_single_step_dispatch():
+    """steps_per_call=k (k full steps scanned inside one dispatch, the
+    host-overhead amortizer) must produce the same params as k single-step
+    dispatches — same data order, same per-step RNG folding."""
+    p1 = _train(4, 8, n_iters=4)
+
+    mesh = worker_mesh(4)
+    config = {"mesh": mesh, "size": 4, "rank": 0, "verbose": False,
+              "batch_size": 8, "steps_per_call": 2}
+    model = TinyModel(config)
+    model.compile_iter_fns(BSP_Exchanger(config))
+    model.data.shuffle_data(0)
+    for count in (2, 4):              # each call covers steps {c-1, c}
+        model.train_iter(count, None)
+    p2 = jax.device_get(steps.unbox(model.step_state["params"]))
+    for a, b in zip(jax.tree_util.tree_leaves(p1),
+                    jax.tree_util.tree_leaves(p2)):
+        np.testing.assert_allclose(a, b, rtol=1e-6, atol=1e-7)
+
+
+def test_steps_per_call_with_para_load_across_epochs():
+    """Drop-last striding (n_batch_train // spc dispatches per epoch) with
+    the prefetch loader: the per-epoch shuffle must cleanly restart the
+    producer past the leftover batch — two full epochs stream with no
+    deadlock and training state keeps advancing."""
+    mesh = worker_mesh(4)
+    config = {"mesh": mesh, "size": 4, "rank": 0, "verbose": False,
+              "batch_size": 8, "n_train": 4 * 8 * 5,   # 5 batches/epoch
+              "para_load": True, "steps_per_call": 2}  # 2 dispatches + 1 left
+    model = TinyModel(config)
+    model.compile_iter_fns(BSP_Exchanger(config))
+    count = 0
+    for epoch in range(2):
+        model.data.shuffle_data(epoch)
+        for _ in range(model.data.n_batch_train // 2):
+            count += 2
+            model.train_iter(count, None)
+    assert count == 8
+    assert np.isfinite(float(np.asarray(model.current_info["cost"])))
+
+
+def test_steps_per_call_rejects_post_step_exchanges():
+    """Multi-step dispatch would skip the Python-side exchange cadence —
+    must be refused for anything but fused BSP grads mode."""
+    from theanompi_tpu.parallel.exchanger import EASGD_Exchanger
+    mesh = worker_mesh(4)
+    config = {"mesh": mesh, "size": 4, "rank": 0, "verbose": False,
+              "batch_size": 8, "steps_per_call": 2}
+    model = TinyModel(config)
+    with pytest.raises(AssertionError, match="fused exchange"):
+        model.compile_iter_fns(EASGD_Exchanger(config))
+
+
 def test_training_reduces_loss():
     mesh = worker_mesh(8)
     config = {"mesh": mesh, "size": 8, "rank": 0, "verbose": False,

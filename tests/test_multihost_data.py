@@ -18,6 +18,53 @@ from tests.conftest import SyntheticData
 from theanompi_tpu.models.data.imagenet import ImageNet_data
 
 
+def test_launcher_execs_two_host_training():
+    """The launcher's multi-host exec path end to end (VERDICT row 14: it
+    had never been executed): two launcher-spawned worker processes × 2
+    virtual CPU devices bring up jax.distributed from the composed command
+    line and train one tiny epoch each."""
+    with socket.socket() as s:
+        s.bind(("localhost", 0))
+        port = s.getsockname()[1]
+
+    env = dict(os.environ)
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+    env.pop("JAX_PLATFORMS", None)     # worker pins platform via config
+
+    def cmd(i):
+        return [sys.executable, "-u", "-m", "theanompi_tpu.launcher",
+                "--rule", "bsp",
+                "--modelfile", "theanompi_tpu.models.cifar10",
+                "--modelclass", "Cifar10_model",
+                "--num-hosts", "2", "--process-id", str(i),
+                "--coordinator", f"localhost:{port}",
+                "platform=cpu", "epochs=1", "batch_size=8",
+                "synthetic_train=64", "synthetic_val=32",
+                "compute_dtype=float32", "scale_lr=false", "printFreq=1"]
+
+    procs = [subprocess.Popen(cmd(i), stdout=subprocess.PIPE,
+                              stderr=subprocess.STDOUT, text=True, env=env)
+             for i in (0, 1)]
+    outs = []
+    for p in procs:
+        out, _ = p.communicate(timeout=300)
+        outs.append(out)
+        assert p.returncode == 0, out
+    # rank 0 prints the training log; rank 1 stays quiet
+    assert "training finished" in outs[0], outs[0]
+
+
+def test_launcher_emit_only_composes_per_host_commands(capsys):
+    from theanompi_tpu import launcher
+    rc = launcher.main(["--rule", "bsp", "--num-hosts", "2",
+                        "--coordinator", "h0:1234", "--emit-only",
+                        "batch_size=8"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "process_id=0" in out and "process_id=1" in out
+    assert "coordinator_address=h0:1234" in out
+
+
 def test_two_process_jax_distributed_bsp_step():
     """REAL 2-process jax.distributed run (VERDICT round-1 Weak #6): two
     subprocesses × 2 virtual CPU devices form a 4-worker global mesh, load

@@ -42,6 +42,12 @@ class MeshProcess:
     def get_internode_comm(self):
         """Bring up the communicator (≙ MPI_Init + COMM_WORLD): multi-host
         control plane if configured, then the 1-D workers mesh."""
+        platform = self.config.get("platform")
+        if platform:
+            # programmatic platform pin (config `platform=cpu`): the
+            # JAX_PLATFORMS env var is not reliable under external PJRT
+            # plugins, and launcher-spawned workers have no other hook
+            jax.config.update("jax_platforms", platform)
         impl = canonical_prng_impl(self.config.get("prng_impl"))
         if impl:
             # 'rbg' uses the TPU hardware RNG for in-step randomness

@@ -36,6 +36,7 @@ class ModelBase:
     batch_size: int = 128          # per-worker, as in the reference
     epochs: int = 60
     n_subb: int = 1                # sub-batches per comm step (grad accum)
+    steps_per_call: int = 1        # full steps per dispatch (BSP grads only)
     learning_rate: float = 0.01
     momentum: float = 0.9
     weight_decay: float = 0.0001
@@ -57,7 +58,7 @@ class ModelBase:
             self.config.setdefault("rank", self.rank)
             self.config["size"] = self.size
         for k in ("batch_size", "epochs", "n_subb", "learning_rate", "seed",
-                  "optimizer", "momentum", "weight_decay"):
+                  "optimizer", "momentum", "weight_decay", "steps_per_call"):
             if k in self.config:
                 setattr(self, k, self.config[k])
         self.seed = int(self.config.get("seed", self.seed))
@@ -74,9 +75,12 @@ class ModelBase:
             # via CUDA IPC: train_iter consumes device-resident batches and
             # the host→device copy overlaps compute.
             from .data.prefetch import PrefetchLoader
-            self.data = PrefetchLoader(
-                self.data,
-                device_put_fn=lambda b: steps.put_batch(self.mesh, b))
+            # steps_per_call > 1 stacks k batches per dispatch — stage the
+            # stack once there instead of per-batch in the producer (avoids
+            # a stage-then-restack double copy)
+            put = None if int(self.steps_per_call) > 1 \
+                else (lambda b: steps.put_batch(self.mesh, b))
+            self.data = PrefetchLoader(self.data, device_put_fn=put)
 
         key = jax.random.key(self.seed)
         self.params = self.init_params(key)
@@ -158,21 +162,49 @@ class ModelBase:
                    "bn_state": self.bn_state, "extra": extra}
         self.step_state = {k: steps.replicate_tree(v, n, self.mesh)
                            for k, v in unboxed.items()}
-        self.train_fn = steps.build_train_step(self.mesh, self, self.exchanger)
+        spc = int(self.steps_per_call)
+        if spc > 1:
+            # multi-step dispatch skips the between-steps Python exchange
+            # hook — only legal when the exchange is fused into the step
+            assert self.exchanger._exchange_fn is None, (
+                "steps_per_call > 1 requires a fused exchange "
+                "(BSP grads mode); post-step collectives have a cadence "
+                "the in-call scan would skip")
+            # fail before cluster/device setup, not at the first step
+            assert jax.process_count() == 1, \
+                "steps_per_call > 1 is single-process for now"
+            if self.data is not None:
+                assert spc <= self.data.n_batch_train, (
+                    f"steps_per_call={spc} exceeds n_batch_train="
+                    f"{self.data.n_batch_train}: every epoch would train "
+                    f"zero steps")
+        self.train_fn = steps.build_train_step(self.mesh, self,
+                                               self.exchanger, n_steps=spc)
         self.val_fn = steps.build_val_step(self.mesh, self)
         self._step_rng = jax.random.key(self.seed + 2)
 
     # -- contract: iteration -----------------------------------------------
 
     def train_iter(self, count: int, recorder=None) -> None:
+        """One dispatch: one training step, or ``steps_per_call`` of them
+        (``count`` then names the LAST step of the call)."""
+        k = int(self.steps_per_call)
         if recorder:
             recorder.start()
-        batch = self.data.next_train_batch(count)
+        if k == 1:
+            batch = self.data.next_train_batch(count)
+        else:
+            batches = [self.data.next_train_batch(count - k + 1 + j)
+                       for j in range(k)]
+            batch = batches[0]       # row accounting below
         if recorder:
             recorder.end("load")
             recorder.start()
-        dev_batch = batch if steps.is_device_batch(batch) \
-            else steps.put_batch(self.mesh, batch)
+        if k == 1:
+            dev_batch = batch if steps.is_device_batch(batch) \
+                else steps.put_batch(self.mesh, batch)
+        else:
+            dev_batch = steps.put_batch_stack(self.mesh, batches)
         self.step_state, cost, err = self.train_fn(
             self.step_state, dev_batch, jnp.float32(self.current_lr),
             self._step_rng, jnp.int32(count))
@@ -194,7 +226,7 @@ class ModelBase:
         if recorder:
             # local rows, consistently: a device-resident (para_load-staged)
             # batch has the GLOBAL shape, a host batch the per-host shape
-            n_images = int(batch["y"].shape[0])
+            n_images = int(batch["y"].shape[0]) * k
             if steps.is_device_batch(batch):
                 n_images //= jax.process_count()
             recorder.train_error(count, cost, err, n_images)
@@ -232,9 +264,10 @@ class ModelBase:
             else steps.put_batch(self.mesh, batch)
         cost, err, err5 = self.val_fn(self._val_params_boxed,
                                       self._val_bn_boxed, dev_batch)
-        cost = float(np.mean(jax.device_get(cost)))
-        err = float(np.mean(jax.device_get(err)))
-        err5 = float(np.mean(jax.device_get(err5)))
+        # per-worker metric vectors span hosts — gather, don't device_get
+        cost = float(np.mean(np.asarray(steps.tree_to_host(cost))))
+        err = float(np.mean(np.asarray(steps.tree_to_host(err))))
+        err5 = float(np.mean(np.asarray(steps.tree_to_host(err5))))
         if recorder:
             recorder.end("val")
             recorder.val_error(count, cost, err, err5)

@@ -130,19 +130,28 @@ def _accumulate_grads(loss_and_metrics: Callable, params, bn_state, batch,
 # step builders
 # ---------------------------------------------------------------------------
 
-def build_train_step(mesh: Mesh, model, exchanger) -> Callable:
+def build_train_step(mesh: Mesh, model, exchanger, n_steps: int = 1) -> Callable:
     """Compile the training step.
 
     Returns ``train_fn(state_dict, batch, lr, rng, count) ->
     (state_dict, cost[n], err[n])`` where ``state_dict`` has boxed leaves and
     is donated (params update in place in HBM, as the reference's in-place
     Theano updates did).
+
+    ``n_steps > 1`` (config ``steps_per_call``): a ``lax.scan`` runs that
+    many FULL training steps per dispatch over a stacked ``[k, ...]`` batch —
+    the per-call host cost (pytree flatten + hundreds of buffer handles) is
+    paid once per k steps instead of per step.  Profiling motivation: on one
+    v5e chip the ResNet-50 step showed 13.2 ms device-busy inside a 17.8 ms
+    wall step — ~26% host dispatch.  Only valid when the exchange is fused
+    into the step (BSP grads mode), where the between-steps Python hook is a
+    no-op; ``count`` is the index of the LAST step in the call.
     """
     axis = WORKER_AXIS
     n = mesh.shape[axis]
     n_subb = getattr(model, "n_subb", 1)
 
-    def per_worker(state, batch, lr, rng, count):
+    def one_step(state, batch, lr, rng, count):
         params = unbox(state["params"])
         opt_state = unbox(state["opt_state"])
         bn_state = unbox(state["bn_state"])
@@ -174,12 +183,32 @@ def build_train_step(mesh: Mesh, model, exchanger) -> Callable:
             "bn_state": box(new_bn),
             "extra": box(extra),
         }
-        return new_state, cost[None], err[None]
+        return new_state, cost, err
+
+    if n_steps == 1:
+        def per_worker(state, batch, lr, rng, count):
+            new_state, cost, err = one_step(state, batch, lr, rng, count)
+            return new_state, cost[None], err[None]
+    else:
+        def per_worker(state, batches, lr, rng, count):
+            # batches leaves: [k, local_rows, ...]; count names the LAST step
+            count0 = count - (n_steps - 1)
+
+            def body(carry, xs):
+                batch, j = xs
+                new_state, cost, err = one_step(carry, batch, lr, rng,
+                                                count0 + j)
+                return new_state, (cost, err)
+
+            js = _vary(jnp.arange(n_steps), axis)
+            state, (costs, errs) = lax.scan(body, state, (batches, js))
+            return state, jnp.mean(costs)[None], jnp.mean(errs)[None]
 
     state_spec = {k: P(axis) for k in ("params", "opt_state", "bn_state", "extra")}
+    batch_spec = P(axis) if n_steps == 1 else P(None, axis)
     sm = jax.shard_map(
         per_worker, mesh=mesh,
-        in_specs=(state_spec, P(axis), P(), P(), P()),
+        in_specs=(state_spec, batch_spec, P(), P(), P()),
         out_specs=(state_spec, P(axis), P(axis)),
     )
     return jax.jit(sm, donate_argnums=(0,))
@@ -213,6 +242,23 @@ def is_device_batch(batch) -> bool:
     loader's producer thread) — ``train_iter`` then skips ``put_batch``."""
     leaves = jax.tree_util.tree_leaves(batch)
     return bool(leaves) and isinstance(leaves[0], jax.Array)
+
+
+def put_batch_stack(mesh: Mesh, batches):
+    """Stack k per-step batches into ``[k, ...]`` leaves for a
+    ``steps_per_call`` multi-step dispatch, sharded ``P(None, workers)``
+    (scan slices the leading axis; each slice splits across workers).
+    Single-process only — the multi-host per-host stitch composes with
+    single-step dispatch."""
+    assert jax.process_count() == 1, \
+        "steps_per_call > 1 is single-process for now"
+    sh = NamedSharding(mesh, P(None, WORKER_AXIS))
+    if all(is_device_batch(b) for b in batches):
+        return jax.tree.map(
+            lambda *xs: jax.device_put(jnp.stack(xs), sh), *batches)
+    return jax.tree.map(
+        lambda *xs: jax.device_put(np.stack([np.asarray(x) for x in xs]), sh),
+        *batches)
 
 
 def put_batch(mesh: Mesh, batch):

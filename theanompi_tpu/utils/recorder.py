@@ -104,10 +104,14 @@ class Recorder:
         ips = self.images_per_sec()
         return IMAGES_PER_REPORT / ips if ips > 0 else float("inf")
 
-    def print_train_info(self, count: int) -> None:
-        if count % self.printFreq != 0:
+    def print_train_info(self, count: int, stride: int = 1) -> None:
+        """``stride`` = steps per train_iter dispatch (``steps_per_call``):
+        count then only visits multiples of it, so the print gate fires once
+        per printFreq window and the averaging slice counts DISPATCH entries,
+        not steps."""
+        if count % self.printFreq >= stride:
             return
-        k = self.printFreq
+        k = max(1, self.printFreq // stride)
         # materializing device scalars happens HERE, once per printFreq iters
         cost = float(np.mean([np.asarray(c) for c in self._train_cost[-k:]])) \
             if self._train_cost else float("nan")

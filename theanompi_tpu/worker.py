@@ -74,11 +74,15 @@ class Worker(MeshProcess):
                 print(f"profiler trace saved to {trace_dir}", flush=True)
 
         t0 = time.time()
+        # steps_per_call > 1: each train_iter dispatch covers several steps
+        # (count strides accordingly; leftover batches < spc roll to the
+        # next epoch's shuffle, like the reference's drop-last batching)
+        spc = max(1, int(getattr(model, "steps_per_call", 1)))
         for epoch in range(start_epoch, epochs):
             model.adjust_hyperp(epoch)
             model.data.shuffle_data(epoch + model.seed)
-            for _ in range(model.data.n_batch_train):
-                count += 1
+            for _ in range(model.data.n_batch_train // spc):
+                count += spc
                 if trace_pending and count >= trace_start:
                     import jax
                     jax.profiler.start_trace(trace_dir)
@@ -88,7 +92,7 @@ class Worker(MeshProcess):
                 self.exchanger.exchange(self.recorder, count)
                 if trace_stop_at is not None and count + 1 >= trace_stop_at:
                     _stop_trace()
-                self.recorder.print_train_info(count)
+                self.recorder.print_train_info(count, stride=spc)
 
             model.begin_val()
             for _ in range(model.data.n_batch_val):
