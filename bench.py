@@ -27,21 +27,22 @@ import os
 import sys
 import time
 
+from theanompi_tpu.models.registry import MODELS  # noqa: E402
+
 K80_ALEXNET_IPS = 128.0   # estimated reference single-K80 AlexNet throughput
 
-MODELS = {
-    "alexnet": ("theanompi_tpu.models.alex_net", "AlexNet",
-                {"synthetic_batches": 4}),
-    "googlenet": ("theanompi_tpu.models.googlenet", "GoogLeNet",
-                  {"synthetic_batches": 4}),
-    "vgg16": ("theanompi_tpu.models.vggnet_16", "VGGNet_16",
-              {"synthetic_batches": 4}),
-    "resnet50": ("theanompi_tpu.models.resnet50", "ResNet50",
-                 {"synthetic_batches": 4}),
-    "cifar10": ("theanompi_tpu.models.cifar10", "Cifar10_model",
-                {"synthetic_train": 4096}),
-}
 
+def _peak_flops(device) -> float:
+    """Best-effort bf16 peak FLOP/s by device kind (for the BENCH_MFU=1
+    column); 0 when unknown (CPU sim)."""
+    kind = getattr(device, "device_kind", "").lower()
+    table = (("v5 lite", 197e12), ("v5litepod", 197e12), ("v6 lite", 918e12),
+             ("v6e", 918e12), ("v5p", 459e12), ("v5", 459e12),
+             ("v4", 275e12), ("v3", 123e12), ("v2", 45e12))
+    for key, peak in table:
+        if key in kind:
+            return peak
+    return 0.0
 
 def main() -> int:
     model_name = os.environ.get("BENCH_MODEL", "alexnet")
@@ -75,10 +76,13 @@ def main() -> int:
         config["exch_strategy"] = os.environ["BENCH_STRATEGY"]
     if os.environ.get("BENCH_SPC"):
         config["steps_per_call"] = int(os.environ["BENCH_SPC"])
+    if os.environ.get("BENCH_BN_DTYPE"):
+        config["bn_norm_dtype"] = os.environ["BENCH_BN_DTYPE"]
     model = getattr(importlib.import_module(modelfile), modelclass)(config)
 
     exchanger = get_exchanger(rule, config)
     model.compile_iter_fns(exchanger)
+    want_mfu = bool(os.environ.get("BENCH_MFU"))
     spc = int(config.get("steps_per_call", 1))
     if spc > 1:
         batches = [model.data.next_train_batch(j) for j in range(spc)]
@@ -93,8 +97,19 @@ def main() -> int:
     lr = jnp.float32(model.current_lr)
     rng = jax.random.key(0)
 
+    compiled = None
+    if want_mfu:
+        # AOT-compile once and reuse the SAME executable for the timed loop
+        # and the flop count (a separate lower().compile() after the run
+        # would pay a second full XLA compile)
+        compiled = model.train_fn.lower(
+            model.step_state, dev_batch, lr, rng, jnp.int32(0)).compile()
+        train_fn = compiled
+    else:
+        train_fn = model.train_fn
+
     def step(i):
-        model.step_state, cost, err = model.train_fn(
+        model.step_state, cost, err = train_fn(
             model.step_state, dev_batch, lr, rng, jnp.int32(i))
         exchanger.exchange(None, i)     # rule cadence (no-op for BSP grads)
         return cost
@@ -117,6 +132,21 @@ def main() -> int:
 
     ips = n_images * iters / dt
     ips_chip = ips / n_chips
+
+    mfu = None
+    if compiled is not None:
+        # XLA's flop count for the (per-device, SPMD-partitioned) module vs
+        # one chip's bf16 peak → per-chip MFU
+        peak = _peak_flops(jax.devices()[0])
+        try:
+            ca = compiled.cost_analysis()
+            ca = ca[0] if isinstance(ca, (list, tuple)) else ca
+            flops = float(ca.get("flops", 0.0))
+            if flops > 0 and peak:
+                mfu = round(flops / (dt / iters) / peak, 4)
+        except Exception as e:
+            print(f"mfu unavailable: {e}", file=sys.stderr)
+
     out = {
         "metric": f"images_per_sec_per_chip ({model_name} batch "
                   f"{model.batch_size} {rule.upper()}, {n_chips} chip(s), "
@@ -128,6 +158,8 @@ def main() -> int:
         "unit": "images/sec/chip",
         "vs_baseline": round(ips_chip / K80_ALEXNET_IPS, 3),
     }
+    if mfu is not None:
+        out["mfu"] = mfu
     print(json.dumps(out))
     return 0
 

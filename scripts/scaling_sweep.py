@@ -32,14 +32,7 @@ if os.environ.get("TMPI_FORCE_CPU"):
     import jax
     jax.config.update("jax_platforms", "cpu")
 
-MODELS = {
-    "cifar10": ("theanompi_tpu.models.cifar10", "Cifar10_model",
-                {"synthetic_train": 8192}),
-    "alexnet": ("theanompi_tpu.models.alex_net", "AlexNet",
-                {"synthetic_batches": 4}),
-    "vgg16": ("theanompi_tpu.models.vggnet_16", "VGGNet_16",
-              {"synthetic_batches": 4}),
-}
+from theanompi_tpu.models.registry import MODELS  # noqa: E402
 
 
 def measure(modelfile, modelclass, extra, n_workers, strategy, batch_size,

@@ -155,3 +155,40 @@ def test_init_schemes():
     assert 0.1 < float(jnp.std(he)) < 0.2    # sqrt(2/100) ≈ 0.141
     with pytest.raises(ValueError):
         L.init_weight(k, (3,), "bogus")
+
+
+def test_batchnorm_bf16_norm_dtype_matches_fp32_path():
+    """norm_dtype=bfloat16 (the perf lever) must keep stats fp32-exact and
+    normalize within bf16 rounding of the fp32-exact path."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from theanompi_tpu.models import layers as L
+
+    r = np.random.RandomState(3)
+    x = jnp.asarray(r.randn(4, 5, 5, 8).astype(np.float32) * 2 + 1,
+                    dtype=jnp.bfloat16)
+    bn32 = L.BatchNorm(8)
+    bnbf = L.BatchNorm(8, norm_dtype=jnp.bfloat16)
+    params = bn32.init(jax.random.key(0))
+    params["scale"] = jnp.asarray(r.rand(8).astype(np.float32) + 0.5)
+    params["bias"] = jnp.asarray(r.randn(8).astype(np.float32))
+    state = bn32.init_state()
+
+    y32, st32 = bn32.apply(params, x, train=True, state=state)
+    ybf, stbf = bnbf.apply(params, x, train=True, state=state)
+    # running stats are computed identically in fp32
+    for k in st32:
+        np.testing.assert_array_equal(np.asarray(st32[k]),
+                                      np.asarray(stbf[k]))
+    np.testing.assert_allclose(np.asarray(ybf, np.float32),
+                               np.asarray(y32, np.float32),
+                               rtol=0.05, atol=0.05)
+    assert ybf.dtype == jnp.bfloat16
+
+    # eval path too
+    ye32, _ = bn32.apply(params, x, train=False, state=st32)
+    yebf, _ = bnbf.apply(params, x, train=False, state=stbf)
+    np.testing.assert_allclose(np.asarray(yebf, np.float32),
+                               np.asarray(ye32, np.float32),
+                               rtol=0.05, atol=0.05)

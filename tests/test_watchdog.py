@@ -1,0 +1,75 @@
+"""Stall watchdog (failure detection, SURVEY.md §5 aux subsystems)."""
+
+import time
+
+from theanompi_tpu.utils.watchdog import StallWatchdog
+
+
+def test_watchdog_fires_once_per_stall_and_rearms():
+    events = []
+    wd = StallWatchdog(timeout_s=0.15, poll_s=0.03,
+                       on_stall=lambda el, label: events.append((el, label)))
+    with wd:
+        wd.beat("iter 1")
+        time.sleep(0.4)              # stall → exactly one firing
+        assert len(events) == 1
+        assert events[0][0] >= 0.15 and events[0][1] == "iter 1"
+        wd.beat("iter 2")            # recovery re-arms
+        time.sleep(0.4)
+        assert len(events) == 2
+        assert events[1][1] == "iter 2"
+    assert wd.stall_count == 2
+
+
+def test_watchdog_silent_while_beating():
+    events = []
+    wd = StallWatchdog(timeout_s=0.2, poll_s=0.03,
+                       on_stall=lambda el, label: events.append(el))
+    with wd:
+        for i in range(8):
+            wd.beat(f"iter {i}")
+            time.sleep(0.05)
+    assert events == []
+
+
+def test_watchdog_disabled_at_zero_timeout():
+    wd = StallWatchdog(timeout_s=0)
+    wd.start()
+    assert wd._thread is None
+    wd.stop()
+
+
+def test_watchdog_in_worker_loop_detects_slow_iteration(capsys):
+    """Through the session API: a deliberately slow data loader trips the
+    watchdog mid-epoch; training still completes."""
+    import theanompi_tpu as tmpi
+
+    events = []
+    orig = StallWatchdog._default_handler
+    StallWatchdog._default_handler = \
+        lambda self, el, label: events.append((el, label))
+    try:
+        import tests.conftest as cf
+
+        class SlowData(cf.SyntheticData):
+            def next_train_batch(self, count):
+                time.sleep(0.3)
+                return super().next_train_batch(count)
+
+        class SlowModel(cf.TinyModel):
+            def build_model(self):
+                super().build_model()
+                self.data = SlowData(self.config, self.batch_size,
+                                     n_train=64)
+
+        cf.SlowModel = SlowModel     # importable by dotted path
+        rule = tmpi.BSP()
+        rule.init(devices=4, modelfile="tests.conftest",
+                  modelclass="SlowModel", epochs=1, batch_size=8,
+                  verbose=False, scale_lr=False, stall_timeout=0.1)
+        rule.wait()
+    finally:
+        StallWatchdog._default_handler = orig
+    assert events, "watchdog never fired despite 0.3s iterations"
+    assert any("iter" in label or "no heartbeat" in label
+               for _, label in events)

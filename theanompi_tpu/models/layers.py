@@ -344,13 +344,22 @@ class BatchNorm(Layer):
 
     Train mode uses batch statistics and returns updated running stats in the
     state pytree; eval mode uses running stats.  Normalizes over all axes but
-    the last (NHWC channel)."""
+    the last (NHWC channel).
+
+    ``norm_dtype``: dtype of the normalize arithmetic.  ``None`` (default)
+    upcasts activations to fp32 end to end.  ``bfloat16`` keeps the STAT
+    math fp32 (reductions upcast on read, which XLA fuses into the producer)
+    but folds (mean, inv·scale, bias) into per-channel bf16 vectors and
+    normalizes in bf16 — no fp32 activation tensor is materialized between
+    bf16 convs.  A/B lever for the BN share of ResNet-50 step time
+    (BASELINE.md round-3 analysis, finding 2)."""
 
     has_state = True
 
     def __init__(self, n_ch: int, momentum: float = 0.9, eps: float = 1e-5,
-                 name: str = "bn"):
+                 norm_dtype=None, name: str = "bn"):
         self.n_ch, self.momentum, self.eps = n_ch, momentum, eps
+        self.norm_dtype = norm_dtype
         self.name = name
 
     def init(self, key):
@@ -361,8 +370,8 @@ class BatchNorm(Layer):
 
     def apply(self, params, x, *, train=False, rng=None, state=None):
         axes = tuple(range(x.ndim - 1))
-        x32 = x.astype(jnp.float32)
         if train:
+            x32 = x.astype(jnp.float32)
             mean = jnp.mean(x32, axes)
             var = jnp.var(x32, axes)
             m = self.momentum
@@ -372,7 +381,15 @@ class BatchNorm(Layer):
             mean, var = state["mean"], state["var"]
             new_state = None
         inv = jax.lax.rsqrt(var + self.eps)
-        y = (x32 - mean) * inv * params["scale"] + params["bias"]
+        nd = self.norm_dtype
+        if nd is not None and x.dtype == nd:
+            # per-channel affine in the activation dtype: y = x·a + b with
+            # a = inv·scale, b = bias − mean·inv·scale (both fp32 → nd)
+            a = (inv * params["scale"]).astype(nd)
+            b = (params["bias"] - mean * inv * params["scale"]).astype(nd)
+            return x * a + b, new_state
+        y = (x.astype(jnp.float32) - mean) * inv * params["scale"] \
+            + params["bias"]
         return y.astype(x.dtype), new_state
 
 

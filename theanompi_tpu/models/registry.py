@@ -1,0 +1,21 @@
+"""Model registry shared by the bench/sweep harnesses.
+
+One source of truth for the short names used by ``bench.py`` (BENCH_MODEL)
+and ``scripts/scaling_sweep.py`` (--model): dotted modelfile, modelclass,
+and the synthetic-data config that makes the model runnable with zero data
+setup — the same (modelfile, modelclass) import-by-string contract the
+reference's launcher used (SURVEY.md §2.1).
+"""
+
+MODELS = {
+    "alexnet": ("theanompi_tpu.models.alex_net", "AlexNet",
+                {"synthetic_batches": 4}),
+    "googlenet": ("theanompi_tpu.models.googlenet", "GoogLeNet",
+                  {"synthetic_batches": 4}),
+    "vgg16": ("theanompi_tpu.models.vggnet_16", "VGGNet_16",
+              {"synthetic_batches": 4}),
+    "resnet50": ("theanompi_tpu.models.resnet50", "ResNet50",
+                 {"synthetic_batches": 4}),
+    "cifar10": ("theanompi_tpu.models.cifar10", "Cifar10_model",
+                {"synthetic_train": 4096}),
+}

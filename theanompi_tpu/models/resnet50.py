@@ -25,12 +25,12 @@ class ConvBN(L.Layer):
     has_state = True
 
     def __init__(self, in_ch, out_ch, kernel, stride=1, padding="SAME",
-                 relu=True, cd=jnp.bfloat16, name="convbn"):
+                 relu=True, cd=jnp.bfloat16, bn_nd=None, name="convbn"):
         self.name = name
         self.conv = L.Conv(in_ch, out_ch, kernel, stride=stride,
                            padding=padding, w_init="he", activation=None,
                            compute_dtype=cd, name="conv")
-        self.bn = L.BatchNorm(out_ch, name="bn")
+        self.bn = L.BatchNorm(out_ch, norm_dtype=bn_nd, name="bn")
         self.relu = relu
 
     def init(self, key):
@@ -54,15 +54,17 @@ class Bottleneck(L.Layer):
     has_state = True
 
     def __init__(self, in_ch, mid_ch, out_ch, stride=1, project=False,
-                 cd=jnp.bfloat16, name="block"):
+                 cd=jnp.bfloat16, bn_nd=None, name="block"):
         self.name = name
-        self.a = ConvBN(in_ch, mid_ch, 1, cd=cd, name="a")
-        self.b = ConvBN(mid_ch, mid_ch, 3, stride=stride, cd=cd, name="b")
-        self.c = ConvBN(mid_ch, out_ch, 1, relu=False, cd=cd, name="c")
+        self.a = ConvBN(in_ch, mid_ch, 1, cd=cd, bn_nd=bn_nd, name="a")
+        self.b = ConvBN(mid_ch, mid_ch, 3, stride=stride, cd=cd, bn_nd=bn_nd,
+                        name="b")
+        self.c = ConvBN(mid_ch, out_ch, 1, relu=False, cd=cd, bn_nd=bn_nd,
+                        name="c")
         self.project = project
         if project:
             self.proj = ConvBN(in_ch, out_ch, 1, stride=stride, relu=False,
-                               cd=cd, name="proj")
+                               cd=cd, bn_nd=bn_nd, name="proj")
 
     def _subs(self):
         subs = {"a": self.a, "b": self.b, "c": self.c}
@@ -112,9 +114,15 @@ class ResNet50(ModelBase):
 
     def build_model(self) -> None:
         cd = self.config.get("compute_dtype", jnp.bfloat16)
+        # bn_norm_dtype='bfloat16': normalize in bf16 with fp32 stats —
+        # perf A/B lever (BASELINE.md round-3 finding 2); default fp32-exact
+        bn_nd = self.config.get("bn_norm_dtype")
+        if isinstance(bn_nd, str):
+            bn_nd = jnp.dtype(bn_nd).type if bn_nd != "none" else None
         nc = self.config.get("n_class", self.n_class)
         layers = [
-            ConvBN(3, 64, 7, stride=2, padding=3, cd=cd, name="conv1"),
+            ConvBN(3, 64, 7, stride=2, padding=3, cd=cd, bn_nd=bn_nd,
+                   name="conv1"),
             L.Pool(3, 2, mode="max", padding="SAME", name="pool1"),
         ]
         in_ch = 64
@@ -123,7 +131,8 @@ class ResNet50(ModelBase):
                 layers.append(Bottleneck(
                     in_ch, mid, out,
                     stride=stride if bi == 0 else 1,
-                    project=(bi == 0), cd=cd, name=f"res{si}_{bi + 1}"))
+                    project=(bi == 0), cd=cd, bn_nd=bn_nd,
+                    name=f"res{si}_{bi + 1}"))
                 in_ch = out
         self.trunk = L.Sequential(layers)
         self.fc = L.FC(2048, nc, w_init=("normal", 0.01), activation=None,

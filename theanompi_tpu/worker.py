@@ -21,6 +21,7 @@ from typing import Optional
 from .base import MeshProcess
 from .parallel.exchanger import get_exchanger
 from .utils.recorder import Recorder
+from .utils.watchdog import StallWatchdog
 
 
 class Worker(MeshProcess):
@@ -78,32 +79,40 @@ class Worker(MeshProcess):
         # (count strides accordingly; leftover batches < spc roll to the
         # next epoch's shuffle, like the reference's drop-last batching)
         spc = max(1, int(getattr(model, "steps_per_call", 1)))
-        for epoch in range(start_epoch, epochs):
-            model.adjust_hyperp(epoch)
-            model.data.shuffle_data(epoch + model.seed)
-            for _ in range(model.data.n_batch_train // spc):
-                count += spc
-                if trace_pending and count >= trace_start:
-                    import jax
-                    jax.profiler.start_trace(trace_dir)
-                    trace_pending = False
-                    trace_stop_at = count + trace_iters
-                model.train_iter(count, self.recorder)
-                self.exchanger.exchange(self.recorder, count)
-                if trace_stop_at is not None and count + 1 >= trace_stop_at:
-                    _stop_trace()
-                self.recorder.print_train_info(count, stride=spc)
+        # failure detection (SURVEY §5): stall_timeout seconds without an
+        # iteration completing → off-thread diagnostic (hung collectives /
+        # transfers block the main thread inside jax, so detection can't
+        # live on it).  0 (default) = off.
+        with StallWatchdog(float(config.get("stall_timeout", 0))) as watchdog:
+            for epoch in range(start_epoch, epochs):
+                model.adjust_hyperp(epoch)
+                model.data.shuffle_data(epoch + model.seed)
+                for _ in range(model.data.n_batch_train // spc):
+                    count += spc
+                    if trace_pending and count >= trace_start:
+                        import jax
+                        jax.profiler.start_trace(trace_dir)
+                        trace_pending = False
+                        trace_stop_at = count + trace_iters
+                    model.train_iter(count, self.recorder)
+                    self.exchanger.exchange(self.recorder, count)
+                    watchdog.beat(f"epoch {epoch} iter {count}")
+                    if trace_stop_at is not None and count + 1 >= trace_stop_at:
+                        _stop_trace()
+                    self.recorder.print_train_info(count, stride=spc)
 
-            model.begin_val()
-            for _ in range(model.data.n_batch_val):
-                model.val_iter(count, self.recorder)
-            model.end_val()
-            self.recorder.print_val_info(count)
+                model.begin_val()
+                for _ in range(model.data.n_batch_val):
+                    model.val_iter(count, self.recorder)
+                    watchdog.beat(f"epoch {epoch} val @ iter {count}")
+                model.end_val()
+                self.recorder.print_val_info(count)
 
-            if ckpt_dir:
-                model.save(ckpt_dir, epoch, count)
-            if config.get("record_dir"):
-                self.recorder.save(config["record_dir"])
+                if ckpt_dir:
+                    model.save(ckpt_dir, epoch, count)
+                if config.get("record_dir"):
+                    self.recorder.save(config["record_dir"])
+                watchdog.beat(f"epoch {epoch} end (ckpt/records saved)")
         if trace_stop_at is not None:   # window outlived training: flush it
             _stop_trace()
         if self.verbose:
