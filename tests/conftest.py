@@ -75,6 +75,38 @@ class TinyModel(ModelBase):
                                   n_train=int(self.config.get("n_train", 256)))
 
 
+class CrashOnceModel(TinyModel):
+    """Fault-injection model for supervisor/recovery tests: raises at
+    ``crash_at`` once (a marker file records that the crash already
+    happened, so the restarted run proceeds)."""
+
+    def train_iter(self, count, recorder=None):
+        marker = self.config.get("crash_marker")
+        if (marker and count >= int(self.config.get("crash_at", 10 ** 9))
+                and not os.path.exists(marker)):
+            with open(marker, "w") as f:
+                f.write("crashed")
+            raise RuntimeError("injected crash for supervisor test")
+        super().train_iter(count, recorder)
+
+
+class HangOnceModel(TinyModel):
+    """Fault-injection model for the hang-recovery test: STALLS (sleeps far
+    past any stall_timeout) at ``hang_at`` once; the marker file makes the
+    restarted run proceed.  The worker's watchdog with stall_action=exit is
+    what breaks the hang."""
+
+    def train_iter(self, count, recorder=None):
+        import time
+        marker = self.config.get("hang_marker")
+        if (marker and count >= int(self.config.get("hang_at", 10 ** 9))
+                and not os.path.exists(marker)):
+            with open(marker, "w") as f:
+                f.write("hung")
+            time.sleep(300)          # the watchdog must kill us long before
+        super().train_iter(count, recorder)
+
+
 @pytest.fixture(scope="session")
 def mesh8():
     from theanompi_tpu.parallel.mesh import worker_mesh

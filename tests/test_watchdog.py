@@ -1,8 +1,59 @@
-"""Stall watchdog (failure detection, SURVEY.md §5 aux subsystems)."""
+"""Stall watchdog + supervised restart (failure detection/recovery,
+SURVEY.md §5 aux subsystems)."""
 
+import os
 import time
 
 from theanompi_tpu.utils.watchdog import StallWatchdog
+
+
+def test_supervisor_restarts_crashed_worker_and_resumes(tmp_path):
+    """launcher --supervise: an injected mid-training crash is recovered by
+    restarting the worker subprocess with resume=true from the latest
+    per-epoch checkpoint; the overall run exits 0."""
+    from theanompi_tpu import launcher
+
+    marker = str(tmp_path / "crashed")
+    ckpt = str(tmp_path / "ckpt")
+    # n_train=256 / (8 workers × batch 8) = 4 iters/epoch: counts 1-4 are
+    # epoch 0 (checkpoint saved at its end), so crash_at=5 fires in epoch 1
+    # AFTER a checkpoint exists — the restart must take the resume path
+    rc = launcher.main([
+        "--supervise", "2", "--rule", "bsp",
+        "--modelfile", "tests.conftest", "--modelclass", "CrashOnceModel",
+        "platform=cpu", "epochs=2", "batch_size=8", "n_train=256",
+        "n_workers=8", "verbose=false", "scale_lr=false",
+        f"ckpt_dir={ckpt}", f"crash_marker={marker}", "crash_at=5",
+    ])
+    assert rc == 0
+    assert os.path.exists(marker)          # the crash really happened
+    # epoch 0's checkpoint predates the crash; epoch 1's must come from the
+    # RESUMED run (the crashed run died at its first iteration)
+    assert os.path.exists(os.path.join(ckpt, "ckpt_epoch0.npz"))
+    with open(os.path.join(ckpt, "LATEST")) as f:
+        assert int(f.read()) == 1
+
+
+def test_supervisor_recovers_from_hang_via_stall_action_exit(tmp_path):
+    """The full hang-recovery loop: a worker that STALLS (not crashes) is
+    killed by its own watchdog (stall_action=exit → rc 42) and the
+    supervisor restarts it from the checkpoint; the retry completes."""
+    from theanompi_tpu import launcher
+
+    marker = str(tmp_path / "hung")
+    ckpt = str(tmp_path / "ckpt")
+    rc = launcher.main([
+        "--supervise", "1", "--rule", "bsp",
+        "--modelfile", "tests.conftest", "--modelclass", "HangOnceModel",
+        "platform=cpu", "epochs=2", "batch_size=8", "n_train=256",
+        "n_workers=8", "verbose=false", "scale_lr=false",
+        "stall_timeout=1.5", "stall_action=exit",
+        f"ckpt_dir={ckpt}", f"hang_marker={marker}", "hang_at=5",
+    ])
+    assert rc == 0
+    assert os.path.exists(marker)          # the hang really happened
+    with open(os.path.join(ckpt, "LATEST")) as f:
+        assert int(f.read()) == 1
 
 
 def test_watchdog_fires_once_per_stall_and_rearms():

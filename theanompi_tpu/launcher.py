@@ -60,6 +60,11 @@ def main(argv=None) -> int:
                    help="this host's index (multi-host exec mode)")
     p.add_argument("--emit-only", action="store_true",
                    help="print the per-host commands instead of executing")
+    p.add_argument("--supervise", type=int, default=0, metavar="N",
+                   help="run the worker as a supervised subprocess and "
+                        "restart it (with resume=true) up to N times on "
+                        "crash — pair with ckpt_dir for checkpoint-based "
+                        "recovery (single-host)")
     p.add_argument("config", nargs="*", help="key=value model/worker config")
     args = p.parse_args(argv)
 
@@ -79,6 +84,26 @@ def main(argv=None) -> int:
                 print(shlex.join(c))
             return 0
         return subprocess.call(cmds[args.process_id])
+
+    if args.supervise > 0:
+        # Failure recovery (SURVEY §5): the worker runs as a subprocess so a
+        # crash (or a watchdog-triggered exit) doesn't take the supervisor
+        # down; each restart resumes from the latest per-epoch checkpoint.
+        if not any(c.startswith("ckpt_dir=") for c in kv):
+            print("warning: --supervise without ckpt_dir= restarts training "
+                  "from scratch each time", file=sys.stderr)
+        base = compose_worker_cmd(args.rule, args.modelfile, args.modelclass,
+                                  kv)
+        rc = 1
+        for attempt in range(args.supervise + 1):
+            cmd = base if attempt == 0 else base + ["resume=true"]
+            rc = subprocess.call(cmd)
+            if rc == 0:
+                return 0
+            if attempt < args.supervise:
+                print(f"worker exited rc={rc}; restarting "
+                      f"({attempt + 1}/{args.supervise})", file=sys.stderr)
+        return rc
 
     # single host: in-process (no spawn needed — the mesh IS the workers)
     from .worker import main as worker_main

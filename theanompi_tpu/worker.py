@@ -82,8 +82,24 @@ class Worker(MeshProcess):
         # failure detection (SURVEY §5): stall_timeout seconds without an
         # iteration completing → off-thread diagnostic (hung collectives /
         # transfers block the main thread inside jax, so detection can't
-        # live on it).  0 (default) = off.
-        with StallWatchdog(float(config.get("stall_timeout", 0))) as watchdog:
+        # live on it).  0 (default) = off.  stall_action='exit' additionally
+        # kills the process (exit code 42) after the dump so a supervisor
+        # (launcher --supervise) can restart from the latest checkpoint —
+        # only sane when the worker IS a subprocess; the in-process session
+        # API should keep the default 'trace'.
+        stall_action = str(config.get("stall_action", "trace"))
+
+        def on_stall(elapsed, label):
+            StallWatchdog._default_handler(watchdog, elapsed, label)
+            if stall_action == "exit":
+                import os
+                print("WATCHDOG: stall_action=exit — terminating for "
+                      "supervisor restart", flush=True)
+                os._exit(42)
+
+        watchdog = StallWatchdog(float(config.get("stall_timeout", 0)),
+                                 on_stall=on_stall)
+        with watchdog:
             for epoch in range(start_epoch, epochs):
                 model.adjust_hyperp(epoch)
                 model.data.shuffle_data(epoch + model.seed)
