@@ -25,37 +25,29 @@ def test_slow_island_does_not_block_fast_one():
     import time
     tr = AsyncEASGDTrainer(_factory, {
         "async_islands": 2, "alpha": 0.5, "sync_freq": 2, "seed": 3})
-    # island 1 sleeps 300ms per step; island 0 runs full speed.  Poll until
-    # the fast island has done real work (a fixed wall budget is fragile on
-    # a loaded CI box where per-thread XLA compiles eat seconds).
-    tr.start(throttle={1: 0.3})
+    # island 1 wedges (sleeps 15s after each step) — under a synchronous
+    # cadence NOTHING could exchange while it sleeps.  The fast island must
+    # keep stepping AND exchanging with the center regardless.  (Rate-ratio
+    # comparisons are fragile under CI CPU contention — sleeps still elapse
+    # while compute threads starve — so assert unblocked progress instead.)
+    tr.start(throttle={1: 15.0})
     fast, slow = tr.islands
-    deadline = time.time() + 90
-    # warmup: XLA compile order between the two threads is arbitrary (the
-    # second compile may hit the in-process cache) — start measuring only
-    # once BOTH islands are actually stepping
-    while (fast.steps_done < 1 or slow.steps_done < 1) \
-            and time.time() < deadline:
-        time.sleep(0.02)
-    f0, s0 = fast.steps_done, slow.steps_done
-    x0 = slow.exchanges_done
-    while fast.steps_done - f0 < 12 and time.time() < deadline:
-        time.sleep(0.02)
-    f1, s1 = fast.steps_done, slow.steps_done
-    x1_fast, x1_slow = fast.exchanges_done, slow.exchanges_done
-    tr.stop_and_join()
+    deadline = time.time() + 120
+    while fast.exchanges_done < 3 and time.time() < deadline:
+        time.sleep(0.05)
+    f_steps, f_exch = fast.steps_done, fast.exchanges_done
+    s_steps = slow.steps_done
+    tr.stop_and_join(timeout=60)
     assert fast.error is None and slow.error is None
-    assert f1 - f0 >= 12, "fast island never got going"
-    assert slow.steps_done >= 1          # the straggler still progresses
-    # the fast island must NOT be rate-limited by the slow one: while it did
-    # ≥12 steps the 300ms-throttled island can have done only a few
-    assert f1 - f0 >= 3 * max(s1 - s0, 1), (f1 - f0, s1 - s0)
-    assert x1_fast > x1_slow - x0
-    # the center absorbed updates from BOTH islands
-    assert tr.center.updates_by_island.get(0, 0) > 0
-    assert tr.center.updates_by_island.get(1, 0) > 0
-    assert tr.center.n_updates == (tr.center.updates_by_island[0]
-                                   + tr.center.updates_by_island[1])
+    assert f_exch >= 3, (
+        f"fast island exchanged only {f_exch}× in 120s while the slow "
+        f"island slept — it is being blocked")
+    assert f_steps >= 6
+    assert s_steps <= 2                  # the wedged island truly lagged
+    assert tr.center.updates_by_island.get(0, 0) >= 3
+    # center bookkeeping stays consistent (the wedged island may or may not
+    # have reached its first exchange before the stop)
+    assert tr.center.n_updates == sum(tr.center.updates_by_island.values())
 
 
 def test_easgd_rule_async_mode():

@@ -74,7 +74,6 @@ def test_2d_mesh_data_x_sequence_training_step():
     axis inside the step, gradient psum over BOTH axes — and the loss
     decreases.  This is the long-context story on top of the same shard_map
     machinery the four exchangers use."""
-    import numpy as np
     from jax import lax
 
     devs = np.asarray(jax.devices()[:8]).reshape(2, 4)

@@ -42,6 +42,7 @@ def test_supervisor_recovers_from_hang_via_stall_action_exit(tmp_path):
 
     marker = str(tmp_path / "hung")
     ckpt = str(tmp_path / "ckpt")
+    t0 = time.time()
     rc = launcher.main([
         "--supervise", "1", "--rule", "bsp",
         "--modelfile", "tests.conftest", "--modelclass", "HangOnceModel",
@@ -50,8 +51,12 @@ def test_supervisor_recovers_from_hang_via_stall_action_exit(tmp_path):
         "stall_timeout=1.5", "stall_action=exit",
         f"ckpt_dir={ckpt}", f"hang_marker={marker}", "hang_at=5",
     ])
+    elapsed = time.time() - t0
     assert rc == 0
     assert os.path.exists(marker)          # the hang really happened
+    # the hang sleeps 300s — finishing far sooner proves the watchdog KILLED
+    # the first worker rather than the sleep merely elapsing
+    assert elapsed < 180, f"{elapsed:.0f}s: watchdog kill didn't happen"
     with open(os.path.join(ckpt, "LATEST")) as f:
         assert int(f.read()) == 1
 

@@ -65,6 +65,10 @@ def main(argv=None) -> int:
                         "restart it (with resume=true) up to N times on "
                         "crash — pair with ckpt_dir for checkpoint-based "
                         "recovery (single-host)")
+    p.add_argument("--min-uptime", type=float, default=0.0, metavar="SEC",
+                   help="crash-loop guard: a nonzero exit within SEC "
+                        "seconds is treated as unrecoverable (config/usage "
+                        "error) and is NOT retried; 0 = always retry")
     p.add_argument("config", nargs="*", help="key=value model/worker config")
     args = p.parse_args(argv)
 
@@ -94,12 +98,20 @@ def main(argv=None) -> int:
                   "from scratch each time", file=sys.stderr)
         base = compose_worker_cmd(args.rule, args.modelfile, args.modelclass,
                                   kv)
+        import time as _time
         rc = 1
         for attempt in range(args.supervise + 1):
             cmd = base if attempt == 0 else base + ["resume=true"]
+            t0 = _time.monotonic()
             rc = subprocess.call(cmd)
             if rc == 0:
                 return 0
+            uptime = _time.monotonic() - t0
+            if args.min_uptime and uptime < args.min_uptime:
+                print(f"worker exited rc={rc} after only {uptime:.1f}s "
+                      f"(< --min-uptime {args.min_uptime}s) — treating as "
+                      f"unrecoverable, not retrying", file=sys.stderr)
+                return rc
             if attempt < args.supervise:
                 print(f"worker exited rc={rc}; restarting "
                       f"({attempt + 1}/{args.supervise})", file=sys.stderr)

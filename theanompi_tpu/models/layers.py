@@ -393,6 +393,82 @@ class BatchNorm(Layer):
         return y.astype(x.dtype), new_state
 
 
+class LayerNorm(Layer):
+    """Layer normalization over the trailing feature dim (transformer zoo;
+    the CNN zoo's normalizer is :class:`BatchNorm`).  Stats in fp32."""
+
+    def __init__(self, dim: int, eps: float = 1e-5, name: str = "ln"):
+        self.dim, self.eps = dim, eps
+        self.name = name
+
+    def init(self, key):
+        return {"scale": jnp.ones((self.dim,)), "bias": jnp.zeros((self.dim,))}
+
+    def apply(self, params, x, *, train=False, rng=None, state=None):
+        x32 = x.astype(jnp.float32)
+        mu = jnp.mean(x32, axis=-1, keepdims=True)
+        var = jnp.var(x32, axis=-1, keepdims=True)
+        y = (x32 - mu) * jax.lax.rsqrt(var + self.eps)
+        return (y * params["scale"] + params["bias"]).astype(x.dtype)
+
+
+class Embedding(Layer):
+    """Token embedding lookup; fp32 table, output cast to compute dtype."""
+
+    def __init__(self, vocab: int, dim: int, w_init=("normal", 0.02),
+                 compute_dtype=jnp.bfloat16, name: str = "embed"):
+        self.vocab, self.dim = vocab, dim
+        self.w_init = w_init
+        self.compute_dtype = compute_dtype
+        self.name = name
+
+    def init(self, key):
+        return {"w": init_weight(key, (self.vocab, self.dim), self.w_init)}
+
+    def apply(self, params, x, *, train=False, rng=None, state=None):
+        return params["w"].astype(self.compute_dtype)[x]
+
+
+class MultiHeadAttention(Layer):
+    """Causal multi-head self-attention (transformer zoo).
+
+    QKV/output projections ride the MXU in ``compute_dtype``; the softmax
+    attention itself runs through :func:`ops.ring_attention.attention_reference`
+    (fp32 accumulation) — the sequence-SHARDED variant of the same math is
+    :func:`ops.ring_attention.ring_attention` on a 2-D data×seq mesh."""
+
+    def __init__(self, dim: int, n_head: int, causal: bool = True,
+                 w_init=("normal", 0.02), compute_dtype=jnp.bfloat16,
+                 name: str = "attn"):
+        assert dim % n_head == 0
+        self.dim, self.n_head, self.causal = dim, n_head, causal
+        self.w_init = w_init
+        self.compute_dtype = compute_dtype
+        self.name = name
+
+    def init(self, key):
+        ks = jax.random.split(key, 4)
+        mk = lambda k: init_weight(k, (self.dim, self.dim), self.w_init)
+        return {"wq": mk(ks[0]), "wk": mk(ks[1]), "wv": mk(ks[2]),
+                "wo": mk(ks[3])}
+
+    def apply(self, params, x, *, train=False, rng=None, state=None):
+        from ..ops.ring_attention import attention_reference
+        cd = self.compute_dtype
+        b, t, d = x.shape
+        h, hd = self.n_head, self.dim // self.n_head
+        xc = x.astype(cd)
+
+        def proj(w):
+            y = jnp.dot(xc, w.astype(cd))
+            return y.reshape(b, t, h, hd).transpose(0, 2, 1, 3)  # [B,H,T,hd]
+
+        q, k, v = proj(params["wq"]), proj(params["wk"]), proj(params["wv"])
+        o = attention_reference(q, k, v, causal=self.causal)
+        o = o.transpose(0, 2, 1, 3).reshape(b, t, d)
+        return jnp.dot(o.astype(cd), params["wo"].astype(cd))
+
+
 class Flatten(Layer):
     def __init__(self, name: str = "flatten"):
         self.name = name

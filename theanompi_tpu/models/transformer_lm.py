@@ -1,0 +1,163 @@
+"""Decoder-only transformer language model.
+
+Beyond-parity model family (the reference zoo, SURVEY.md §2.7, is CNN-only):
+a GPT-style causal LM following the SAME duck-typed model contract as the
+CNN zoo, so every rule/exchanger/worker/bench path drives it unchanged —
+``rule.init(modelfile='theanompi_tpu.models.transformer_lm',
+modelclass='TransformerLM')``.
+
+Attention runs in-model over the full (replicated) sequence; the
+sequence-SHARDED path for long contexts is ``ops/ring_attention.py``'s ring
+algorithm on a 2-D data×seq mesh (same math, pinned equal in
+``tests/test_ring_attention.py``).
+
+Without a data dir it synthesizes a deterministic, genuinely learnable token
+stream (noisy modular-increment chains) so convergence smokes run with zero
+setup, like the CIFAR-10 synthetic fallback.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import layers as L
+from .data import DataBase
+from .model_base import ModelBase
+
+
+class LMData(DataBase):
+    """Synthetic next-token-prediction data: x[t+1] = x[t] + 1 (mod V) with
+    ``noise`` probability of a random token — learnable one-step rule."""
+
+    def __init__(self, config=None, batch_size=16, seq_len=64, vocab=64,
+                 n_train=1024, n_val=256, noise=0.05):
+        super().__init__(config, batch_size)
+        seq_len = int(self.config.get("seq_len", seq_len))
+        vocab = int(self.config.get("vocab", vocab))
+        n_train = int(self.config.get("synthetic_train", n_train))
+        n_val = int(self.config.get("synthetic_val", n_val))
+
+        def make(n, seed):
+            r = np.random.RandomState(seed)
+            start = r.randint(0, vocab, (n, 1))
+            seq = (start + np.arange(seq_len + 1)) % vocab
+            flip = r.rand(n, seq_len + 1) < noise
+            seq = np.where(flip, r.randint(0, vocab, seq.shape), seq)
+            return seq.astype(np.int32)
+
+        self._train_seq = make(n_train, 101)
+        self._val_seq = make(n_val, 202)
+        # DataBase bookkeeping keys off x/y arrays
+        self.x_train = self._train_seq[:, :-1]
+        self.y_train = self._train_seq[:, 1:]
+        self.x_val = self._val_seq[:, :-1]
+        self.y_val = self._val_seq[:, 1:]
+        self._finalize()
+
+    def _make_batch(self, x, y, train):
+        # token ids stay int32 (the base class casts images to float32)
+        return {"x": np.ascontiguousarray(x, dtype=np.int32),
+                "y": np.ascontiguousarray(y, dtype=np.int32)}
+
+
+class Block(L.Layer):
+    """Pre-LN transformer block: LN→MHA→residual, LN→MLP→residual."""
+
+    has_state = False
+
+    def __init__(self, dim, n_head, mlp_ratio=4, cd=jnp.bfloat16,
+                 name="block"):
+        self.name = name
+        self.ln1 = L.LayerNorm(dim, name="ln1")
+        self.attn = L.MultiHeadAttention(dim, n_head, compute_dtype=cd,
+                                         name="attn")
+        self.ln2 = L.LayerNorm(dim, name="ln2")
+        self.fc1 = L.FC(dim, mlp_ratio * dim, w_init=("normal", 0.02),
+                        activation="relu", compute_dtype=cd, name="fc1")
+        self.fc2 = L.FC(mlp_ratio * dim, dim, w_init=("normal", 0.02),
+                        activation=None, compute_dtype=cd, name="fc2")
+
+    def init(self, key):
+        ks = jax.random.split(key, 5)
+        return {"ln1": self.ln1.init(ks[0]), "attn": self.attn.init(ks[1]),
+                "ln2": self.ln2.init(ks[2]), "fc1": self.fc1.init(ks[3]),
+                "fc2": self.fc2.init(ks[4])}
+
+    def apply(self, params, x, *, train=False, rng=None, state=None):
+        h = self.ln1.apply(params["ln1"], x)
+        x = x + self.attn.apply(params["attn"], h, train=train)
+        h = self.ln2.apply(params["ln2"], x)
+        h = self.fc1.apply(params["fc1"], h)
+        h = self.fc2.apply(params["fc2"], h)
+        return x + h
+
+
+class TransformerLM(ModelBase):
+    batch_size = 16
+    epochs = 10
+    n_subb = 1
+    learning_rate = 3e-3
+    optimizer = "adam"
+    weight_decay = 0.0
+    momentum = 0.9
+    vocab = 64
+    d_model = 128
+    n_head = 4
+    n_layer = 2
+    seq_len = 64
+
+    def build_model(self) -> None:
+        cd = self.config.get("compute_dtype", jnp.bfloat16)
+        for k in ("vocab", "d_model", "n_head", "n_layer", "seq_len"):
+            if k in self.config:
+                setattr(self, k, int(self.config[k]))
+        self.embed = L.Embedding(self.vocab, self.d_model, compute_dtype=cd)
+        self.pos = L.Embedding(self.seq_len, self.d_model, compute_dtype=cd,
+                               name="pos")
+        self.blocks = [Block(self.d_model, self.n_head, cd=cd,
+                             name=f"block{i}") for i in range(self.n_layer)]
+        self.ln_f = L.LayerNorm(self.d_model, name="ln_f")
+        self.head = L.FC(self.d_model, self.vocab, w_init=("normal", 0.02),
+                         activation=None, compute_dtype=cd, name="head")
+        self.data = LMData(self.config, self.batch_size)
+
+    def init_params(self, key):
+        ks = jax.random.split(key, len(self.blocks) + 4)
+        p = {"embed": self.embed.init(ks[0]), "pos": self.pos.init(ks[1]),
+             "ln_f": self.ln_f.init(ks[2]), "head": self.head.init(ks[3])}
+        for i, blk in enumerate(self.blocks):
+            p[blk.name] = blk.init(ks[4 + i])
+        return p
+
+    def init_bn_state(self):
+        return {}
+
+    def apply_model(self, params, x, *, train, rng, state):
+        t = x.shape[1]
+        h = self.embed.apply(params["embed"], x) + \
+            self.pos.apply(params["pos"], jnp.arange(t))[None]
+        for blk in self.blocks:
+            h = blk.apply(params[blk.name], h, train=train)
+        h = self.ln_f.apply(params["ln_f"], h)
+        return self.head.apply(params["head"], h), state
+
+    def loss_and_metrics(self, params, bn_state, batch, rng, train):
+        logits, _ = self.apply_model(params, batch["x"], train=train,
+                                     rng=rng, state=bn_state)
+        v = logits.shape[-1]
+        flat = logits.reshape(-1, v)
+        y = batch["y"].reshape(-1)
+        cost = L.softmax_cross_entropy(flat, y)
+        err = L.errors(flat, y)
+        return cost, (err, bn_state)
+
+    def val_metrics(self, params, bn_state, batch):
+        logits, _ = self.apply_model(params, batch["x"], train=False,
+                                     rng=None, state=bn_state)
+        v = logits.shape[-1]
+        flat = logits.reshape(-1, v)
+        y = batch["y"].reshape(-1)
+        cost = L.softmax_cross_entropy(flat, y)
+        return cost, (L.errors(flat, y), L.errors_top_x(flat, y, 5))

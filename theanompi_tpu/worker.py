@@ -88,6 +88,9 @@ class Worker(MeshProcess):
         # only sane when the worker IS a subprocess; the in-process session
         # API should keep the default 'trace'.
         stall_action = str(config.get("stall_action", "trace"))
+        assert stall_action in ("trace", "exit"), (
+            f"unknown stall_action {stall_action!r}: use 'trace' "
+            f"(diagnostic dump only) or 'exit' (kill for supervisor restart)")
 
         def on_stall(elapsed, label):
             StallWatchdog._default_handler(watchdog, elapsed, label)
