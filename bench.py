@@ -32,6 +32,7 @@ from theanompi_tpu.models.registry import MODELS  # noqa: E402
 K80_ALEXNET_IPS = 128.0   # estimated reference single-K80 AlexNet throughput
 
 
+
 def _peak_flops(device) -> float:
     """Best-effort bf16 peak FLOP/s by device kind (for the BENCH_MFU=1
     column); 0 when unknown (CPU sim)."""
@@ -75,60 +76,75 @@ def main() -> int:
     if os.environ.get("BENCH_STRATEGY"):
         config["exch_strategy"] = os.environ["BENCH_STRATEGY"]
     if os.environ.get("BENCH_SPC"):
+        # multi-step dispatch (BASELINE.md round-3 analysis) — opt-in:
+        # measured faster on TPU where host dispatch dominates, but the CPU
+        # sim shows the opposite, so the default stays 1 until the TPU
+        # numbers justify flipping it (scripts/perf_matrix.sh probes it)
         config["steps_per_call"] = int(os.environ["BENCH_SPC"])
     if os.environ.get("BENCH_BN_DTYPE"):
         config["bn_norm_dtype"] = os.environ["BENCH_BN_DTYPE"]
-    model = getattr(importlib.import_module(modelfile), modelclass)(config)
-
-    exchanger = get_exchanger(rule, config)
-    model.compile_iter_fns(exchanger)
-    want_mfu = bool(os.environ.get("BENCH_MFU"))
-    spc = int(config.get("steps_per_call", 1))
-    if spc > 1:
-        batches = [model.data.next_train_batch(j) for j in range(spc)]
-        dev_batch = steps.put_batch_stack(mesh, batches)
-        n_images = int(batches[0]["y"].shape[0]) * spc
-    else:
-        batch = model.data.next_train_batch(0)
-        dev_batch = steps.put_batch(mesh, batch)
-        n_images = int(batch["y"].shape[0])
 
     import jax.numpy as jnp
-    lr = jnp.float32(model.current_lr)
-    rng = jax.random.key(0)
+    want_mfu = bool(os.environ.get("BENCH_MFU"))
 
-    compiled = None
-    if want_mfu:
-        # AOT-compile once and reuse the SAME executable for the timed loop
-        # and the flop count (a separate lower().compile() after the run
-        # would pay a second full XLA compile)
-        compiled = model.train_fn.lower(
-            model.step_state, dev_batch, lr, rng, jnp.int32(0)).compile()
-        train_fn = compiled
-    else:
-        train_fn = model.train_fn
+    def measure(cfg):
+        """Build + warm up + time one configuration; XLA compilation happens
+        at the first warmup call, so any lowering failure lands here."""
+        model = getattr(importlib.import_module(modelfile), modelclass)(cfg)
+        exchanger = get_exchanger(rule, cfg)
+        model.compile_iter_fns(exchanger)
+        spc = int(cfg.get("steps_per_call", 1))
+        if spc > 1:
+            batches = [model.data.next_train_batch(j) for j in range(spc)]
+            dev_batch = steps.put_batch_stack(mesh, batches)
+            n_images = int(batches[0]["y"].shape[0]) * spc
+        else:
+            batch = model.data.next_train_batch(0)
+            dev_batch = steps.put_batch(mesh, batch)
+            n_images = int(batch["y"].shape[0])
+        lr = jnp.float32(model.current_lr)
+        rng = jax.random.key(0)
 
-    def step(i):
-        model.step_state, cost, err = train_fn(
-            model.step_state, dev_batch, lr, rng, jnp.int32(i))
-        exchanger.exchange(None, i)     # rule cadence (no-op for BSP grads)
-        return cost
+        compiled = None
+        if want_mfu:
+            # AOT-compile once and reuse the SAME executable for the timed
+            # loop and the flop count (a separate lower().compile() after
+            # the run would pay a second full XLA compile)
+            compiled = model.train_fn.lower(
+                model.step_state, dev_batch, lr, rng, jnp.int32(0)).compile()
+            train_fn = compiled
+        else:
+            train_fn = model.train_fn
 
-    def drain():
-        # block on the state, not the cost: the last exchange collective
-        # (non-BSP rules) reassigns step_state and would otherwise still be
-        # in flight when the clock stops
-        jax.block_until_ready(model.step_state["params"])
+        def step(i):
+            model.step_state, cost, err = train_fn(
+                model.step_state, dev_batch, lr, rng, jnp.int32(i))
+            exchanger.exchange(None, i)  # rule cadence (no-op for BSP grads)
 
-    for i in range(warmup):
-        step(i)
-    drain()
+        def drain():
+            # block on the state, not the cost: the last exchange collective
+            # (non-BSP rules) reassigns step_state and would otherwise still
+            # be in flight when the clock stops
+            jax.block_until_ready(model.step_state["params"])
 
-    t0 = time.time()
-    for i in range(iters):
-        step(warmup + i)
-    drain()
-    dt = time.time() - t0
+        for i in range(warmup):
+            step(i)
+        drain()
+        t0 = time.time()
+        for i in range(iters):
+            step(warmup + i)
+        drain()
+        return model, spc, n_images, time.time() - t0, compiled
+
+    try:
+        model, spc, n_images, dt, compiled = measure(config)
+    except Exception as e:
+        if int(config.get("steps_per_call", 1)) <= 1:
+            raise
+        print(f"steps_per_call={config['steps_per_call']} failed "
+              f"({e!r}); falling back to 1", file=sys.stderr)
+        config["steps_per_call"] = 1
+        model, spc, n_images, dt, compiled = measure(config)
 
     ips = n_images * iters / dt
     ips_chip = ips / n_chips

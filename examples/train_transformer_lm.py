@@ -1,0 +1,33 @@
+#!/usr/bin/env python
+"""Causal transformer LM under BSP — the beyond-parity sequence model.
+
+Runs on the synthetic next-token stream with zero data setup (swap in a
+real token dataset by subclassing ``transformer_lm.LMData``).  The
+sequence-SHARDED long-context path is ``ops/ring_attention.py`` on a 2-D
+data×seq mesh; this session trains data-parallel like any zoo model.
+"""
+
+from _common import setup, n_devices
+
+setup()
+
+from theanompi_tpu import BSP  # noqa: E402
+
+if __name__ == "__main__":
+    rule = BSP()
+    rule.init(
+        devices=n_devices(),
+        modelfile="theanompi_tpu.models.transformer_lm",
+        modelclass="TransformerLM",
+        batch_size=16,
+        seq_len=128,
+        vocab=256,
+        d_model=256,
+        n_layer=4,
+        n_head=8,
+        epochs=10,
+        printFreq=20,
+        async_ckpt=True,
+        ckpt_dir="./ckpt_lm",
+    )
+    rule.wait()

@@ -140,6 +140,41 @@ def test_imagenet_cursor_restores_aug_stream():
     np.testing.assert_array_equal(a["x"], b["x"])
 
 
+def test_async_ckpt_matches_sync(tmp_path):
+    """async_ckpt moves only the disk write off-thread: the landed files
+    must be byte-equivalent to a synchronous save of the same state."""
+    d_sync = str(tmp_path / "sync")
+    d_async = str(tmp_path / "async")
+    m = _model(async_ckpt=True)
+    for i in range(2):
+        m.train_iter(i + 1, None)
+    m.config["async_ckpt"] = False
+    m.save(d_sync, epoch=0, count=2)
+    m.config["async_ckpt"] = True
+    m.save(d_async, epoch=0, count=2)
+    m.wait_pending_ckpt()
+
+    a = np.load(os.path.join(d_sync, "ckpt_epoch0.npz"))
+    b = np.load(os.path.join(d_async, "ckpt_epoch0.npz"))
+    assert sorted(a.files) == sorted(b.files)
+    for k in a.files:
+        np.testing.assert_array_equal(a[k], b[k])
+    # and the async checkpoint restores
+    m2 = _model()
+    assert m2.load(d_async) == 0
+
+
+def test_async_ckpt_write_failure_surfaces():
+    """A failed background write must raise at the next join point — a
+    silently-lost checkpoint would let a supervisor resume from an older
+    epoch with no signal."""
+    m = _model(async_ckpt=True)
+    m.train_iter(1, None)
+    m.save("/proc/definitely/not/writable", epoch=0, count=1)
+    with pytest.raises(RuntimeError, match="async checkpoint write failed"):
+        m.wait_pending_ckpt()
+
+
 def test_checkpoint_latest_and_missing(tmp_path):
     d = str(tmp_path / "none")
     assert ckpt.latest_epoch(d) is None

@@ -94,6 +94,7 @@ class ModelBase:
         self.train_fn = None
         self.val_fn = None
         self.exchanger = None
+        self._ckpt_thread = None
         self._exch_key = jax.random.key(self.seed + 1)
         self._val_params_boxed = None
         self._val_bn_boxed = None
@@ -334,17 +335,47 @@ class ModelBase:
             params_npy = state["params"]
         cursor = self.data.get_cursor() \
             if hasattr(self.data, "get_cursor") else None
+        import os
         if jax.process_index() != 0:
             # rank 0 writes, as the reference did — concurrent writers on a
             # shared filesystem would corrupt the archive
-            import os
             return os.path.join(ckpt_dir, f"ckpt_epoch{epoch}.npz")
-        return ckpt_lib.save_checkpoint(
-            ckpt_dir, state, epoch, count,
+        kwargs = dict(
             rng_keys={"step": self._step_rng, "exch": self._exch_key},
             cursor=cursor, params_npy=params_npy,
             extra_meta={"boxed": not getattr(self.exchanger,
                                              "replicas_identical", False)})
+        if self.config.get("async_ckpt", False):
+            # the device→host gather above is the only part that must block
+            # the training loop; the disk write runs on a background thread
+            # (one in flight at a time — a newer save joins the older first)
+            import threading
+            self.wait_pending_ckpt()
+
+            def _write():
+                try:
+                    ckpt_lib.save_checkpoint(ckpt_dir, state, epoch, count,
+                                             **kwargs)
+                except BaseException as e:   # surfaced by wait_pending_ckpt
+                    self._ckpt_exc = e
+
+            self._ckpt_exc = None
+            self._ckpt_thread = threading.Thread(target=_write, daemon=True)
+            self._ckpt_thread.start()
+            return os.path.join(ckpt_dir, f"ckpt_epoch{epoch}.npz")
+        return ckpt_lib.save_checkpoint(ckpt_dir, state, epoch, count,
+                                        **kwargs)
+
+    def wait_pending_ckpt(self) -> None:
+        """Block until an in-flight async checkpoint write (if any) lands;
+        re-raise its failure here — a swallowed write error would let a
+        supervisor resume from an older epoch with no signal."""
+        if self._ckpt_thread is not None:
+            self._ckpt_thread.join()
+            self._ckpt_thread = None
+            exc, self._ckpt_exc = getattr(self, "_ckpt_exc", None), None
+            if exc is not None:
+                raise RuntimeError("async checkpoint write failed") from exc
 
     def load(self, ckpt_dir: str, epoch: Optional[int] = None) -> Optional[int]:
         """Restore state (call after ``compile_iter_fns``). Returns the epoch

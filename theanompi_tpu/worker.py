@@ -102,36 +102,50 @@ class Worker(MeshProcess):
 
         watchdog = StallWatchdog(float(config.get("stall_timeout", 0)),
                                  on_stall=on_stall)
-        with watchdog:
-            for epoch in range(start_epoch, epochs):
-                model.adjust_hyperp(epoch)
-                model.data.shuffle_data(epoch + model.seed)
-                for _ in range(model.data.n_batch_train // spc):
-                    count += spc
-                    if trace_pending and count >= trace_start:
-                        import jax
-                        jax.profiler.start_trace(trace_dir)
-                        trace_pending = False
-                        trace_stop_at = count + trace_iters
-                    model.train_iter(count, self.recorder)
-                    self.exchanger.exchange(self.recorder, count)
-                    watchdog.beat(f"epoch {epoch} iter {count}")
-                    if trace_stop_at is not None and count + 1 >= trace_stop_at:
-                        _stop_trace()
-                    self.recorder.print_train_info(count, stride=spc)
+        try:
+            with watchdog:
+                for epoch in range(start_epoch, epochs):
+                    model.adjust_hyperp(epoch)
+                    model.data.shuffle_data(epoch + model.seed)
+                    for _ in range(model.data.n_batch_train // spc):
+                        count += spc
+                        if trace_pending and count >= trace_start:
+                            import jax
+                            jax.profiler.start_trace(trace_dir)
+                            trace_pending = False
+                            trace_stop_at = count + trace_iters
+                        model.train_iter(count, self.recorder)
+                        self.exchanger.exchange(self.recorder, count)
+                        watchdog.beat(f"epoch {epoch} iter {count}")
+                        if trace_stop_at is not None and count + 1 >= trace_stop_at:
+                            _stop_trace()
+                        self.recorder.print_train_info(count, stride=spc)
 
-                model.begin_val()
-                for _ in range(model.data.n_batch_val):
-                    model.val_iter(count, self.recorder)
-                    watchdog.beat(f"epoch {epoch} val @ iter {count}")
-                model.end_val()
-                self.recorder.print_val_info(count)
+                    model.begin_val()
+                    for _ in range(model.data.n_batch_val):
+                        model.val_iter(count, self.recorder)
+                        watchdog.beat(f"epoch {epoch} val @ iter {count}")
+                    model.end_val()
+                    self.recorder.print_val_info(count)
 
-                if ckpt_dir:
-                    model.save(ckpt_dir, epoch, count)
-                if config.get("record_dir"):
-                    self.recorder.save(config["record_dir"])
-                watchdog.beat(f"epoch {epoch} end (ckpt/records saved)")
+                    if ckpt_dir:
+                        model.save(ckpt_dir, epoch, count)
+                    if config.get("record_dir"):
+                        self.recorder.save(config["record_dir"])
+                    watchdog.beat(f"epoch {epoch} end (ckpt/records saved)")
+        finally:
+            # async_ckpt: a completed epoch's in-flight write must land even
+            # when an exception (or Ctrl-C) unwinds the loop — the daemon
+            # writer would otherwise die mid-np.savez, truncating the file
+            if hasattr(model, "wait_pending_ckpt"):
+                try:
+                    model.wait_pending_ckpt()
+                except Exception as ckpt_exc:
+                    import sys as _sys
+                    if _sys.exc_info()[0] is None:
+                        raise       # sole failure: surface it
+                    print(f"async checkpoint ALSO failed during unwind: "
+                          f"{ckpt_exc!r}", file=_sys.stderr, flush=True)
         if trace_stop_at is not None:   # window outlived training: flush it
             _stop_trace()
         if self.verbose:
