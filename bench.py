@@ -136,6 +136,7 @@ def main() -> int:
         drain()
         return model, spc, n_images, time.time() - t0, compiled
 
+    retry = False
     try:
         model, spc, n_images, dt, compiled = measure(config)
     except Exception as e:
@@ -143,6 +144,11 @@ def main() -> int:
             raise
         print(f"steps_per_call={config['steps_per_call']} failed "
               f"({e!r}); falling back to 1", file=sys.stderr)
+        retry = True
+    if retry:
+        # retry OUTSIDE the except block: the failed attempt's traceback
+        # would otherwise keep its device buffers rooted while the fallback
+        # allocates a second full model
         config["steps_per_call"] = 1
         model, spc, n_images, dt, compiled = measure(config)
 

@@ -140,6 +140,46 @@ def test_imagenet_cursor_restores_aug_stream():
     np.testing.assert_array_equal(a["x"], b["x"])
 
 
+def test_bsp_checkpoint_is_worker_count_portable(tmp_path):
+    """Elastic resume: a BSP grads-mode checkpoint stores ONE replica, so it
+    restores onto a mesh of any worker count — train on 4 chips, resume on
+    8 (the reference could not change -np between runs)."""
+    d = str(tmp_path / "ckpt")
+    m4 = _model(n=4)
+    for i in range(3):
+        m4.train_iter(i + 1, None)
+    m4.save(d, epoch=0, count=3)
+    ref = jax.device_get(steps.unbox(m4.step_state["params"]))
+
+    m8 = _model(n=8)
+    assert m8.load(d) == 0
+    got = jax.device_get(m8.step_state["params"])
+    for a, b in zip(jax.tree_util.tree_leaves(ref),
+                    jax.tree_util.tree_leaves(got)):
+        for w in range(8):
+            np.testing.assert_array_equal(np.asarray(b)[w], np.asarray(a))
+    m8.train_iter(4, None)               # and it keeps training
+    # async-rule (boxed) checkpoints are NOT portable — they must fail
+    # loudly, not silently collapse replicas
+    from theanompi_tpu.parallel.exchanger import GOSGD_Exchanger
+    mesh = worker_mesh(4)
+    cfg = {"mesh": mesh, "size": 4, "rank": 0, "verbose": False,
+           "batch_size": 8}
+    g4 = TinyModel(cfg)
+    g4.compile_iter_fns(GOSGD_Exchanger(cfg))
+    g4.data.shuffle_data(0)
+    g4.train_iter(1, None)
+    d2 = str(tmp_path / "gossip")
+    g4.save(d2, epoch=0, count=1)
+    mesh8 = worker_mesh(8)
+    cfg8 = {"mesh": mesh8, "size": 8, "rank": 0, "verbose": False,
+            "batch_size": 8}
+    g8 = TinyModel(cfg8)
+    g8.compile_iter_fns(GOSGD_Exchanger(cfg8))
+    with pytest.raises(ValueError, match="incompatible checkpoint"):
+        g8.load(d2)
+
+
 def test_async_ckpt_matches_sync(tmp_path):
     """async_ckpt moves only the disk write off-thread: the landed files
     must be byte-equivalent to a synchronous save of the same state."""

@@ -382,6 +382,7 @@ class ModelBase:
         restored from, or None.  Restores the boxed per-worker state, the
         PRNG keys, and the data cursor, so training replays bit-identically
         from the save point (tested for BSP and GoSGD)."""
+        self.wait_pending_ckpt()    # async_ckpt: never read a mid-write file
         n = self.mesh.shape[WORKER_AXIS]
 
         def shape_of(x, boxed):

@@ -138,11 +138,14 @@ class Worker(MeshProcess):
             # when an exception (or Ctrl-C) unwinds the loop — the daemon
             # writer would otherwise die mid-np.savez, truncating the file
             if hasattr(model, "wait_pending_ckpt"):
+                import sys as _sys
+                # capture BEFORE the try: inside an except block exc_info
+                # reports the caught exception, not the unwinding one
+                unwinding = _sys.exc_info()[0] is not None
                 try:
                     model.wait_pending_ckpt()
                 except Exception as ckpt_exc:
-                    import sys as _sys
-                    if _sys.exc_info()[0] is None:
+                    if not unwinding:
                         raise       # sole failure: surface it
                     print(f"async checkpoint ALSO failed during unwind: "
                           f"{ckpt_exc!r}", file=_sys.stderr, flush=True)
