@@ -106,7 +106,13 @@ def main() -> int:
         rng = jax.random.key(0)
 
         compiled = None
-        if want_mfu:
+        mfu_this = want_mfu and spc == 1
+        if want_mfu and not mfu_this:
+            # XLA's cost_analysis does not reliably scale the scan body by
+            # its trip count — an spc>1 MFU would misread; the spc=1 row of
+            # the same config carries the MFU
+            print("mfu suppressed for steps_per_call > 1", file=sys.stderr)
+        if mfu_this:
             # AOT-compile once and reuse the SAME executable for the timed
             # loop and the flop count (a separate lower().compile() after
             # the run would pay a second full XLA compile)

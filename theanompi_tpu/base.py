@@ -60,7 +60,12 @@ class MeshProcess:
             num_processes=self.config.get("num_processes"),
             process_id=self.config.get("process_id"),
         )
-        self.mesh = worker_mesh(self.config.get("n_workers"))
+        # tp>1 (tensor parallelism, parallel/tp.py): n_workers counts
+        # data-parallel GROUPS; the mesh gains a 'model' axis and each group
+        # spans tp chips.  rank/size semantics (and the data sharding they
+        # drive) stay data-parallel.
+        self.mesh = worker_mesh(self.config.get("n_workers"),
+                                tp=int(self.config.get("tp", 1)))
         self.rank = jax.process_index()
         self.size = self.mesh.shape[WORKER_AXIS]
         self.config.update(rank=self.rank, size=self.size, mesh=self.mesh,

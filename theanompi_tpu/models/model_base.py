@@ -51,7 +51,8 @@ class ModelBase:
         self.size = self.config.get("size", 1)
         self.mesh = self.config.get("mesh")
         if self.mesh is None:
-            self.mesh = worker_mesh(self.config.get("n_workers"))
+            self.mesh = worker_mesh(self.config.get("n_workers"),
+                                    tp=int(self.config.get("tp", 1)))
             self.size = self.mesh.shape[WORKER_AXIS]
             # build_model()'s data object reads size from config — keep it
             # coherent when the model is constructed standalone (no Worker).
@@ -91,6 +92,7 @@ class ModelBase:
             else get_optimizer(self.optimizer, weight_decay=self.weight_decay)
 
         self.step_state: Optional[Dict[str, Any]] = None
+        self._state_specs = None
         self.train_fn = None
         self.val_fn = None
         self.exchanger = None
@@ -128,6 +130,12 @@ class ModelBase:
         err = L.errors(logits, batch["y"])
         return cost, (err, new_bn)
 
+    def param_specs(self):
+        """Per-leaf PartitionSpecs over the ``'model'`` mesh axis for tensor
+        -parallel models (``parallel/tp.py``), or None for pure data
+        parallelism (the whole CNN zoo — the reference's only mode)."""
+        return None
+
     def postprocess_grads(self, grads, count):
         """Traced hook before the exchange: transform gradients."""
         return grads
@@ -161,8 +169,13 @@ class ModelBase:
         opt_state = self.opt.init(self.params)
         unboxed = {"params": self.params, "opt_state": opt_state,
                    "bn_state": self.bn_state, "extra": extra}
-        self.step_state = {k: steps.replicate_tree(v, n, self.mesh)
-                           for k, v in unboxed.items()}
+        self._state_specs = None if self.param_specs() is None else \
+            steps.state_partition_specs(self, self.exchanger)
+        self.step_state = {
+            k: steps.replicate_tree(
+                v, n, self.mesh,
+                None if self._state_specs is None else self._state_specs[k])
+            for k, v in unboxed.items()}
         spc = int(self.steps_per_call)
         if spc > 1:
             # multi-step dispatch skips the between-steps Python exchange
@@ -241,7 +254,10 @@ class ModelBase:
         if self.exchanger is not None and hasattr(self.exchanger,
                                                   "canonical_params"):
             canon = self.exchanger.canonical_params(self.step_state)
-            self._val_params_boxed = steps.replicate_tree(canon, n, self.mesh)
+            pspec = None if self._state_specs is None \
+                else self._state_specs["params"]
+            self._val_params_boxed = steps.replicate_tree(canon, n, self.mesh,
+                                                          pspec)
             # Consistent statistics for the consensus model: score the center
             # with the replica-MEAN running stats, not each worker's divergent
             # local ones (the reference's server validated its own center
@@ -405,12 +421,17 @@ class ModelBase:
         meta = restored.pop("_meta")
         rngs = restored.pop("_rng_keys", None)
         cursor = restored.pop("_cursor", None)
+        sp = self._state_specs
         if boxed:
-            self.step_state = {k: steps.place_boxed(v, self.mesh)
-                               for k, v in restored.items()}
+            self.step_state = {
+                k: steps.place_boxed(v, self.mesh,
+                                     None if sp is None else sp[k])
+                for k, v in restored.items()}
         else:
-            self.step_state = {k: steps.replicate_tree(v, n, self.mesh)
-                               for k, v in restored.items()}
+            self.step_state = {
+                k: steps.replicate_tree(v, n, self.mesh,
+                                        None if sp is None else sp[k])
+                for k, v in restored.items()}
         if rngs:
             self._step_rng = rngs.get("step", self._step_rng)
             self._exch_key = rngs.get("exch", self._exch_key)

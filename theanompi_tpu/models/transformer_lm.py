@@ -63,21 +63,49 @@ class LMData(DataBase):
 
 
 class Block(L.Layer):
-    """Pre-LN transformer block: LN→MHA→residual, LN→MLP→residual."""
+    """Pre-LN transformer block: LN→MHA→residual, LN→MLP→residual.
+
+    ``tp > 1`` (tensor parallelism, ``parallel/tp.py``): the attention is
+    head-sharded and the MLP column→row-parallel over the ``'model'`` mesh
+    axis — same init and math as the dense block (pinned equal in
+    ``tests/test_tp.py``), two psums per block."""
 
     has_state = False
 
-    def __init__(self, dim, n_head, mlp_ratio=4, cd=jnp.bfloat16,
+    def __init__(self, dim, n_head, mlp_ratio=4, cd=jnp.bfloat16, tp=1,
                  name="block"):
+        from ..parallel import tp as tplib
         self.name = name
+        self.tp = tp
         self.ln1 = L.LayerNorm(dim, name="ln1")
-        self.attn = L.MultiHeadAttention(dim, n_head, compute_dtype=cd,
-                                         name="attn")
+        if tp > 1:
+            self.attn = tplib.TPMultiHeadAttention(dim, n_head, tp,
+                                                   compute_dtype=cd,
+                                                   name="attn")
+        else:
+            self.attn = L.MultiHeadAttention(dim, n_head, compute_dtype=cd,
+                                             name="attn")
         self.ln2 = L.LayerNorm(dim, name="ln2")
+        # fc1 is column-parallel under tp: a plain FC applied to the local
+        # weight shard IS the column-parallel layer (only the spec differs)
         self.fc1 = L.FC(dim, mlp_ratio * dim, w_init=("normal", 0.02),
                         activation="relu", compute_dtype=cd, name="fc1")
-        self.fc2 = L.FC(mlp_ratio * dim, dim, w_init=("normal", 0.02),
-                        activation=None, compute_dtype=cd, name="fc2")
+        fc2_cls = tplib.RowFC if tp > 1 else L.FC
+        self.fc2 = fc2_cls(mlp_ratio * dim, dim, w_init=("normal", 0.02),
+                           activation=None, compute_dtype=cd, name="fc2")
+
+    def specs(self):
+        """Per-leaf PartitionSpecs over 'model' (None when dense)."""
+        if self.tp == 1:
+            return None
+        from jax.sharding import PartitionSpec as P
+        M = "model"
+        ln = {"scale": P(), "bias": P()}
+        col = {"w": P(None, M), "b": P(M)}
+        return {"ln1": ln, "ln2": ln,
+                "attn": {"wq": P(None, M), "wk": P(None, M),
+                         "wv": P(None, M), "wo": P(M, None)},
+                "fc1": col, "fc2": {"w": P(M, None), "b": P()}}
 
     def init(self, key):
         ks = jax.random.split(key, 5)
@@ -108,20 +136,47 @@ class TransformerLM(ModelBase):
     n_layer = 2
     seq_len = 64
 
+    tp = 1          # tensor-parallel degree (mesh gains a 'model' axis)
+
     def build_model(self) -> None:
         cd = self.config.get("compute_dtype", jnp.bfloat16)
-        for k in ("vocab", "d_model", "n_head", "n_layer", "seq_len"):
+        for k in ("vocab", "d_model", "n_head", "n_layer", "seq_len", "tp"):
             if k in self.config:
                 setattr(self, k, int(self.config[k]))
-        self.embed = L.Embedding(self.vocab, self.d_model, compute_dtype=cd)
+        if self.tp > 1:
+            from ..parallel import tp as tplib
+            assert self.mesh.shape.get(tplib.MODEL_AXIS) == self.tp, (
+                f"tp={self.tp} needs a mesh with a '{tplib.MODEL_AXIS}' axis "
+                f"of that size (worker_mesh(n, tp={self.tp})); got "
+                f"{dict(self.mesh.shape)}")
+            self.embed = tplib.VocabParallelEmbedding(
+                self.vocab, self.d_model, self.tp, compute_dtype=cd)
+        else:
+            self.embed = L.Embedding(self.vocab, self.d_model,
+                                     compute_dtype=cd)
         self.pos = L.Embedding(self.seq_len, self.d_model, compute_dtype=cd,
                                name="pos")
-        self.blocks = [Block(self.d_model, self.n_head, cd=cd,
+        self.blocks = [Block(self.d_model, self.n_head, cd=cd, tp=self.tp,
                              name=f"block{i}") for i in range(self.n_layer)]
         self.ln_f = L.LayerNorm(self.d_model, name="ln_f")
+        # under tp the head is column-parallel over the VOCAB; the loss works
+        # directly on the sharded logits (vocab-parallel cross-entropy)
         self.head = L.FC(self.d_model, self.vocab, w_init=("normal", 0.02),
                          activation=None, compute_dtype=cd, name="head")
         self.data = LMData(self.config, self.batch_size)
+
+    def param_specs(self):
+        if self.tp == 1:
+            return None
+        from jax.sharding import PartitionSpec as P
+        M = "model"
+        specs = {"embed": {"w": P(M, None)},       # vocab-sharded table
+                 "pos": {"w": P()},
+                 "ln_f": {"scale": P(), "bias": P()},
+                 "head": {"w": P(None, M), "b": P(M)}}
+        for blk in self.blocks:
+            specs[blk.name] = blk.specs()
+        return specs
 
     def init_params(self, key):
         ks = jax.random.split(key, len(self.blocks) + 4)
@@ -149,6 +204,10 @@ class TransformerLM(ModelBase):
         v = logits.shape[-1]
         flat = logits.reshape(-1, v)
         y = batch["y"].reshape(-1)
+        if self.tp > 1:
+            from ..parallel import tp as tplib
+            return tplib.tp_softmax_cross_entropy(flat, y), \
+                (tplib.tp_errors(flat, y), bn_state)
         cost = L.softmax_cross_entropy(flat, y)
         err = L.errors(flat, y)
         return cost, (err, bn_state)
@@ -159,5 +218,9 @@ class TransformerLM(ModelBase):
         v = logits.shape[-1]
         flat = logits.reshape(-1, v)
         y = batch["y"].reshape(-1)
+        if self.tp > 1:
+            from ..parallel import tp as tplib
+            return tplib.tp_softmax_cross_entropy(flat, y), \
+                (tplib.tp_errors(flat, y), tplib.tp_errors_top_x(flat, y, 5))
         cost = L.softmax_cross_entropy(flat, y)
         return cost, (L.errors(flat, y), L.errors_top_x(flat, y, 5))

@@ -88,6 +88,17 @@ class Exchanger:
         """Unboxed per-worker persistent state (error feedback, center, α...)."""
         return {}
 
+    def extra_specs(self, param_specs):
+        """Per-leaf PartitionSpecs for :meth:`extra_state_template` when the
+        model is tensor-parallel (``model.param_specs() is not None``).  Must
+        mirror the template's structure.  Rules whose extra state is a copy
+        of the params (EASGD/ASGD centers) return ``param_specs`` shapes."""
+        if self.extra_state_template():
+            raise NotImplementedError(
+                f"{type(self).__name__} does not declare tensor-parallel "
+                "specs for its extra state")
+        return {}
+
     # -- in-step (traced) --------------------------------------------------
 
     def step_update(self, params, opt_state, grads, extra, lr, *, axis, size,
@@ -151,12 +162,22 @@ class BSP_Exchanger(Exchanger):
         return (self.mode == "grads" and not self.strategy.stateful
                 and self.strategy.name != "none")
 
+    def extra_specs(self, param_specs):
+        if self.strategy.stateful:
+            # error-feedback state is one flat vector sized from GLOBAL
+            # shapes (strategies.py init_state) — its local-shard layout
+            # under tp is a later-round composition
+            raise NotImplementedError(
+                f"compressed strategy {self.strategy.name!r} does not "
+                "compose with tensor parallelism yet; use "
+                "allreduce/ring/none")
+        return {}
+
     def prepare(self, mesh: Mesh, model) -> None:
         super().prepare(mesh, model)
         if self.mode == "params":
             axis, n = WORKER_AXIS, self.size
-            state_spec = {k: P(axis) for k in
-                          ("params", "opt_state", "bn_state", "extra")}
+            state_spec = steps.state_partition_specs(model, self, axis)
 
             def body(state, key, count):
                 params = steps.unbox(state["params"])
@@ -221,11 +242,14 @@ class EASGD_Exchanger(Exchanger):
     def extra_state_template(self) -> Dict[str, Any]:
         return {"center": jax.tree.map(jnp.asarray, self.model.params)}
 
+    def extra_specs(self, param_specs):
+        # the center is a params-shaped tree: same per-leaf layout
+        return {"center": param_specs}
+
     def prepare(self, mesh: Mesh, model) -> None:
         super().prepare(mesh, model)
         axis, alpha = WORKER_AXIS, self.alpha
-        state_spec = {k: P(axis) for k in
-                      ("params", "opt_state", "bn_state", "extra")}
+        state_spec = steps.state_partition_specs(model, self, axis)
 
         def body(state, key, count):
             params = steps.unbox(state["params"])
@@ -268,11 +292,13 @@ class ASGD_Exchanger(Exchanger):
     def extra_state_template(self) -> Dict[str, Any]:
         return {"center": jax.tree.map(jnp.asarray, self.model.params)}
 
+    def extra_specs(self, param_specs):
+        return {"center": param_specs}
+
     def prepare(self, mesh: Mesh, model) -> None:
         super().prepare(mesh, model)
         axis = WORKER_AXIS
-        state_spec = {k: P(axis) for k in
-                      ("params", "opt_state", "bn_state", "extra")}
+        state_spec = steps.state_partition_specs(model, self, axis)
 
         def body(state, key, count):
             params = steps.unbox(state["params"])
@@ -334,6 +360,9 @@ class GOSGD_Exchanger(Exchanger):
     def extra_state_template(self) -> Dict[str, Any]:
         return {"alpha": jnp.ones(())}
 
+    def extra_specs(self, param_specs):
+        return {"alpha": P()}
+
     @staticmethod
     def _derangements(n: int, k: int, seed: int = 0x605) -> np.ndarray:
         """k distinct random derangements of range(n) (static, seeded)."""
@@ -355,8 +384,7 @@ class GOSGD_Exchanger(Exchanger):
     def prepare(self, mesh: Mesh, model) -> None:
         super().prepare(mesh, model)
         axis, n, p_share = WORKER_AXIS, self.size, self.p_share
-        state_spec = {k: P(axis) for k in
-                      ("params", "opt_state", "bn_state", "extra")}
+        state_spec = steps.state_partition_specs(model, self, axis)
         n_bits = max(1, int(np.ceil(np.log2(max(n, 2)))))
         if self.peers_mode == "perm":
             perms = self._derangements(n, self.n_perms)

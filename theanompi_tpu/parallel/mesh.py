@@ -23,6 +23,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 WORKER_AXIS = "workers"
+MODEL_AXIS = "model"      # tensor/expert-parallel axis (parallel/tp.py)
 
 
 def init_multihost(
@@ -55,26 +56,42 @@ def worker_mesh(
     n_workers: Optional[int] = None,
     devices: Optional[Sequence[jax.Device]] = None,
     axis_name: str = WORKER_AXIS,
+    tp: int = 1,
 ) -> Mesh:
-    """Build the 1-D data-parallel mesh — the TPU-native "communicator".
+    """Build the data-parallel mesh — the TPU-native "communicator".
 
     Reference equivalent: the set of MPI ranks created by
     ``mpirun -np N python -m theanompi.worker`` with one rank per GPU
     (SURVEY.md §2.1, §2.6).  Theano-MPI's parallelism surface is pure data
     parallelism in four flavors, so the canonical mesh is 1-D over
     ``'workers'``.
+
+    ``tp > 1`` adds a second ``'model'`` axis (``n_workers × tp`` devices):
+    each data-parallel "worker" becomes a GROUP of ``tp`` chips sharing one
+    tensor-parallel model replica (``parallel/tp.py``).  The inner (fastest
+    -varying) axis is ``'model'`` so a TP group sits on adjacent chips —
+    per-layer psums ride the shortest ICI hops, the dp collective the longer
+    ones, matching their per-step frequencies.
     """
     if devices is None:
         devices = jax.devices()
+    tp = int(tp)
     if n_workers is None:
-        n_workers = len(devices)
-    if n_workers > len(devices):
+        n_workers = len(devices) // tp
+        if n_workers == 0:
+            raise ValueError(
+                f"tp={tp} needs at least tp devices but only "
+                f"{len(devices)} are visible")
+    need = n_workers * tp
+    if need > len(devices):
         raise ValueError(
-            f"requested {n_workers} workers but only {len(devices)} devices "
-            f"are visible ({[str(d) for d in devices]})"
+            f"requested {n_workers} workers × tp={tp} = {need} devices but "
+            f"only {len(devices)} are visible ({[str(d) for d in devices]})"
         )
-    dev = np.asarray(devices[:n_workers])
-    return Mesh(dev, (axis_name,))
+    if tp == 1:
+        return Mesh(np.asarray(devices[:n_workers]), (axis_name,))
+    dev = np.asarray(devices[:need]).reshape(n_workers, tp)
+    return Mesh(dev, (axis_name, MODEL_AXIS))
 
 
 def mesh_size(mesh: Mesh, axis_name: str = WORKER_AXIS) -> int:

@@ -44,11 +44,17 @@ def unbox(tree):
     return jax.tree.map(lambda x: x[0], tree)
 
 
-def place_boxed(tree, mesh: Mesh):
+def place_boxed(tree, mesh: Mesh, specs=None):
     """Place an already-boxed ``[n_workers, ...]`` host pytree onto the mesh
-    (checkpoint restore: per-worker replicas round-trip without collapsing)."""
-    sh = worker_local_sharding(mesh)
-    return jax.tree.map(lambda x: jax.device_put(np.asarray(x), sh), tree)
+    (checkpoint restore: per-worker replicas round-trip without collapsing).
+    ``specs``: optional same-structure pytree of BOXED PartitionSpecs (tensor
+    -parallel models shard some leaves over ``'model'`` too)."""
+    if specs is None:
+        sh = worker_local_sharding(mesh)
+        return jax.tree.map(lambda x: jax.device_put(np.asarray(x), sh), tree)
+    return jax.tree.map(
+        lambda x, s: jax.device_put(np.asarray(x), NamedSharding(mesh, s)),
+        tree, specs)
 
 
 def tree_to_host(tree):
@@ -60,16 +66,55 @@ def tree_to_host(tree):
     return jax.device_get(tree)
 
 
-def replicate_tree(tree, n: int, mesh: Mesh):
+def replicate_tree(tree, n: int, mesh: Mesh, specs=None):
     """Broadcast an unboxed pytree to the boxed [n_workers, ...] layout and
-    place it sharded over the workers axis (one replica per chip)."""
-    sh = worker_local_sharding(mesh)
-    return jax.tree.map(
-        lambda x: jax.device_put(
-            np.broadcast_to(np.asarray(x)[None], (n,) + np.asarray(x).shape), sh
-        ),
-        tree,
-    )
+    place it sharded over the workers axis (one replica per chip — or per
+    tp GROUP of chips when ``specs`` shard leaves over ``'model'`` too)."""
+    def put(x, sh):
+        x = np.asarray(x)
+        return jax.device_put(np.broadcast_to(x[None], (n,) + x.shape), sh)
+
+    if specs is None:
+        sh = worker_local_sharding(mesh)
+        return jax.tree.map(lambda x: put(x, sh), tree)
+    return jax.tree.map(lambda x, s: put(x, NamedSharding(mesh, s)),
+                        tree, specs)
+
+
+def boxed_specs(tree, axis: str = WORKER_AXIS):
+    """Prefix every leaf PartitionSpec in ``tree`` with the worker axis
+    (``None`` leaves mean replicated)."""
+    return jax.tree.map(lambda s: P(axis, *(s or ())), tree, is_leaf=_is_spec)
+
+
+def state_partition_specs(model, exchanger, axis: str = WORKER_AXIS):
+    """Boxed PartitionSpecs for the four step-state parts.
+
+    Data-parallel-only models (``param_specs() is None``, the whole CNN zoo):
+    the uniform prefix ``P(axis)`` — every leaf is a per-worker replica.
+
+    Tensor-parallel models declare per-leaf specs over the ``'model'`` axis
+    (``parallel/tp.py``); here they are prefixed with the worker axis and
+    propagated structurally to the optimizer state (same per-leaf layout as
+    the params they belong to — ``utils/opt.py``) and the exchanger's extra
+    state (``Exchanger.extra_specs``).
+    """
+    pspecs = model.param_specs()
+    if pspecs is None:
+        return {k: P(axis)
+                for k in ("params", "opt_state", "bn_state", "extra")}
+
+    from ..utils.opt import opt_state_specs
+    bn = jax.tree.map(lambda x: P(), model.bn_state)
+    return {"params": boxed_specs(pspecs, axis),
+            "opt_state": boxed_specs(opt_state_specs(model.optimizer,
+                                                     pspecs), axis),
+            "bn_state": boxed_specs(bn, axis),
+            "extra": boxed_specs(exchanger.extra_specs(pspecs), axis)}
+
+
+def _is_spec(x) -> bool:
+    return x is None or isinstance(x, P)
 
 
 # ---------------------------------------------------------------------------
@@ -204,7 +249,7 @@ def build_train_step(mesh: Mesh, model, exchanger, n_steps: int = 1) -> Callable
             state, (costs, errs) = lax.scan(body, state, (batches, js))
             return state, jnp.mean(costs)[None], jnp.mean(errs)[None]
 
-    state_spec = {k: P(axis) for k in ("params", "opt_state", "bn_state", "extra")}
+    state_spec = state_partition_specs(model, exchanger, axis)
     batch_spec = P(axis) if n_steps == 1 else P(None, axis)
     sm = jax.shard_map(
         per_worker, mesh=mesh,
@@ -229,9 +274,15 @@ def build_val_step(mesh: Mesh, model) -> Callable:
         cost, (err, err5) = model.val_metrics(params, bn_state, batch)
         return cost[None], err[None], err5[None]
 
+    pspecs = model.param_specs()
+    if pspecs is None:
+        p_spec = bn_spec = P(axis)
+    else:
+        p_spec = boxed_specs(pspecs, axis)
+        bn_spec = jax.tree.map(lambda x: P(axis), model.bn_state)
     sm = jax.shard_map(
         per_worker, mesh=mesh,
-        in_specs=(P(axis), P(axis), P(axis)),
+        in_specs=(p_spec, bn_spec, P(axis)),
         out_specs=(P(axis), P(axis), P(axis)),
     )
     return jax.jit(sm)

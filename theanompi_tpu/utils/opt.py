@@ -146,6 +146,24 @@ OPTIMIZERS = {
 }
 
 
+def opt_state_specs(name: str, param_specs):
+    """PartitionSpecs for an optimizer's state given the params' per-leaf
+    specs (tensor-parallel models, ``parallel/tp.py``): every momentum/second
+    -moment buffer is laid out exactly like the parameter it belongs to;
+    adam's per-leaf step counters are scalars (replicated)."""
+    from jax.sharding import PartitionSpec as P
+    from ..parallel.steps import _is_spec
+
+    if name == "sgd":
+        return ()
+    if name in ("momentum", "nesterov", "rmsprop"):
+        return param_specs
+    if name == "adam":
+        scalars = jax.tree.map(lambda s: P(), param_specs, is_leaf=_is_spec)
+        return {"m": param_specs, "v": param_specs, "t": scalars}
+    raise ValueError(f"no state-spec rule for optimizer {name!r}")
+
+
 def get_optimizer(name: str, **kwargs) -> OptPair:
     try:
         return OPTIMIZERS[name](**kwargs)
