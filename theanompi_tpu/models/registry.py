@@ -18,6 +18,8 @@ MODELS = {
                  {"synthetic_batches": 4}),
     "transformer_lm": ("theanompi_tpu.models.transformer_lm", "TransformerLM",
                        {"synthetic_train": 2048}),
+    "moe_lm": ("theanompi_tpu.models.transformer_lm", "MoETransformerLM",
+               {"synthetic_train": 2048}),
     # 8192 synthetic samples: enough for a 64-worker × batch-128 global
     # batch in the scaling sweep (the bench's per-chip runs need far less)
     "cifar10": ("theanompi_tpu.models.cifar10", "Cifar10_model",

@@ -95,11 +95,11 @@ class Block(L.Layer):
                            activation=None, compute_dtype=cd, name="fc2")
 
     def specs(self):
-        """Per-leaf PartitionSpecs over 'model' (None when dense)."""
+        """Per-leaf PartitionSpecs over the 'model' axis (None when dense)."""
         if self.tp == 1:
             return None
         from jax.sharding import PartitionSpec as P
-        M = "model"
+        from ..parallel.mesh import MODEL_AXIS as M
         ln = {"scale": P(), "bias": P()}
         col = {"w": P(None, M), "b": P(M)}
         return {"ln1": ln, "ln2": ln,
@@ -120,6 +120,45 @@ class Block(L.Layer):
         h = self.fc1.apply(params["fc1"], h)
         h = self.fc2.apply(params["fc2"], h)
         return x + h
+
+
+class MoEBlock(Block):
+    """Transformer block whose MLP is a Switch-style top-1 mixture of
+    experts (``parallel/moe.py``), expert-parallel over ``'model'`` when
+    ``ep > 1``.  ``apply`` returns ``(y, aux)`` — the load-balance loss rides
+    up to the model's loss head."""
+
+    def __init__(self, dim, n_head, n_experts, mlp_ratio=4, cd=jnp.bfloat16,
+                 tp=1, capacity_factor=1.25, name="moe_block"):
+        # attention (and its specs) come from Block; tp doubles as the
+        # expert-parallel degree — both shard over the same 'model' axis
+        super().__init__(dim, n_head, mlp_ratio=mlp_ratio, cd=cd, tp=tp,
+                         name=name)
+        from ..parallel.moe import MoE
+        self.moe = MoE(dim, n_experts, mlp_ratio=mlp_ratio, ep=tp,
+                       capacity_factor=capacity_factor, compute_dtype=cd,
+                       name="moe")
+        del self.fc1, self.fc2
+
+    def init(self, key):
+        ks = jax.random.split(key, 4)
+        return {"ln1": self.ln1.init(ks[0]), "attn": self.attn.init(ks[1]),
+                "ln2": self.ln2.init(ks[2]), "moe": self.moe.init(ks[3])}
+
+    def specs(self):
+        s = super().specs()
+        if s is None:
+            return None
+        del s["fc1"], s["fc2"]
+        s["moe"] = self.moe.specs()
+        return s
+
+    def apply(self, params, x, *, train=False, rng=None, state=None):
+        h = self.ln1.apply(params["ln1"], x)
+        x = x + self.attn.apply(params["attn"], h, train=train)
+        h = self.ln2.apply(params["ln2"], x)
+        y, aux = self.moe.apply(params["moe"], h, train=train)
+        return x + y, aux
 
 
 class TransformerLM(ModelBase):
@@ -169,7 +208,7 @@ class TransformerLM(ModelBase):
         if self.tp == 1:
             return None
         from jax.sharding import PartitionSpec as P
-        M = "model"
+        from ..parallel.mesh import MODEL_AXIS as M
         specs = {"embed": {"w": P(M, None)},       # vocab-sharded table
                  "pos": {"w": P()},
                  "ln_f": {"scale": P(), "bias": P()},
@@ -224,3 +263,75 @@ class TransformerLM(ModelBase):
                 (tplib.tp_errors(flat, y), tplib.tp_errors_top_x(flat, y, 5))
         cost = L.softmax_cross_entropy(flat, y)
         return cost, (L.errors(flat, y), L.errors_top_x(flat, y, 5))
+
+
+class MoETransformerLM(TransformerLM):
+    """Sparse-FFN variant: every ``moe_every``-th block's MLP is a Switch
+    top-1 mixture of ``moe_experts`` experts (``parallel/moe.py``).  Under
+    ``tp > 1`` the experts are SHARDED over the ``'model'`` axis (expert
+    parallelism) while attention stays tensor-parallel on the same axis.
+    The Switch load-balance loss is added to the objective with coefficient
+    ``moe_aux`` and surfaced per-step via ``current_info``-style cost."""
+
+    moe_experts = 4
+    moe_every = 2          # every k-th block is MoE (1 = all blocks)
+    moe_aux = 0.01
+    capacity_factor = 1.25
+
+    def build_model(self) -> None:
+        super().build_model()
+        cd = self.config.get("compute_dtype", jnp.bfloat16)
+        for k in ("moe_experts", "moe_every"):
+            if k in self.config:
+                setattr(self, k, int(self.config[k]))
+        for k in ("moe_aux", "capacity_factor"):
+            if k in self.config:
+                setattr(self, k, float(self.config[k]))
+        if self.tp > 1:
+            assert self.moe_experts % self.tp == 0, (
+                f"moe_experts={self.moe_experts} not divisible by "
+                f"tp/ep={self.tp}")
+        self.blocks = [
+            MoEBlock(self.d_model, self.n_head, self.moe_experts, cd=cd,
+                     tp=self.tp, capacity_factor=self.capacity_factor,
+                     name=f"block{i}")
+            if (i + 1) % self.moe_every == 0 else
+            Block(self.d_model, self.n_head, cd=cd, tp=self.tp,
+                  name=f"block{i}")
+            for i in range(self.n_layer)]
+
+    def _forward(self, params, x, *, train):
+        t = x.shape[1]
+        h = self.embed.apply(params["embed"], x) + \
+            self.pos.apply(params["pos"], jnp.arange(t))[None]
+        aux = jnp.zeros((), jnp.float32)
+        n_moe = 0
+        for blk in self.blocks:
+            out = blk.apply(params[blk.name], h, train=train)
+            if isinstance(blk, MoEBlock):
+                h, a = out
+                aux = aux + a
+                n_moe += 1
+            else:
+                h = out
+        h = self.ln_f.apply(params["ln_f"], h)
+        logits = self.head.apply(params["head"], h)
+        return logits, aux / max(n_moe, 1)
+
+    def apply_model(self, params, x, *, train, rng, state):
+        logits, _ = self._forward(params, x, train=train)
+        return logits, state
+
+    def loss_and_metrics(self, params, bn_state, batch, rng, train):
+        logits, aux = self._forward(params, batch["x"], train=train)
+        v = logits.shape[-1]
+        flat = logits.reshape(-1, v)
+        y = batch["y"].reshape(-1)
+        if self.tp > 1:
+            from ..parallel import tp as tplib
+            cost = tplib.tp_softmax_cross_entropy(flat, y)
+            err = tplib.tp_errors(flat, y)
+        else:
+            cost = L.softmax_cross_entropy(flat, y)
+            err = L.errors(flat, y)
+        return cost + self.moe_aux * aux, (err, bn_state)

@@ -1,0 +1,141 @@
+"""Mixture-of-Experts with expert parallelism over the ``'model'`` axis.
+
+Beyond-parity capability (the reference — Theano-MPI, SURVEY.md §1 — is pure
+data parallelism): a Switch-Transformer-style top-1 MoE FFN whose experts are
+SHARDED over the ``'model'`` mesh axis — each chip in a tensor-parallel group
+hosts ``E/ep`` complete experts, so the FFN parameter count scales with the
+mesh while per-chip compute stays flat.
+
+TPU-first mapping (the Mesh-TensorFlow / Switch einsum formulation):
+
+* routing, capacity masking, and the dispatch one-hot ``[N, E, C]`` are
+  computed from REPLICATED activations (identical on every chip of the tp
+  group) — no all-to-all is needed: each chip slices ITS experts' columns of
+  the dispatch tensor, gathers its tokens with one einsum (an MXU matmul, no
+  ragged scatter), runs its experts batched, and one ``psum`` over
+  ``'model'`` assembles the combined output.  Static shapes throughout —
+  over-capacity tokens are dropped (they ride the residual connection), the
+  standard Switch behavior.
+* the load-balance auxiliary loss is the Switch one: ``E · Σ_e f_e · P_e``
+  (``f_e`` = fraction of tokens routed to expert e, ``P_e`` = mean router
+  probability), 1.0 at perfectly uniform routing.
+
+``ep == 1`` (no ``'model'`` axis) runs the identical math without the slice
+and psum — pinned equal to a dense MLP when all experts share weights
+(``tests/test_moe.py``).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from ..models import layers as L
+from .mesh import MODEL_AXIS
+
+
+class MoE(L.Layer):
+    """Top-1 (Switch) mixture of 2-layer MLP experts, optionally expert
+    -parallel over ``'model'``.
+
+    ``apply`` returns ``(y, aux)`` — the combined output and the scalar load
+    -balance loss — so callers must unpack (the transformer block does).
+    """
+
+    has_state = False
+
+    def __init__(self, dim, n_experts, mlp_ratio=4, ep: int = 1,
+                 capacity_factor: float = 1.25, w_init=("normal", 0.02),
+                 compute_dtype=jnp.bfloat16, axis: str = MODEL_AXIS,
+                 name: str = "moe"):
+        assert n_experts % ep == 0, \
+            f"n_experts={n_experts} not divisible by ep={ep}"
+        self.dim, self.n_experts, self.hidden = dim, n_experts, mlp_ratio * dim
+        self.ep = ep
+        self.capacity_factor = float(capacity_factor)
+        self.w_init = w_init
+        self.compute_dtype = compute_dtype
+        self.axis = axis
+        self.name = name
+
+    def init(self, key):
+        kg, k1, k2 = jax.random.split(key, 3)
+        E, d, f = self.n_experts, self.dim, self.hidden
+        return {
+            "wg": L.init_weight(kg, (d, E), self.w_init),
+            "w1": L.init_weight(k1, (E, d, f), self.w_init),
+            "b1": jnp.zeros((E, f)),
+            "w2": L.init_weight(k2, (E, f, d), self.w_init),
+            "b2": jnp.zeros((E, d)),
+        }
+
+    def specs(self):
+        """Per-leaf PartitionSpecs: router replicated, experts sharded on
+        their leading (expert) dim.  None when ep == 1."""
+        if self.ep == 1:
+            return None
+        from jax.sharding import PartitionSpec as P
+        M = self.axis
+        return {"wg": P(), "w1": P(M, None, None), "b1": P(M, None),
+                "w2": P(M, None, None), "b2": P(M, None)}
+
+    def capacity(self, n_tokens: int) -> int:
+        return max(1, int(np.ceil(
+            n_tokens / self.n_experts * self.capacity_factor)))
+
+    def apply(self, params, x, *, train=False, rng=None, state=None):
+        cd = self.compute_dtype
+        shape = x.shape
+        d, E = self.dim, self.n_experts
+        xf = x.reshape(-1, d)
+        n = xf.shape[0]
+        C = self.capacity(n)
+
+        # -- routing (fp32, replicated over the model axis) ---------------
+        logits = jnp.dot(xf.astype(jnp.float32),
+                         params["wg"].astype(jnp.float32))       # [N, E]
+        probs = jax.nn.softmax(logits, axis=-1)
+        eidx = jnp.argmax(probs, axis=-1)                        # [N]
+        gate = jnp.max(probs, axis=-1)                           # [N]
+        assign = jax.nn.one_hot(eidx, E, dtype=jnp.float32)      # [N, E]
+
+        # Switch aux loss: E · Σ_e f_e · P_e  (1.0 at uniform routing)
+        f_e = jnp.mean(assign, axis=0)
+        p_e = jnp.mean(probs, axis=0)
+        aux = E * jnp.sum(f_e * p_e)
+
+        # -- capacity + dispatch one-hot [N, E, C] -------------------------
+        pos = jnp.cumsum(assign, axis=0) - 1.0                   # [N, E]
+        keep = (pos < C).astype(jnp.float32) * assign
+        disp = keep[:, :, None] * jax.nn.one_hot(
+            pos.astype(jnp.int32), C, dtype=jnp.float32)
+
+        # -- expert-parallel slice: my E/ep experts ------------------------
+        e_loc = E // self.ep
+        if self.ep > 1:
+            rank = lax.axis_index(self.axis)
+            disp = lax.dynamic_slice_in_dim(disp, rank * e_loc, e_loc, axis=1)
+            comb_gate = lax.dynamic_slice_in_dim(
+                keep * gate[:, None], rank * e_loc, e_loc, axis=1)
+            w1, b1 = params["w1"], params["b1"]    # local [E/ep, ...] shards
+            w2, b2 = params["w2"], params["b2"]
+        else:
+            comb_gate = keep * gate[:, None]
+            w1, b1, w2, b2 = (params["w1"], params["b1"],
+                              params["w2"], params["b2"])
+
+        # -- gather → batched expert MLP → combine (all MXU einsums) -------
+        xe = jnp.einsum("nec,nd->ecd", disp.astype(cd), xf.astype(cd))
+        h = jax.nn.relu(
+            jnp.einsum("ecd,edf->ecf", xe, w1.astype(cd))
+            + b1[:, None, :].astype(cd))
+        ye = jnp.einsum("ecf,efd->ecd", h, w2.astype(cd)) \
+            + b2[:, None, :].astype(cd)
+        comb = (disp * comb_gate[:, :, None]).astype(cd)
+        y = jnp.einsum("ecd,nec->nd", ye, comb)
+        if self.ep > 1:
+            y = lax.psum(y, self.axis)
+            aux = lax.pmean(aux, self.axis)   # equal values; mark invariant
+        return y.reshape(shape).astype(x.dtype), aux
