@@ -65,7 +65,8 @@ class MeshProcess:
         # spans tp chips.  rank/size semantics (and the data sharding they
         # drive) stay data-parallel.
         self.mesh = worker_mesh(self.config.get("n_workers"),
-                                tp=int(self.config.get("tp", 1)))
+                                tp=int(self.config.get("tp", 1)),
+                                pp=int(self.config.get("pp", 1)))
         self.rank = jax.process_index()
         self.size = self.mesh.shape[WORKER_AXIS]
         self.config.update(rank=self.rank, size=self.size, mesh=self.mesh,

@@ -52,7 +52,8 @@ class ModelBase:
         self.mesh = self.config.get("mesh")
         if self.mesh is None:
             self.mesh = worker_mesh(self.config.get("n_workers"),
-                                    tp=int(self.config.get("tp", 1)))
+                                    tp=int(self.config.get("tp", 1)),
+                                    pp=int(self.config.get("pp", 1)))
             self.size = self.mesh.shape[WORKER_AXIS]
             # build_model()'s data object reads size from config — keep it
             # coherent when the model is constructed standalone (no Worker).

@@ -176,12 +176,26 @@ class TransformerLM(ModelBase):
     seq_len = 64
 
     tp = 1          # tensor-parallel degree (mesh gains a 'model' axis)
+    pp = 1          # pipeline-parallel degree (mesh gains a 'pipe' axis)
+    pp_microbatches = 0   # microbatches streamed per step (0 → 2·pp)
 
     def build_model(self) -> None:
         cd = self.config.get("compute_dtype", jnp.bfloat16)
-        for k in ("vocab", "d_model", "n_head", "n_layer", "seq_len", "tp"):
+        for k in ("vocab", "d_model", "n_head", "n_layer", "seq_len", "tp",
+                  "pp", "pp_microbatches"):
             if k in self.config:
                 setattr(self, k, int(self.config[k]))
+        if self.pp > 1:
+            from ..parallel.mesh import PIPE_AXIS
+            assert self.tp == 1, "tp and pp compose in a later round"
+            assert self.mesh.shape.get(PIPE_AXIS) == self.pp, (
+                f"pp={self.pp} needs a mesh with a '{PIPE_AXIS}' axis of "
+                f"that size (worker_mesh(n, pp={self.pp})); got "
+                f"{dict(self.mesh.shape)}")
+            assert self.n_layer % self.pp == 0, (
+                f"n_layer={self.n_layer} not divisible by pp={self.pp}")
+            if not self.pp_microbatches:
+                self.pp_microbatches = 2 * self.pp
         if self.tp > 1:
             from ..parallel import tp as tplib
             assert self.mesh.shape.get(tplib.MODEL_AXIS) == self.tp, (
@@ -205,9 +219,17 @@ class TransformerLM(ModelBase):
         self.data = LMData(self.config, self.batch_size)
 
     def param_specs(self):
+        from jax.sharding import PartitionSpec as P
+        if self.pp > 1:
+            from ..parallel.mesh import PIPE_AXIS
+            struct = jax.eval_shape(self.blocks[0].init, jax.random.key(0))
+            rep = {"scale": P(), "bias": P()}
+            return {"embed": {"w": P()}, "pos": {"w": P()}, "ln_f": rep,
+                    "head": {"w": P(), "b": P()},
+                    # stacked [n_layer, ...] leaves: layer dim over stages
+                    "blocks": jax.tree.map(lambda _: P(PIPE_AXIS), struct)}
         if self.tp == 1:
             return None
-        from jax.sharding import PartitionSpec as P
         from ..parallel.mesh import MODEL_AXIS as M
         specs = {"embed": {"w": P(M, None)},       # vocab-sharded table
                  "pos": {"w": P()},
@@ -221,6 +243,11 @@ class TransformerLM(ModelBase):
         ks = jax.random.split(key, len(self.blocks) + 4)
         p = {"embed": self.embed.init(ks[0]), "pos": self.pos.init(ks[1]),
              "ln_f": self.ln_f.init(ks[2]), "head": self.head.init(ks[3])}
+        if self.pp > 1:
+            # stack the per-layer params [n_layer, ...] from the SAME keys
+            # the dense layout would use — pp=k and pp=1 are the same model
+            p["blocks"] = jax.vmap(self.blocks[0].init)(ks[4:])
+            return p
         for i, blk in enumerate(self.blocks):
             p[blk.name] = blk.init(ks[4 + i])
         return p
@@ -232,8 +259,22 @@ class TransformerLM(ModelBase):
         t = x.shape[1]
         h = self.embed.apply(params["embed"], x) + \
             self.pos.apply(params["pos"], jnp.arange(t))[None]
-        for blk in self.blocks:
-            h = blk.apply(params[blk.name], h, train=train)
+        if self.pp > 1:
+            from ..parallel import pipeline as pl
+            tpl = self.blocks[0]
+
+            def stage_fn(stack, hm):
+                def body(hh, lp):
+                    return tpl.apply(lp, hh, train=train), None
+                hh, _ = jax.lax.scan(body, hm, stack)
+                return hh
+
+            hm = pl.microbatch(h, self.pp_microbatches)
+            hm = pl.pipeline_apply(stage_fn, params["blocks"], hm)
+            h = pl.unmicrobatch(hm)
+        else:
+            for blk in self.blocks:
+                h = blk.apply(params[blk.name], h, train=train)
         h = self.ln_f.apply(params["ln_f"], h)
         return self.head.apply(params["head"], h), state
 
@@ -280,6 +321,9 @@ class MoETransformerLM(TransformerLM):
 
     def build_model(self) -> None:
         super().build_model()
+        assert self.pp == 1, (
+            "pipeline parallelism needs a homogeneous block stack; the "
+            "mixed MoE/dense stack does not compose with pp yet")
         cd = self.config.get("compute_dtype", jnp.bfloat16)
         for k in ("moe_experts", "moe_every"):
             if k in self.config:

@@ -24,6 +24,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 WORKER_AXIS = "workers"
 MODEL_AXIS = "model"      # tensor/expert-parallel axis (parallel/tp.py)
+PIPE_AXIS = "pipe"        # pipeline-stage axis (parallel/pipeline.py)
 
 
 def init_multihost(
@@ -57,6 +58,7 @@ def worker_mesh(
     devices: Optional[Sequence[jax.Device]] = None,
     axis_name: str = WORKER_AXIS,
     tp: int = 1,
+    pp: int = 1,
 ) -> Mesh:
     """Build the data-parallel mesh — the TPU-native "communicator".
 
@@ -72,26 +74,36 @@ def worker_mesh(
     -varying) axis is ``'model'`` so a TP group sits on adjacent chips —
     per-layer psums ride the shortest ICI hops, the dp collective the longer
     ones, matching their per-step frequencies.
+
+    ``pp > 1`` adds a ``'pipe'`` axis instead: each worker group spans ``pp``
+    pipeline stages (``parallel/pipeline.py``).  ``tp`` and ``pp`` are
+    mutually exclusive for now.
     """
     if devices is None:
         devices = jax.devices()
-    tp = int(tp)
+    tp, pp = int(tp), int(pp)
+    if tp > 1 and pp > 1:
+        raise NotImplementedError(
+            "tp and pp on one mesh (3-D dp×model×pipe) is a later-round "
+            "composition; use one of tp/pp per mesh")
+    group, group_axis = (tp, MODEL_AXIS) if tp > 1 else (pp, PIPE_AXIS)
     if n_workers is None:
-        n_workers = len(devices) // tp
+        n_workers = len(devices) // group
         if n_workers == 0:
             raise ValueError(
-                f"tp={tp} needs at least tp devices but only "
-                f"{len(devices)} are visible")
-    need = n_workers * tp
+                f"group size {group} needs at least that many devices but "
+                f"only {len(devices)} are visible")
+    need = n_workers * group
     if need > len(devices):
         raise ValueError(
-            f"requested {n_workers} workers × tp={tp} = {need} devices but "
-            f"only {len(devices)} are visible ({[str(d) for d in devices]})"
+            f"requested {n_workers} workers × {group_axis} group {group} = "
+            f"{need} devices but only {len(devices)} are visible "
+            f"({[str(d) for d in devices]})"
         )
-    if tp == 1:
+    if group == 1:
         return Mesh(np.asarray(devices[:n_workers]), (axis_name,))
-    dev = np.asarray(devices[:need]).reshape(n_workers, tp)
-    return Mesh(dev, (axis_name, MODEL_AXIS))
+    dev = np.asarray(devices[:need]).reshape(n_workers, group)
+    return Mesh(dev, (axis_name, group_axis))
 
 
 def mesh_size(mesh: Mesh, axis_name: str = WORKER_AXIS) -> int:

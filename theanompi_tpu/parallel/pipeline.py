@@ -1,0 +1,88 @@
+"""Pipeline parallelism: GPipe-style microbatch pipelining over a ``'pipe'``
+mesh axis.
+
+Beyond-parity capability (the reference — Theano-MPI, SURVEY.md §1 — is pure
+data parallelism): the transformer's homogeneous block stack is SHARDED over
+pipeline stages — each chip holds ``L/pp`` consecutive layers — and
+microbatches stream through the stages with one ``ppermute`` hop per tick.
+
+TPU-first shape: everything is ONE compiled SPMD program.  A ``lax.scan``
+runs ``M + pp − 1`` ticks (M microbatches, pp stages); each tick every stage
+applies its local layers to either the freshly injected microbatch (stage 0)
+or the activation received from its predecessor, then shifts its output one
+stage down the ring.  The bubble (stages idling for ``pp − 1`` ticks) is the
+textbook GPipe cost — amortized by choosing ``M ≫ pp``.  Collected outputs
+live on the last stage and are broadcast with a masked ``psum``.  Gradients
+need nothing special: autodiff transposes the scan + ``ppermute`` (reverse
+hops) and shard_map's varying-axes typing inserts the transpose-psums for
+stage-replicated parameters (embeddings/head), exactly as in
+``parallel/tp.py`` — pinned against the dense model in
+``tests/test_pipeline.py``.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .mesh import PIPE_AXIS
+from .steps import _vary as _pvary
+
+
+def pipeline_apply(stage_fn: Callable, stage_params, x_micro,
+                   axis: str = PIPE_AXIS, remat: bool = True):
+    """Stream microbatches through pipeline stages (inside ``shard_map``).
+
+    ``stage_fn(stage_params, x) -> y`` applies THIS stage's local layers to
+    one microbatch (same shape in and out — transformer blocks).
+    ``stage_params``: pytree whose leaves carry a leading LOCAL layer dim
+    (the ``'pipe'``-sharded slice of the stacked layer stack).
+    ``x_micro``: ``[M, mb, ...]`` microbatches, replicated over ``axis``.
+    Returns ``[M, mb, ...]`` outputs, replicated over ``axis``.
+
+    ``remat``: rematerialize each stage application on the backward pass —
+    the standard GPipe memory trade (activations for the whole scan would
+    otherwise be saved per tick).
+    """
+    pp = lax.psum(1, axis)
+    rank = lax.axis_index(axis)
+    m = x_micro.shape[0]
+    fn = jax.checkpoint(stage_fn) if remat else stage_fn
+
+    shift = [(i, i + 1) for i in range(pp - 1)] if pp > 1 else []
+
+    def tick(carry, t):
+        state, outputs = carry
+        inject = jnp.take(x_micro, jnp.clip(t, 0, m - 1), axis=0)
+        inp = jnp.where(rank == 0, inject, state)
+        out = fn(stage_params, inp)
+        # the last stage finished microbatch t-(pp-1) this tick
+        j = jnp.clip(t - (pp - 1), 0, m - 1)
+        collect = (rank == pp - 1) & (t >= pp - 1)
+        cur = jnp.take(outputs, j, axis=0)
+        outputs = lax.dynamic_update_index_in_dim(
+            outputs, jnp.where(collect, out, cur), j, axis=0)
+        state = lax.ppermute(out, axis, shift) if shift else out
+        return (state, outputs), None
+
+    state0 = _pvary(jnp.zeros_like(x_micro[0]), axis)
+    out0 = _pvary(jnp.zeros_like(x_micro), axis)
+    ticks = _pvary(jnp.arange(m + pp - 1), axis)
+    (_, outputs), _ = lax.scan(tick, (state0, out0), ticks)
+    # only the last stage wrote non-zeros — masked psum broadcasts to all
+    return lax.psum(outputs, axis)
+
+
+def microbatch(x, n_micro: int):
+    """Split the leading batch dim into ``[n_micro, b/n_micro, ...]``."""
+    b = x.shape[0]
+    assert b % n_micro == 0, \
+        f"batch {b} not divisible by pp_microbatches={n_micro}"
+    return x.reshape((n_micro, b // n_micro) + x.shape[1:])
+
+
+def unmicrobatch(y):
+    return y.reshape((-1,) + y.shape[2:])
