@@ -73,6 +73,14 @@ def main() -> int:
               **extra}
     if os.environ.get("BENCH_BATCH"):
         config["batch_size"] = int(os.environ["BENCH_BATCH"])
+    if os.environ.get("BENCH_SYNTH_BATCHES"):
+        # the CNN zoo's synthetic data keeps 4 batches by default; spc>4
+        # multi-step dispatch needs at least spc distinct batches or
+        # compile_iter_fns rejects it (every epoch would train zero steps)
+        config["synthetic_batches"] = int(os.environ["BENCH_SYNTH_BATCHES"])
+    if os.environ.get("BENCH_CFG"):
+        # arbitrary config overrides as JSON (transformer dims etc.)
+        config.update(json.loads(os.environ["BENCH_CFG"]))
     if os.environ.get("BENCH_STRATEGY"):
         config["exch_strategy"] = os.environ["BENCH_STRATEGY"]
     if os.environ.get("BENCH_SPC"):

@@ -1,0 +1,17 @@
+# Shared helper for the perf-matrix scripts (source, don't execute):
+# run <label> [ENV=V ...] — one bench.py row appended to $OUT as JSON,
+# stderr kept in ${OUT%.jsonl}.err.  LM_CFG is the transformer-family
+# benchmark shape.
+run() {
+  local label="$1"; shift
+  echo "== $label" >&2
+  local line
+  line=$(env "$@" BENCH_MFU=1 BENCH_ITERS=20 timeout 1200 python bench.py 2>>"${OUT%.jsonl}.err" | tail -1) || line=""
+  if [ -n "$line" ]; then
+    echo "{\"config\": \"$label\", \"result\": $line}" >> "$OUT"
+  else
+    echo "{\"config\": \"$label\", \"result\": null}" >> "$OUT"
+  fi
+}
+
+LM_CFG='{"d_model":512,"n_head":8,"n_layer":8,"seq_len":512,"vocab":32768,"synthetic_train":512}'

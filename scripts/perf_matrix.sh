@@ -6,29 +6,18 @@ set -u -o pipefail
 OUT="${1:-perf_matrix.jsonl}"
 cd "$(dirname "$0")/.."
 : > "$OUT"
-
-run() {   # run <label> [ENV=V ...]
-  local label="$1"; shift
-  echo "== $label" >&2
-  local line
-  line=$(env "$@" BENCH_MFU=1 BENCH_ITERS=20 timeout 1200 python bench.py 2>/dev/null | tail -1) || line=""
-  if [ -n "$line" ]; then
-    echo "{\"config\": \"$label\", \"result\": $line}" >> "$OUT"
-  else
-    echo "{\"config\": \"$label\", \"result\": null}" >> "$OUT"
-  fi
-}
+. scripts/_bench_row.sh
 
 # staged configs at reference batch sizes (the comparison that counts)
 run alexnet-b128            BENCH_MODEL=alexnet
 run alexnet-b128-spc4       BENCH_MODEL=alexnet  BENCH_SPC=4
 run googlenet-b32           BENCH_MODEL=googlenet
-run googlenet-b32-spc8      BENCH_MODEL=googlenet BENCH_SPC=8
+run googlenet-b32-spc8      BENCH_MODEL=googlenet BENCH_SPC=8 BENCH_SYNTH_BATCHES=8
 run vgg16-b32               BENCH_MODEL=vgg16
 run vgg16-b32-spc4          BENCH_MODEL=vgg16    BENCH_SPC=4
 run resnet50-b32            BENCH_MODEL=resnet50
-run resnet50-b32-spc8       BENCH_MODEL=resnet50 BENCH_SPC=8
-run resnet50-b32-spc8-bnbf16 BENCH_MODEL=resnet50 BENCH_SPC=8 BENCH_BN_DTYPE=bfloat16
+run resnet50-b32-spc8       BENCH_MODEL=resnet50 BENCH_SPC=8 BENCH_SYNTH_BATCHES=8
+run resnet50-b32-spc8-bnbf16 BENCH_MODEL=resnet50 BENCH_SPC=8 BENCH_SYNTH_BATCHES=8 BENCH_BN_DTYPE=bfloat16
 run resnet50-b32-bnbf16     BENCH_MODEL=resnet50 BENCH_BN_DTYPE=bfloat16
 run cifar10-b128            BENCH_MODEL=cifar10
 
@@ -40,5 +29,9 @@ run googlenet-b128          BENCH_MODEL=googlenet BENCH_BATCH=128
 # compressed-wire staged config #5 at VGG-16 scale (chunked top-k + onebit)
 run vgg16-b32-topk          BENCH_MODEL=vgg16 BENCH_STRATEGY=topk
 run vgg16-b32-onebit        BENCH_MODEL=vgg16 BENCH_STRATEGY=onebit
+
+# transformer family (beyond-parity; value = sequences/sec/chip)
+run transformer_lm-b16      BENCH_MODEL=transformer_lm BENCH_BATCH=16 BENCH_CFG="$LM_CFG"
+run moe_lm-b16              BENCH_MODEL=moe_lm         BENCH_BATCH=16 BENCH_CFG="$LM_CFG"
 
 cat "$OUT"

@@ -1,0 +1,31 @@
+#!/usr/bin/env bash
+# The rows perf_matrix.sh did not get to before the round-3 tunnel wedge.
+# VGG-16 rows run LAST: the wedge started mid-vgg16-b32, so if it wedges
+# again everything else is already measured.
+#   ./scripts/perf_matrix_rest.sh [out_file]
+set -u -o pipefail
+OUT="${1:-perf_matrix_r3.jsonl}"
+cd "$(dirname "$0")/.."
+. scripts/_bench_row.sh
+
+run resnet50-b32            BENCH_MODEL=resnet50
+run resnet50-b32-spc8       BENCH_MODEL=resnet50 BENCH_SPC=8 BENCH_SYNTH_BATCHES=8
+run resnet50-b32-spc8-bnbf16 BENCH_MODEL=resnet50 BENCH_SPC=8 BENCH_SYNTH_BATCHES=8 BENCH_BN_DTYPE=bfloat16
+run resnet50-b32-bnbf16     BENCH_MODEL=resnet50 BENCH_BN_DTYPE=bfloat16
+run cifar10-b128            BENCH_MODEL=cifar10
+run resnet50-b64            BENCH_MODEL=resnet50 BENCH_BATCH=64
+run resnet50-b128           BENCH_MODEL=resnet50 BENCH_BATCH=128
+run googlenet-b128          BENCH_MODEL=googlenet BENCH_BATCH=128
+run googlenet-b32-spc8      BENCH_MODEL=googlenet BENCH_SPC=8 BENCH_SYNTH_BATCHES=8
+run alexnet-b128-spc8       BENCH_MODEL=alexnet BENCH_SPC=8 BENCH_SYNTH_BATCHES=8
+
+run transformer_lm-b16      BENCH_MODEL=transformer_lm BENCH_BATCH=16 BENCH_CFG="$LM_CFG"
+run moe_lm-b16              BENCH_MODEL=moe_lm         BENCH_BATCH=16 BENCH_CFG="$LM_CFG"
+
+# vgg16 last — prime wedge suspect
+run vgg16-b32               BENCH_MODEL=vgg16
+run vgg16-b32-spc4          BENCH_MODEL=vgg16    BENCH_SPC=4
+run vgg16-b32-topk          BENCH_MODEL=vgg16 BENCH_STRATEGY=topk
+run vgg16-b32-onebit        BENCH_MODEL=vgg16 BENCH_STRATEGY=onebit
+
+cat "$OUT"

@@ -1,0 +1,104 @@
+"""Sequence parallelism as a model mode (parallel/sp.py): TransformerLM with
+sp=k shards the TIME dimension over a 'seq' axis and runs ring attention —
+it must be the same model as the dense layout (same init, same losses),
+which also pins the batch-spec plumbing (x/y sharded [workers, seq]).
+
+The ring-attention op itself is oracle-pinned in test_ring_attention.py;
+this file pins the MODEL integration.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from theanompi_tpu.models.transformer_lm import TransformerLM
+from theanompi_tpu.parallel.exchanger import BSP_Exchanger, get_exchanger
+from theanompi_tpu.parallel.mesh import SEQ_AXIS, WORKER_AXIS, worker_mesh
+
+LM_CFG = dict(verbose=False, batch_size=8, seq_len=32, vocab=32,
+              synthetic_train=64, synthetic_val=32,
+              d_model=32, n_head=4, n_layer=2, compute_dtype=jnp.float32)
+
+
+def _make(dp, sp, **kw):
+    mesh = worker_mesh(dp, sp=sp)
+    cfg = {**LM_CFG, "mesh": mesh, "size": dp, "rank": 0, "sp": sp, **kw}
+    return TransformerLM(cfg)
+
+
+def _train_steps(model, exch, n_steps):
+    model.compile_iter_fns(exch)
+    model.data.shuffle_data(0)
+    costs = []
+    for i in range(n_steps):
+        model.train_iter(i, None)
+        costs.append(float(model.current_info["cost"]))
+    return costs
+
+
+def test_sp_mesh_and_batch_sharding(mesh8):
+    model = _make(dp=2, sp=4)
+    assert dict(model.mesh.shape) == {WORKER_AXIS: 2, SEQ_AXIS: 4}
+    model.compile_iter_fns(BSP_Exchanger(model.config))
+    from theanompi_tpu.parallel import steps
+    model.data.shuffle_data(0)
+    batch = model.data.next_train_batch(0)
+    dev = steps.put_batch(model.mesh, batch, model.batch_spec())
+    assert dev["x"].sharding.spec == (WORKER_AXIS, SEQ_AXIS)
+    # one chip holds [rows/dp, T/sp]
+    assert dev["x"].addressable_shards[0].data.shape == (8, 8)
+
+
+def test_sp_init_identical_to_dense(mesh8):
+    dense = _make(dp=2, sp=1)
+    sp = _make(dp=2, sp=4)
+    jax.tree.map(lambda a, b: np.testing.assert_array_equal(
+        np.asarray(a), np.asarray(b)), dense.params, sp.params)
+
+
+def test_sp_bsp_training_matches_dense(mesh8):
+    dense = _make(dp=2, sp=1)
+    sp = _make(dp=2, sp=4)
+    c_dense = _train_steps(dense, BSP_Exchanger(dense.config), 6)
+    c_sp = _train_steps(sp, BSP_Exchanger(sp.config), 6)
+    np.testing.assert_allclose(c_sp, c_dense, rtol=2e-4, atol=2e-5)
+    from theanompi_tpu.parallel import steps
+    pd = steps.unbox(jax.device_get(steps.tree_to_host(
+        dense.step_state["params"])))
+    ps = steps.unbox(jax.device_get(steps.tree_to_host(
+        sp.step_state["params"])))
+    jax.tree.map(lambda a, b: np.testing.assert_allclose(
+        np.asarray(a), np.asarray(b), rtol=5e-3, atol=1e-5), pd, ps)
+
+
+def test_sp_val_matches_dense(mesh8):
+    dense = _make(dp=2, sp=1)
+    sp = _make(dp=2, sp=4)
+    for m in (dense, sp):
+        m.compile_iter_fns(BSP_Exchanger(m.config))
+        m.data.shuffle_data(0)
+        m.begin_val()
+    recs = []
+    from theanompi_tpu.parallel import steps
+    for m in (dense, sp):
+        batch = m.data.next_val_batch(0)
+        dev = steps.put_batch(m.mesh, batch, m.batch_spec())
+        cost, err, err5 = m.val_fn(m._val_params_boxed, m._val_bn_boxed, dev)
+        recs.append((float(np.mean(np.asarray(cost))),
+                     float(np.mean(np.asarray(err)))))
+    (cd, ed), (cs, es) = recs
+    assert cd == pytest.approx(cs, abs=1e-4)
+    assert ed == pytest.approx(es, abs=1e-6)
+
+
+def test_sp_with_async_rule_smoke(mesh8):
+    model = _make(dp=2, sp=4, sync_freq=2)
+    exch = get_exchanger("easgd", model.config)
+    costs = _train_steps(model, exch, 4)
+    exch.exchange(None, exch.exchange_freq)
+    assert np.isfinite(costs).all()
+    model.begin_val()
+    model.val_iter(0, None)
+    model.end_val()

@@ -66,7 +66,8 @@ class MeshProcess:
         # drive) stay data-parallel.
         self.mesh = worker_mesh(self.config.get("n_workers"),
                                 tp=int(self.config.get("tp", 1)),
-                                pp=int(self.config.get("pp", 1)))
+                                pp=int(self.config.get("pp", 1)),
+                                sp=int(self.config.get("sp", 1)))
         self.rank = jax.process_index()
         self.size = self.mesh.shape[WORKER_AXIS]
         self.config.update(rank=self.rank, size=self.size, mesh=self.mesh,

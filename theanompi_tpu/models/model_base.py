@@ -53,7 +53,8 @@ class ModelBase:
         if self.mesh is None:
             self.mesh = worker_mesh(self.config.get("n_workers"),
                                     tp=int(self.config.get("tp", 1)),
-                                    pp=int(self.config.get("pp", 1)))
+                                    pp=int(self.config.get("pp", 1)),
+                                    sp=int(self.config.get("sp", 1)))
             self.size = self.mesh.shape[WORKER_AXIS]
             # build_model()'s data object reads size from config — keep it
             # coherent when the model is constructed standalone (no Worker).
@@ -81,7 +82,8 @@ class ModelBase:
             # stack once there instead of per-batch in the producer (avoids
             # a stage-then-restack double copy)
             put = None if int(self.steps_per_call) > 1 \
-                else (lambda b: steps.put_batch(self.mesh, b))
+                else (lambda b: steps.put_batch(self.mesh, b,
+                                                self.batch_spec()))
             self.data = PrefetchLoader(self.data, device_put_fn=put)
 
         key = jax.random.key(self.seed)
@@ -135,6 +137,12 @@ class ModelBase:
         """Per-leaf PartitionSpecs over the ``'model'`` mesh axis for tensor
         -parallel models (``parallel/tp.py``), or None for pure data
         parallelism (the whole CNN zoo — the reference's only mode)."""
+        return None
+
+    def batch_spec(self):
+        """PartitionSpec for batch leaves, or None for the default
+        ``P(workers)`` row split.  Sequence-parallel models
+        (``parallel/sp.py``) also shard the time dim."""
         return None
 
     def postprocess_grads(self, grads, count):
@@ -217,7 +225,7 @@ class ModelBase:
             recorder.start()
         if k == 1:
             dev_batch = batch if steps.is_device_batch(batch) \
-                else steps.put_batch(self.mesh, batch)
+                else steps.put_batch(self.mesh, batch, self.batch_spec())
         else:
             dev_batch = steps.put_batch_stack(self.mesh, batches)
         self.step_state, cost, err = self.train_fn(
@@ -279,7 +287,7 @@ class ModelBase:
             recorder.start()
         batch = self.data.next_val_batch(count)
         dev_batch = batch if steps.is_device_batch(batch) \
-            else steps.put_batch(self.mesh, batch)
+            else steps.put_batch(self.mesh, batch, self.batch_spec())
         cost, err, err5 = self.val_fn(self._val_params_boxed,
                                       self._val_bn_boxed, dev_batch)
         # per-worker metric vectors span hosts — gather, don't device_get

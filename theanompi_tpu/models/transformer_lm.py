@@ -73,7 +73,7 @@ class Block(L.Layer):
     has_state = False
 
     def __init__(self, dim, n_head, mlp_ratio=4, cd=jnp.bfloat16, tp=1,
-                 name="block"):
+                 sp=1, name="block"):
         from ..parallel import tp as tplib
         self.name = name
         self.tp = tp
@@ -82,6 +82,11 @@ class Block(L.Layer):
             self.attn = tplib.TPMultiHeadAttention(dim, n_head, tp,
                                                    compute_dtype=cd,
                                                    name="attn")
+        elif sp > 1:
+            # sequence-sharded activations: ring attention over 'seq'
+            from ..parallel.sp import RingMultiHeadAttention
+            self.attn = RingMultiHeadAttention(dim, n_head, compute_dtype=cd,
+                                               name="attn")
         else:
             self.attn = L.MultiHeadAttention(dim, n_head, compute_dtype=cd,
                                              name="attn")
@@ -177,14 +182,25 @@ class TransformerLM(ModelBase):
 
     tp = 1          # tensor-parallel degree (mesh gains a 'model' axis)
     pp = 1          # pipeline-parallel degree (mesh gains a 'pipe' axis)
+    sp = 1          # sequence-parallel degree (mesh gains a 'seq' axis)
     pp_microbatches = 0   # microbatches streamed per step (0 → 2·pp)
 
     def build_model(self) -> None:
         cd = self.config.get("compute_dtype", jnp.bfloat16)
         for k in ("vocab", "d_model", "n_head", "n_layer", "seq_len", "tp",
-                  "pp", "pp_microbatches"):
+                  "pp", "sp", "pp_microbatches"):
             if k in self.config:
                 setattr(self, k, int(self.config[k]))
+        if self.sp > 1:
+            from ..parallel.mesh import SEQ_AXIS
+            assert self.tp == 1 and self.pp == 1, \
+                "one of tp/pp/sp per mesh for now"
+            assert self.mesh.shape.get(SEQ_AXIS) == self.sp, (
+                f"sp={self.sp} needs a mesh with a '{SEQ_AXIS}' axis of "
+                f"that size (worker_mesh(n, sp={self.sp})); got "
+                f"{dict(self.mesh.shape)}")
+            assert self.seq_len % self.sp == 0, (
+                f"seq_len={self.seq_len} not divisible by sp={self.sp}")
         if self.pp > 1:
             from ..parallel.mesh import PIPE_AXIS
             assert self.tp == 1, "tp and pp compose in a later round"
@@ -210,7 +226,8 @@ class TransformerLM(ModelBase):
         self.pos = L.Embedding(self.seq_len, self.d_model, compute_dtype=cd,
                                name="pos")
         self.blocks = [Block(self.d_model, self.n_head, cd=cd, tp=self.tp,
-                             name=f"block{i}") for i in range(self.n_layer)]
+                             sp=self.sp, name=f"block{i}")
+                       for i in range(self.n_layer)]
         self.ln_f = L.LayerNorm(self.d_model, name="ln_f")
         # under tp the head is column-parallel over the VOCAB; the loss works
         # directly on the sharded logits (vocab-parallel cross-entropy)
@@ -255,10 +272,22 @@ class TransformerLM(ModelBase):
     def init_bn_state(self):
         return {}
 
+    def batch_spec(self):
+        if self.sp > 1:
+            from jax.sharding import PartitionSpec as P
+            from ..parallel.mesh import SEQ_AXIS, WORKER_AXIS
+            return P(WORKER_AXIS, SEQ_AXIS)    # [B rows, T tokens] both cut
+        return None
+
     def apply_model(self, params, x, *, train, rng, state):
         t = x.shape[1]
+        pos_idx = jnp.arange(t)
+        if self.sp > 1:
+            # x holds this chip's token BLOCK — positions are global
+            from ..parallel.mesh import SEQ_AXIS
+            pos_idx = pos_idx + jax.lax.axis_index(SEQ_AXIS) * t
         h = self.embed.apply(params["embed"], x) + \
-            self.pos.apply(params["pos"], jnp.arange(t))[None]
+            self.pos.apply(params["pos"], pos_idx)[None]
         if self.pp > 1:
             from ..parallel import pipeline as pl
             tpl = self.blocks[0]
@@ -290,6 +319,9 @@ class TransformerLM(ModelBase):
                 (tplib.tp_errors(flat, y), bn_state)
         cost = L.softmax_cross_entropy(flat, y)
         err = L.errors(flat, y)
+        if self.sp > 1:
+            from ..parallel.sp import sp_mean
+            cost, err = sp_mean(cost), sp_mean(err)
         return cost, (err, bn_state)
 
     def val_metrics(self, params, bn_state, batch):
@@ -303,7 +335,11 @@ class TransformerLM(ModelBase):
             return tplib.tp_softmax_cross_entropy(flat, y), \
                 (tplib.tp_errors(flat, y), tplib.tp_errors_top_x(flat, y, 5))
         cost = L.softmax_cross_entropy(flat, y)
-        return cost, (L.errors(flat, y), L.errors_top_x(flat, y, 5))
+        err, err5 = L.errors(flat, y), L.errors_top_x(flat, y, 5)
+        if self.sp > 1:
+            from ..parallel.sp import sp_mean
+            cost, err, err5 = sp_mean(cost), sp_mean(err), sp_mean(err5)
+        return cost, (err, err5)
 
 
 class MoETransformerLM(TransformerLM):
@@ -324,6 +360,9 @@ class MoETransformerLM(TransformerLM):
         assert self.pp == 1, (
             "pipeline parallelism needs a homogeneous block stack; the "
             "mixed MoE/dense stack does not compose with pp yet")
+        assert self.sp == 1, (
+            "sequence parallelism does not compose with the MoE stack yet "
+            "(expert routing needs the full token set or an all-to-all)")
         cd = self.config.get("compute_dtype", jnp.bfloat16)
         for k in ("moe_experts", "moe_every"):
             if k in self.config:

@@ -25,6 +25,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 WORKER_AXIS = "workers"
 MODEL_AXIS = "model"      # tensor/expert-parallel axis (parallel/tp.py)
 PIPE_AXIS = "pipe"        # pipeline-stage axis (parallel/pipeline.py)
+SEQ_AXIS = "seq"          # sequence-parallel axis (parallel/sp.py)
 
 
 def init_multihost(
@@ -59,6 +60,7 @@ def worker_mesh(
     axis_name: str = WORKER_AXIS,
     tp: int = 1,
     pp: int = 1,
+    sp: int = 1,
 ) -> Mesh:
     """Build the data-parallel mesh — the TPU-native "communicator".
 
@@ -75,18 +77,21 @@ def worker_mesh(
     per-layer psums ride the shortest ICI hops, the dp collective the longer
     ones, matching their per-step frequencies.
 
-    ``pp > 1`` adds a ``'pipe'`` axis instead: each worker group spans ``pp``
-    pipeline stages (``parallel/pipeline.py``).  ``tp`` and ``pp`` are
-    mutually exclusive for now.
+    ``pp > 1`` adds a ``'pipe'`` axis instead (pipeline stages,
+    ``parallel/pipeline.py``); ``sp > 1`` a ``'seq'`` axis (sequence blocks,
+    ``parallel/sp.py``).  The three group modes are mutually exclusive for
+    now — one 2-D mesh per run.
     """
     if devices is None:
         devices = jax.devices()
-    tp, pp = int(tp), int(pp)
-    if tp > 1 and pp > 1:
+    tp, pp, sp = int(tp), int(pp), int(sp)
+    groups = [(tp, MODEL_AXIS), (pp, PIPE_AXIS), (sp, SEQ_AXIS)]
+    active = [(g, a) for g, a in groups if g > 1]
+    if len(active) > 1:
         raise NotImplementedError(
-            "tp and pp on one mesh (3-D dp×model×pipe) is a later-round "
-            "composition; use one of tp/pp per mesh")
-    group, group_axis = (tp, MODEL_AXIS) if tp > 1 else (pp, PIPE_AXIS)
+            f"only one of tp/pp/sp per mesh for now; got "
+            f"{[a for _, a in active]} (3-D compositions are a later round)")
+    group, group_axis = active[0] if active else (1, MODEL_AXIS)
     if n_workers is None:
         n_workers = len(devices) // group
         if n_workers == 0:

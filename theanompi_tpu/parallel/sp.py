@@ -1,0 +1,64 @@
+"""Sequence parallelism as a first-class model mode.
+
+Long-context training (the brief's first-class requirement; the reference —
+Theano-MPI, SURVEY.md §1 — is CNN-only) shards the SEQUENCE dimension over a
+``'seq'`` mesh axis: activations hold ``T/sp`` tokens per chip, so the
+context length scales with the mesh.  Everything per-token (embeddings,
+LayerNorm, MLP, LM head, per-token loss) runs unchanged on the local token
+block; only attention needs cross-chip communication, and that is the ring
+algorithm in ``ops/ring_attention.py`` — K/V blocks rotate via
+``lax.ppermute``, online-softmax accumulation, exact math (oracle-pinned).
+
+:class:`RingMultiHeadAttention` is the drop-in attention for a
+sequence-sharded ``TransformerLM`` (``sp=k`` config): same init/params as
+the dense layer, Q/K/V projections local, one ring pass per block.  Params
+stay replicated over ``'seq'`` (specs all ``P()``), so gradient reduction
+over the axis falls out of shard_map's varying-axes typing exactly as in
+``parallel/tp.py``; the per-token loss just averages with ``pmean`` over the
+axis.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+from ..models import layers as L
+from .mesh import SEQ_AXIS
+
+
+class RingMultiHeadAttention(L.MultiHeadAttention):
+    """Causal MHA over a sequence-SHARDED activation block.
+
+    ``x`` is ``[B, T/sp, D]`` (this chip's token block); projections are
+    per-token (local), the attention itself is the exact blockwise ring over
+    ``axis`` with causal masking in GLOBAL positions.
+    """
+
+    def __init__(self, dim, n_head, causal: bool = True,
+                 axis: str = SEQ_AXIS, **kwargs):
+        super().__init__(dim, n_head, causal=causal, **kwargs)
+        self.axis = axis
+
+    def apply(self, params, x, *, train=False, rng=None, state=None):
+        from ..ops.ring_attention import ring_attention
+        cd = self.compute_dtype
+        b, t_loc, d = x.shape
+        h, hd = self.n_head, self.dim // self.n_head
+        xc = x.astype(cd)
+
+        def proj(w):
+            y = jnp.dot(xc, w.astype(cd))
+            return y.reshape(b, t_loc, h, hd).transpose(0, 2, 1, 3)
+
+        q, k, v = proj(params["wq"]), proj(params["wk"]), proj(params["wv"])
+        o = ring_attention(q, k, v, axis=self.axis, causal=self.causal)
+        o = o.transpose(0, 2, 1, 3).reshape(b, t_loc, d)
+        return jnp.dot(o.astype(cd), params["wo"].astype(cd))
+
+
+def sp_mean(x, axis: str = SEQ_AXIS):
+    """Average a per-local-token-block scalar over the sequence axis (equal
+    token counts per shard, so the plain mean of means is the global mean);
+    marks the result invariant for the step's out-spec typing."""
+    return lax.pmean(x, axis)

@@ -250,7 +250,14 @@ def build_train_step(mesh: Mesh, model, exchanger, n_steps: int = 1) -> Callable
             return state, jnp.mean(costs)[None], jnp.mean(errs)[None]
 
     state_spec = state_partition_specs(model, exchanger, axis)
-    batch_spec = P(axis) if n_steps == 1 else P(None, axis)
+    bs = model.batch_spec()
+    if bs is not None:
+        assert n_steps == 1, \
+            "custom batch specs (sequence parallelism) compose with " \
+            "steps_per_call in a later round"
+        batch_spec = bs
+    else:
+        batch_spec = P(axis) if n_steps == 1 else P(None, axis)
     sm = jax.shard_map(
         per_worker, mesh=mesh,
         in_specs=(state_spec, batch_spec, P(), P(), P()),
@@ -280,9 +287,12 @@ def build_val_step(mesh: Mesh, model) -> Callable:
     else:
         p_spec = boxed_specs(pspecs, axis)
         bn_spec = jax.tree.map(lambda x: P(axis), model.bn_state)
+    vb_spec = model.batch_spec()
+    if vb_spec is None:
+        vb_spec = P(axis)
     sm = jax.shard_map(
         per_worker, mesh=mesh,
-        in_specs=(p_spec, bn_spec, P(axis)),
+        in_specs=(p_spec, bn_spec, vb_spec),
         out_specs=(P(axis), P(axis), P(axis)),
     )
     return jax.jit(sm)
@@ -312,15 +322,22 @@ def put_batch_stack(mesh: Mesh, batches):
         *batches)
 
 
-def put_batch(mesh: Mesh, batch):
+def put_batch(mesh: Mesh, batch, spec=None):
     """Place a host batch onto the mesh, split across workers.
 
     Single-process: ``batch`` is the global batch, device_put shards it.
     Multi-host: ``batch`` is this host's LOCAL shard; the global array is
     stitched from per-process data without cross-host copies.
+
+    ``spec``: optional PartitionSpec for every batch leaf beyond the default
+    ``P(workers)`` row split (sequence-parallel models also shard the time
+    dim, ``model.batch_spec()``).
     """
     if jax.process_count() > 1:
         from .mesh import make_per_host_array
+        assert spec is None, \
+            "custom batch specs are single-process for now"
         return make_per_host_array(mesh, batch)
-    sh = batch_sharding(mesh)
+    sh = NamedSharding(mesh, spec) if spec is not None else \
+        batch_sharding(mesh)
     return jax.tree.map(lambda x: jax.device_put(x, sh), batch)
