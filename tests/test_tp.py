@@ -144,10 +144,22 @@ def test_tp_checkpoint_roundtrip(tmp_path, mesh8):
     assert np.isfinite(float(model2.current_info["cost"]))
 
 
-def test_tp_rejects_compressed_strategies(mesh8):
-    model, cfg = _make(dp=2, tp=4, exch_strategy="onebit")
-    with pytest.raises(NotImplementedError, match="compose with tensor"):
-        model.compile_iter_fns(BSP_Exchanger(model.config))
+def test_tp_compressed_strategies_train(mesh8):
+    """onebit/topk error-feedback compression composes with tp: each tp rank
+    compresses its LOCAL grad shard (EF state [tp·local_flat] sharded over
+    'model').  Loss must stay finite and trend down; EF state must be
+    per-model-shard (non-identical across tp ranks after training)."""
+    for strat in ("onebit", "topk"):
+        model, cfg = _make(dp=2, tp=4, exch_strategy=strat)
+        costs = _train_steps(model, BSP_Exchanger(model.config), 8)
+        assert np.isfinite(costs).all(), (strat, costs)
+        assert np.mean(costs[-3:]) < np.mean(costs[:3]), (strat, costs)
+        ef = model.step_state["extra"]["strat"]
+        from theanompi_tpu.parallel.mesh import MODEL_AXIS
+        assert ef.sharding.spec == ("workers", MODEL_AXIS)
+        # per-shard residuals: the four tp shards' EF blocks differ
+        blocks = np.asarray(jax.device_get(ef))[0].reshape(4, -1)
+        assert not np.allclose(blocks[0], blocks[1])
 
 
 def test_tp_loss_head_matches_dense_oracle(mesh8):

@@ -203,7 +203,6 @@ class TransformerLM(ModelBase):
                 f"seq_len={self.seq_len} not divisible by sp={self.sp}")
         if self.pp > 1:
             from ..parallel.mesh import PIPE_AXIS
-            assert self.tp == 1, "tp and pp compose in a later round"
             assert self.mesh.shape.get(PIPE_AXIS) == self.pp, (
                 f"pp={self.pp} needs a mesh with a '{PIPE_AXIS}' axis of "
                 f"that size (worker_mesh(n, pp={self.pp})); got "
@@ -237,24 +236,32 @@ class TransformerLM(ModelBase):
 
     def param_specs(self):
         from jax.sharding import PartitionSpec as P
-        if self.pp > 1:
-            from ..parallel.mesh import PIPE_AXIS
-            struct = jax.eval_shape(self.blocks[0].init, jax.random.key(0))
-            rep = {"scale": P(), "bias": P()}
-            return {"embed": {"w": P()}, "pos": {"w": P()}, "ln_f": rep,
-                    "head": {"w": P(), "b": P()},
-                    # stacked [n_layer, ...] leaves: layer dim over stages
-                    "blocks": jax.tree.map(lambda _: P(PIPE_AXIS), struct)}
-        if self.tp == 1:
+        if self.pp == 1 and self.tp == 1:
             return None
-        from ..parallel.mesh import MODEL_AXIS as M
-        specs = {"embed": {"w": P(M, None)},       # vocab-sharded table
-                 "pos": {"w": P()},
-                 "ln_f": {"scale": P(), "bias": P()},
-                 "head": {"w": P(None, M), "b": P(M)}}
-        for blk in self.blocks:
-            specs[blk.name] = blk.specs()
-        return specs
+        if self.tp > 1:
+            from ..parallel.mesh import MODEL_AXIS as M
+            top = {"embed": {"w": P(M, None)},     # vocab-sharded table
+                   "pos": {"w": P()},
+                   "ln_f": {"scale": P(), "bias": P()},
+                   "head": {"w": P(None, M), "b": P(M)}}
+        else:
+            top = {"embed": {"w": P()}, "pos": {"w": P()},
+                   "ln_f": {"scale": P(), "bias": P()},
+                   "head": {"w": P(), "b": P()}}
+        if self.pp == 1:
+            return {**top, **{blk.name: blk.specs()
+                              for blk in self.blocks}}
+        # pp: stacked [n_layer, ...] leaves, layer dim over stages — under
+        # tp×pp the per-layer tp specs shift right by the stacking dim
+        from ..parallel.mesh import PIPE_AXIS
+        from ..parallel.steps import _is_spec
+        blk = self.blocks[0].specs()
+        if blk is None:
+            struct = jax.eval_shape(self.blocks[0].init, jax.random.key(0))
+            blk = jax.tree.map(lambda _: P(), struct)
+        stacked = jax.tree.map(lambda s: P(PIPE_AXIS, *(s or ())), blk,
+                               is_leaf=_is_spec)
+        return {**top, "blocks": stacked}
 
     def init_params(self, key):
         ks = jax.random.split(key, len(self.blocks) + 4)

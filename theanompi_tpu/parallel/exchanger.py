@@ -164,13 +164,12 @@ class BSP_Exchanger(Exchanger):
 
     def extra_specs(self, param_specs):
         if self.strategy.stateful:
-            # error-feedback state is one flat vector sized from GLOBAL
-            # shapes (strategies.py init_state) — its local-shard layout
-            # under tp is a later-round composition
-            raise NotImplementedError(
-                f"compressed strategy {self.strategy.name!r} does not "
-                "compose with tensor parallelism yet; use "
-                "allreduce/ring/none")
+            # the error-feedback flat vector is per-device within a worker
+            # group: each model/pipe rank compresses ITS local grad shard
+            # independently, so the global extra leaf is
+            # [prod(group) · local_flat] sharded over the group axes
+            group = self._group_axes()
+            return {"strat": P(group) if group else P()}
         return {}
 
     def prepare(self, mesh: Mesh, model) -> None:
@@ -195,9 +194,26 @@ class BSP_Exchanger(Exchanger):
                                out_specs=state_spec)
             self._exchange_fn = jax.jit(sm, donate_argnums=(0,))
 
+    def _group_axes(self):
+        """Non-worker mesh axes (model/pipe) — under model parallelism each
+        device along these axes compresses a DIFFERENT local grad shard."""
+        return tuple(a for a in self.mesh.axis_names if a != WORKER_AXIS)
+
     def extra_state_template(self) -> Dict[str, Any]:
         if self.strategy.stateful:
-            return {"strat": self.strategy.init_state(self.model.params)}
+            pspecs = self.model.param_specs()
+            group = self._group_axes()
+            if pspecs is None or not group:
+                return {"strat": self.strategy.init_state(self.model.params)}
+            # model-parallel layout: EF state sized from the LOCAL shard a
+            # device sees inside shard_map, tiled to the global
+            # [prod(group) · local] layout that extra_specs shards back over
+            # the group axes
+            local = steps.local_param_template(self.model.params, pspecs,
+                                               self.mesh)
+            st = self.strategy.init_state(local)
+            n = int(np.prod([self.mesh.shape[a] for a in group]))
+            return {"strat": jnp.tile(st, n)}
         return {}
 
     def step_update(self, params, opt_state, grads, extra, lr, *, axis, size,
@@ -208,9 +224,37 @@ class BSP_Exchanger(Exchanger):
                                                axis=axis, size=size)
             if "strat" in extra:
                 extra = dict(extra, strat=strat_state)
+            grads = self._restore_replication(grads)
         opt = self.model.opt
         params, opt_state = opt.update(grads, opt_state, params, lr)
         return params, opt_state, extra
+
+    def _restore_replication(self, grads):
+        """Flattening strategies under model parallelism: chunk-level
+        compression (topk) can select DIFFERENT entries of a replicated
+        leaf's segment on different model/pipe ranks, and even value
+        -identical decodes lose the vma invariance the out-specs need —
+        pmean each leaf over the group axes its spec does NOT shard
+        (tiny: LayerNorms, biases, stage-replicated embeddings)."""
+        pspecs = self.model.param_specs()
+        group = self._group_axes()
+        if pspecs is None or not group or not self.strategy.flattens:
+            return grads
+
+        def sharded_axes(s):
+            out = set()
+            for e in (s or ()):
+                if isinstance(e, (tuple, list)):
+                    out.update(e)
+                elif e is not None:
+                    out.add(e)
+            return out
+
+        def fix(g, s):
+            missing = tuple(a for a in group if a not in sharded_axes(s))
+            return lax.pmean(g, missing) if missing else g
+
+        return jax.tree.map(fix, grads, pspecs)
 
     def sync_bn(self, bn_state, *, axis, size):
         # Keep BSP replicas bit-identical: running stats are averaged every

@@ -77,21 +77,25 @@ def worker_mesh(
     per-layer psums ride the shortest ICI hops, the dp collective the longer
     ones, matching their per-step frequencies.
 
-    ``pp > 1`` adds a ``'pipe'`` axis instead (pipeline stages,
-    ``parallel/pipeline.py``); ``sp > 1`` a ``'seq'`` axis (sequence blocks,
-    ``parallel/sp.py``).  The three group modes are mutually exclusive for
-    now — one 2-D mesh per run.
+    ``pp > 1`` adds a ``'pipe'`` axis (pipeline stages,
+    ``parallel/pipeline.py``); ``tp`` and ``pp`` COMPOSE on a 3-D
+    ``(workers, pipe, model)`` mesh — 'pipe' outer (one activation shift per
+    stage per microbatch), 'model' inner (per-layer psums, the most frequent
+    collective, ride adjacent chips).  ``sp > 1`` adds a ``'seq'`` axis
+    (sequence blocks, ``parallel/sp.py``) and is exclusive with tp/pp.
     """
     if devices is None:
         devices = jax.devices()
     tp, pp, sp = int(tp), int(pp), int(sp)
-    groups = [(tp, MODEL_AXIS), (pp, PIPE_AXIS), (sp, SEQ_AXIS)]
-    active = [(g, a) for g, a in groups if g > 1]
-    if len(active) > 1:
+    if sp > 1 and (tp > 1 or pp > 1):
         raise NotImplementedError(
-            f"only one of tp/pp/sp per mesh for now; got "
-            f"{[a for _, a in active]} (3-D compositions are a later round)")
-    group, group_axis = active[0] if active else (1, MODEL_AXIS)
+            "sp does not compose with tp/pp on one mesh yet")
+    group = tp * pp * sp
+    axes, shape = [axis_name], [0]
+    for g, a in ((pp, PIPE_AXIS), (tp, MODEL_AXIS), (sp, SEQ_AXIS)):
+        if g > 1:
+            axes.append(a)
+            shape.append(g)
     if n_workers is None:
         n_workers = len(devices) // group
         if n_workers == 0:
@@ -101,14 +105,13 @@ def worker_mesh(
     need = n_workers * group
     if need > len(devices):
         raise ValueError(
-            f"requested {n_workers} workers × {group_axis} group {group} = "
-            f"{need} devices but only {len(devices)} are visible "
-            f"({[str(d) for d in devices]})"
+            f"requested {n_workers} workers × group {group} "
+            f"(tp={tp}, pp={pp}, sp={sp}) = {need} devices but only "
+            f"{len(devices)} are visible ({[str(d) for d in devices]})"
         )
-    if group == 1:
-        return Mesh(np.asarray(devices[:n_workers]), (axis_name,))
-    dev = np.asarray(devices[:need]).reshape(n_workers, group)
-    return Mesh(dev, (axis_name, group_axis))
+    shape[0] = n_workers
+    dev = np.asarray(devices[:need]).reshape(shape)
+    return Mesh(dev, tuple(axes))
 
 
 def mesh_size(mesh: Mesh, axis_name: str = WORKER_AXIS) -> int:

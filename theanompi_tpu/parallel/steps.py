@@ -117,6 +117,25 @@ def _is_spec(x) -> bool:
     return x is None or isinstance(x, P)
 
 
+def local_param_template(params, pspecs, mesh: Mesh):
+    """Zeros shaped like each leaf's LOCAL shard under ``pspecs`` — what a
+    device actually sees inside shard_map.  Sizes the error-feedback state
+    of compressed strategies under tensor parallelism."""
+    def shrink(x, s):
+        shape = list(np.shape(x))
+        for i, ax in enumerate(s or ()):
+            for a in (ax if isinstance(ax, (tuple, list)) else (ax,)):
+                if a is not None:
+                    assert shape[i] % mesh.shape[a] == 0, \
+                        f"dim {i} of {tuple(np.shape(x))} not divisible " \
+                        f"by mesh axis {a!r}={mesh.shape[a]}"
+                    shape[i] //= mesh.shape[a]
+        dtype = getattr(x, "dtype", None) or np.asarray(x).dtype
+        return jnp.zeros(shape, dtype)
+
+    return jax.tree.map(shrink, params, pspecs)
+
+
 # ---------------------------------------------------------------------------
 # microbatch gradient accumulation (reference: n_subb sub-batches, §3.4)
 # ---------------------------------------------------------------------------

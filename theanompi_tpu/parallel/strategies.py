@@ -57,6 +57,11 @@ class Strategy:
 
     name = "base"
     stateful = False
+    # True when the strategy operates on one FLATTENED vector rather than
+    # leaf-wise: under tensor parallelism the flatten mixes sharded and
+    # replicated-leaf segments, so the exchanger re-imposes replication on
+    # the replicated leaves afterwards (a pmean over 'model')
+    flattens = False
 
     def init_state(self, params) -> Any:
         """Per-worker persistent state (unsharded template; the exchanger adds
@@ -126,6 +131,7 @@ class Ring(Strategy):
     def __init__(self, wire_dtype=None):
         self.wire_dtype = wire_dtype
         self.name = "ring" if wire_dtype is None else "ring16"
+        self.flattens = True
 
     def __call__(self, tree, state, *, axis: str, size: int):
         if size == 1:
@@ -193,6 +199,7 @@ class OneBit(Strategy):
 
     name = "onebit"
     stateful = True
+    flattens = True
 
     def init_state(self, params):
         n = helper_funcs.tree_size(params)
@@ -237,6 +244,7 @@ class TopK(Strategy):
 
     name = "topk"
     stateful = True
+    flattens = True
 
     CHUNK = 8192          # ≤ 2^16 for int16 offsets; multiple of the lane dim
 
