@@ -1,0 +1,56 @@
+#!/usr/bin/env python
+"""One TransformerLM, every parallelism axis: dp / tp / pp / sp / tp×pp.
+
+The reference (Theano-MPI) is pure data parallelism; this session shows the
+same 3-call rule API driving the model-parallel meshes (`parallel/tp.py`,
+`parallel/pipeline.py`, `parallel/sp.py`):
+
+* ``tp=k``   — Megatron-style tensor parallelism over a 'model' axis
+               (head-sharded attention, column/row-parallel MLP,
+               vocab-parallel embedding + loss)
+* ``pp=k``   — GPipe pipeline over a 'pipe' axis (stacked block params,
+               microbatch streaming via ppermute)
+* ``sp=k``   — sequence parallelism over a 'seq' axis (ring attention;
+               batch leaves placed [workers, seq])
+* ``tp`` + ``pp`` together — a 3-D dp×pipe×model mesh
+
+Pick a mode with MODE=dp|tp|pp|sp|tp_pp (default tp).  ``devices`` counts
+DATA-PARALLEL groups: devices=2 with tp=2, pp=2 uses 8 chips.
+"""
+
+import os
+
+from _common import setup
+
+setup()
+
+MODES = {
+    "dp":    dict(devices=8),
+    "tp":    dict(devices=4, tp=2),
+    "pp":    dict(devices=2, pp=4, pp_microbatches=8),
+    "sp":    dict(devices=2, sp=4),
+    "tp_pp": dict(devices=2, tp=2, pp=2, pp_microbatches=8),
+}
+
+from theanompi_tpu import BSP  # noqa: E402
+
+if __name__ == "__main__":
+    mode = os.environ.get("MODE", "tp")
+    if mode not in MODES:
+        import sys
+        sys.exit(f"MODE must be one of {sorted(MODES)}; got {mode!r}")
+    rule = BSP()
+    rule.init(
+        modelfile="theanompi_tpu.models.transformer_lm",
+        modelclass="TransformerLM",
+        batch_size=16,
+        seq_len=128,
+        vocab=256,
+        d_model=256,
+        n_layer=4,
+        n_head=8,
+        epochs=5,
+        printFreq=20,
+        **MODES[mode],
+    )
+    rule.wait()

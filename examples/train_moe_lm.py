@@ -1,0 +1,37 @@
+#!/usr/bin/env python
+"""Switch-style mixture-of-experts LM with expert parallelism.
+
+Every other block's MLP is a top-1 MoE (``parallel/moe.py``); with ``tp=k``
+the experts are SHARDED over the 'model' axis (each chip in a group hosts
+``moe_experts/k`` experts) while attention stays tensor-parallel on the
+same axis.  The Switch load-balance loss rides into the objective with
+coefficient ``moe_aux``.
+"""
+
+from _common import setup
+
+setup()
+
+from theanompi_tpu import BSP  # noqa: E402
+
+if __name__ == "__main__":
+    rule = BSP()
+    rule.init(
+        devices=4,
+        tp=2,                  # = expert-parallel degree
+        modelfile="theanompi_tpu.models.transformer_lm",
+        modelclass="MoETransformerLM",
+        batch_size=16,
+        seq_len=128,
+        vocab=256,
+        d_model=256,
+        n_layer=4,
+        n_head=8,
+        moe_experts=8,
+        moe_every=2,
+        capacity_factor=1.25,
+        moe_aux=0.01,
+        epochs=5,
+        printFreq=20,
+    )
+    rule.wait()
