@@ -20,6 +20,7 @@ run googlenet-b32-spc8      BENCH_MODEL=googlenet BENCH_SPC=8 BENCH_SYNTH_BATCHE
 run alexnet-b128-spc8       BENCH_MODEL=alexnet BENCH_SPC=8 BENCH_SYNTH_BATCHES=8
 
 run transformer_lm-b16      BENCH_MODEL=transformer_lm BENCH_BATCH=16 BENCH_CFG="$LM_CFG"
+run transformer_lm-b16-flash BENCH_MODEL=transformer_lm BENCH_BATCH=16 BENCH_CFG="${LM_CFG%\}},\"attn_impl\":\"flash\"}"
 run moe_lm-b16              BENCH_MODEL=moe_lm         BENCH_BATCH=16 BENCH_CFG="$LM_CFG"
 
 # vgg16 last — prime wedge suspect

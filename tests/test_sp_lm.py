@@ -93,6 +93,33 @@ def test_sp_val_matches_dense(mesh8):
     assert ed == pytest.approx(es, abs=1e-6)
 
 
+def test_sp_with_compressed_wire(mesh8):
+    """EF compression under sp: params (and so grads, after the automatic
+    transpose-psum) are replicated over 'seq', so the EF state stays
+    replicated too — the default spec path must handle a 'seq'-axis mesh."""
+    model = _make(dp=2, sp=4, exch_strategy="onebit")
+    costs = _train_steps(model, BSP_Exchanger(model.config), 6)
+    assert np.isfinite(costs).all()
+    assert np.mean(costs[-3:]) < np.mean(costs[:3])
+    ef = model.step_state["extra"]["strat"]
+    assert ef.sharding.spec == (WORKER_AXIS,), ef.sharding.spec
+
+
+def test_attn_impl_plumbing(mesh8):
+    """attn_impl threads from config to every attention layer; 'flash' is
+    TPU-only so CPU tests check the wiring, not the kernel."""
+    model = _make(dp=2, sp=1)
+    assert all(b.attn.attn_impl == "reference" for b in model.blocks)
+    mesh = worker_mesh(2)
+    cfg = {**LM_CFG, "mesh": mesh, "size": 2, "rank": 0,
+           "attn_impl": "flash"}
+    m2 = TransformerLM(cfg)
+    assert all(b.attn.attn_impl == "flash" for b in m2.blocks)
+    with pytest.raises(AssertionError):
+        from theanompi_tpu.models import layers as L
+        L.MultiHeadAttention(32, 4, attn_impl="nope")
+
+
 def test_sp_with_async_rule_smoke(mesh8):
     model = _make(dp=2, sp=4, sync_freq=2)
     exch = get_exchanger("easgd", model.config)

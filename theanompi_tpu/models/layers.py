@@ -435,16 +435,35 @@ class MultiHeadAttention(Layer):
     QKV/output projections ride the MXU in ``compute_dtype``; the softmax
     attention itself runs through :func:`ops.ring_attention.attention_reference`
     (fp32 accumulation) — the sequence-SHARDED variant of the same math is
-    :func:`ops.ring_attention.ring_attention` on a 2-D data×seq mesh."""
+    :func:`ops.ring_attention.ring_attention` on a 2-D data×seq mesh.
+
+    ``attn_impl='flash'`` (TPU only): the fused Pallas flash-attention
+    kernel (``jax.experimental.pallas.ops.tpu.flash_attention`` — tiled
+    online-softmax in VMEM, custom VJP, never materializes the [T, T]
+    scores) instead of the XLA einsum chain.  Needs seq_len a multiple of
+    the kernel's 128-wide blocks."""
 
     def __init__(self, dim: int, n_head: int, causal: bool = True,
                  w_init=("normal", 0.02), compute_dtype=jnp.bfloat16,
-                 name: str = "attn"):
+                 attn_impl: str = "reference", name: str = "attn"):
         assert dim % n_head == 0
+        assert attn_impl in ("reference", "flash"), attn_impl
         self.dim, self.n_head, self.causal = dim, n_head, causal
         self.w_init = w_init
         self.compute_dtype = compute_dtype
+        self.attn_impl = attn_impl
         self.name = name
+
+    def _attend(self, q, k, v):
+        """[B, H, T, hd] → [B, H, T, hd] softmax attention."""
+        if self.attn_impl == "flash":
+            from jax.experimental.pallas.ops.tpu.flash_attention import \
+                flash_attention
+            hd = q.shape[-1]
+            return flash_attention(q, k, v, causal=self.causal,
+                                   sm_scale=1.0 / (hd ** 0.5))
+        from ..ops.ring_attention import attention_reference
+        return attention_reference(q, k, v, causal=self.causal)
 
     def init(self, key):
         ks = jax.random.split(key, 4)
@@ -453,7 +472,6 @@ class MultiHeadAttention(Layer):
                 "wo": mk(ks[3])}
 
     def apply(self, params, x, *, train=False, rng=None, state=None):
-        from ..ops.ring_attention import attention_reference
         cd = self.compute_dtype
         b, t, d = x.shape
         h, hd = self.n_head, self.dim // self.n_head
@@ -464,7 +482,7 @@ class MultiHeadAttention(Layer):
             return y.reshape(b, t, h, hd).transpose(0, 2, 1, 3)  # [B,H,T,hd]
 
         q, k, v = proj(params["wq"]), proj(params["wk"]), proj(params["wv"])
-        o = attention_reference(q, k, v, causal=self.causal)
+        o = self._attend(q, k, v)
         o = o.transpose(0, 2, 1, 3).reshape(b, t, d)
         return jnp.dot(o.astype(cd), params["wo"].astype(cd))
 

@@ -73,7 +73,7 @@ class Block(L.Layer):
     has_state = False
 
     def __init__(self, dim, n_head, mlp_ratio=4, cd=jnp.bfloat16, tp=1,
-                 sp=1, name="block"):
+                 sp=1, attn_impl="reference", name="block"):
         from ..parallel import tp as tplib
         self.name = name
         self.tp = tp
@@ -81,14 +81,21 @@ class Block(L.Layer):
         if tp > 1:
             self.attn = tplib.TPMultiHeadAttention(dim, n_head, tp,
                                                    compute_dtype=cd,
+                                                   attn_impl=attn_impl,
                                                    name="attn")
         elif sp > 1:
-            # sequence-sharded activations: ring attention over 'seq'
+            # sequence-sharded activations: ring attention over 'seq' — the
+            # blockwise accumulate is its own kernel, so a flash request
+            # must fail fast rather than silently measure the ring path
+            assert attn_impl == "reference", (
+                f"attn_impl={attn_impl!r} does not apply under sp>1 "
+                "(sequence-sharded attention is the ring kernel)")
             from ..parallel.sp import RingMultiHeadAttention
             self.attn = RingMultiHeadAttention(dim, n_head, compute_dtype=cd,
                                                name="attn")
         else:
             self.attn = L.MultiHeadAttention(dim, n_head, compute_dtype=cd,
+                                             attn_impl=attn_impl,
                                              name="attn")
         self.ln2 = L.LayerNorm(dim, name="ln2")
         # fc1 is column-parallel under tp: a plain FC applied to the local
@@ -134,11 +141,12 @@ class MoEBlock(Block):
     up to the model's loss head."""
 
     def __init__(self, dim, n_head, n_experts, mlp_ratio=4, cd=jnp.bfloat16,
-                 tp=1, capacity_factor=1.25, name="moe_block"):
+                 tp=1, capacity_factor=1.25, attn_impl="reference",
+                 name="moe_block"):
         # attention (and its specs) come from Block; tp doubles as the
         # expert-parallel degree — both shard over the same 'model' axis
         super().__init__(dim, n_head, mlp_ratio=mlp_ratio, cd=cd, tp=tp,
-                         name=name)
+                         attn_impl=attn_impl, name=name)
         from ..parallel.moe import MoE
         self.moe = MoE(dim, n_experts, mlp_ratio=mlp_ratio, ep=tp,
                        capacity_factor=capacity_factor, compute_dtype=cd,
@@ -224,8 +232,10 @@ class TransformerLM(ModelBase):
                                      compute_dtype=cd)
         self.pos = L.Embedding(self.seq_len, self.d_model, compute_dtype=cd,
                                name="pos")
+        attn_impl = str(self.config.get("attn_impl", "reference"))
         self.blocks = [Block(self.d_model, self.n_head, cd=cd, tp=self.tp,
-                             sp=self.sp, name=f"block{i}")
+                             sp=self.sp, attn_impl=attn_impl,
+                             name=f"block{i}")
                        for i in range(self.n_layer)]
         self.ln_f = L.LayerNorm(self.d_model, name="ln_f")
         # under tp the head is column-parallel over the VOCAB; the loss works
@@ -381,13 +391,14 @@ class MoETransformerLM(TransformerLM):
             assert self.moe_experts % self.tp == 0, (
                 f"moe_experts={self.moe_experts} not divisible by "
                 f"tp/ep={self.tp}")
+        attn_impl = str(self.config.get("attn_impl", "reference"))
         self.blocks = [
             MoEBlock(self.d_model, self.n_head, self.moe_experts, cd=cd,
                      tp=self.tp, capacity_factor=self.capacity_factor,
-                     name=f"block{i}")
+                     attn_impl=attn_impl, name=f"block{i}")
             if (i + 1) % self.moe_every == 0 else
             Block(self.d_model, self.n_head, cd=cd, tp=self.tp,
-                  name=f"block{i}")
+                  attn_impl=attn_impl, name=f"block{i}")
             for i in range(self.n_layer)]
 
     def _forward(self, params, x, *, train):

@@ -107,7 +107,6 @@ class TPMultiHeadAttention(L.MultiHeadAttention):
         self.axis = axis
 
     def apply(self, params, x, *, train=False, rng=None, state=None):
-        from ..ops.ring_attention import attention_reference
         cd = self.compute_dtype
         b, t, d = x.shape
         h_loc = self.n_head // self.tp
@@ -121,7 +120,7 @@ class TPMultiHeadAttention(L.MultiHeadAttention):
             return y.reshape(b, t, h_loc, hd).transpose(0, 2, 1, 3)
 
         q, k, v = proj(params["wq"]), proj(params["wk"]), proj(params["wv"])
-        o = attention_reference(q, k, v, causal=self.causal)
+        o = self._attend(q, k, v)     # local heads, full sequence
         o = o.transpose(0, 2, 1, 3).reshape(b, t, d_loc)
         # output projection: local wo slice is [d/tp, d] (row-parallel)
         return lax.psum(jnp.dot(o.astype(cd), params["wo"].astype(cd)),
