@@ -101,6 +101,52 @@ def test_moe_converges_and_validates(mesh8):
     model.end_val()
 
 
+def test_moe_pp_matches_dense_layout(mesh8):
+    """A homogeneous all-MoE stack (moe_every=1) pipelines over 'pipe': same
+    init (stacked from the same keys) and — with drop-free capacity and the
+    aux term off — the same loss curve as the pp=1 layout.  (With binding
+    capacity the layouts legitimately differ: GPipe routes per MICROBATCH,
+    so the capacity cutoff and the nonlinear aux statistic see B/M-row
+    token sets — inherent pipeline-MoE semantics, not an implementation
+    gap.)"""
+    def make(pp):
+        mesh = worker_mesh(2, pp=pp)
+        cfg = {**CFG, "mesh": mesh, "size": 2, "rank": 0, "tp": 1, "pp": pp,
+               "moe_every": 1, "n_layer": 4, "pp_microbatches": 4,
+               "capacity_factor": 4.0, "moe_aux": 0.0}
+        return MoETransformerLM(cfg)
+
+    m1, m4 = make(1), make(4)
+    stacked = m4.params["blocks"]
+    for i, blk in enumerate(m1.blocks):
+        jax.tree.map(lambda s, d: np.testing.assert_array_equal(
+            np.asarray(s[i]), np.asarray(d)),
+            stacked, m1.params[blk.name])
+    c1 = _train_steps(m1, 5)
+    c4 = _train_steps(m4, 5)
+    np.testing.assert_allclose(c4, c1, rtol=2e-4, atol=2e-5)
+
+
+def test_moe_pp_with_aux_converges(mesh8):
+    """Default capacity/aux on the pipelined MoE stack: the aux rides the
+    pipeline (bubble ticks masked) and training converges."""
+    mesh = worker_mesh(2, pp=4)
+    cfg = {**CFG, "mesh": mesh, "size": 2, "rank": 0, "tp": 1, "pp": 4,
+           "moe_every": 1, "n_layer": 4, "pp_microbatches": 4}
+    m = MoETransformerLM(cfg)
+    costs = _train_steps(m, 8)
+    assert np.isfinite(costs).all()
+    assert np.mean(costs[-3:]) < np.mean(costs[:3])
+
+
+def test_moe_mixed_stack_rejects_pp(mesh8):
+    mesh = worker_mesh(2, pp=4)
+    cfg = {**CFG, "mesh": mesh, "size": 2, "rank": 0, "tp": 1, "pp": 4,
+           "moe_every": 2, "n_layer": 4}
+    with pytest.raises(AssertionError, match="homogeneous"):
+        MoETransformerLM(cfg)
+
+
 def test_moe_checkpoint_roundtrip(tmp_path, mesh8):
     from theanompi_tpu.parallel import steps
     model = _make(dp=2, tp=4)

@@ -112,9 +112,12 @@ def test_attn_impl_plumbing(mesh8):
     assert all(b.attn.attn_impl == "reference" for b in model.blocks)
     mesh = worker_mesh(2)
     cfg = {**LM_CFG, "mesh": mesh, "size": 2, "rank": 0,
-           "attn_impl": "flash"}
+           "attn_impl": "flash", "seq_len": 128}
     m2 = TransformerLM(cfg)
     assert all(b.attn.attn_impl == "flash" for b in m2.blocks)
+    # flash needs 128-aligned sequence blocks — rejected at build time
+    with pytest.raises(AssertionError, match="128"):
+        TransformerLM({**cfg, "seq_len": 96})
     with pytest.raises(AssertionError):
         from theanompi_tpu.models import layers as L
         L.MultiHeadAttention(32, 4, attn_impl="nope")

@@ -233,6 +233,11 @@ class TransformerLM(ModelBase):
         self.pos = L.Embedding(self.seq_len, self.d_model, compute_dtype=cd,
                                name="pos")
         attn_impl = str(self.config.get("attn_impl", "reference"))
+        if attn_impl == "flash":
+            # fail at build time, not with an opaque Pallas lowering error
+            assert self.seq_len % 128 == 0, (
+                f"attn_impl='flash' needs seq_len a multiple of the "
+                f"kernel's 128-wide blocks; got {self.seq_len}")
         self.blocks = [Block(self.d_model, self.n_head, cd=cd, tp=self.tp,
                              sp=self.sp, attn_impl=attn_impl,
                              name=f"block{i}")
@@ -374,9 +379,6 @@ class MoETransformerLM(TransformerLM):
 
     def build_model(self) -> None:
         super().build_model()
-        assert self.pp == 1, (
-            "pipeline parallelism needs a homogeneous block stack; the "
-            "mixed MoE/dense stack does not compose with pp yet")
         assert self.sp == 1, (
             "sequence parallelism does not compose with the MoE stack yet "
             "(expert routing needs the full token set or an all-to-all)")
@@ -384,6 +386,10 @@ class MoETransformerLM(TransformerLM):
         for k in ("moe_experts", "moe_every"):
             if k in self.config:
                 setattr(self, k, int(self.config[k]))
+        assert self.pp == 1 or self.moe_every == 1, (
+            "pipeline parallelism needs a homogeneous block stack: the "
+            "mixed MoE/dense stack (moe_every > 1) does not stack over "
+            "'pipe'; use moe_every=1 (every block MoE) with pp")
         for k in ("moe_aux", "capacity_factor"):
             if k in self.config:
                 setattr(self, k, float(self.config[k]))
@@ -405,19 +411,46 @@ class MoETransformerLM(TransformerLM):
         t = x.shape[1]
         h = self.embed.apply(params["embed"], x) + \
             self.pos.apply(params["pos"], jnp.arange(t))[None]
-        aux = jnp.zeros((), jnp.float32)
-        n_moe = 0
-        for blk in self.blocks:
-            out = blk.apply(params[blk.name], h, train=train)
-            if isinstance(blk, MoEBlock):
-                h, a = out
-                aux = aux + a
-                n_moe += 1
-            else:
-                h = out
+        if self.pp > 1:
+            # homogeneous all-MoE stack over 'pipe': each stage's aux rides
+            # the pipeline (bubble ticks masked), normalized to the dense
+            # layout's mean-aux-per-layer
+            from ..parallel import pipeline as pl
+            tpl = self.blocks[0]
+
+            def stage_fn(stack, hm):
+                def body(carry, lp):
+                    hh, aux = carry
+                    y, a = tpl.apply(lp, hh, train=train)
+                    return (y, aux + a), None
+
+                # zero scalar derived from hm so the scan carry inherits
+                # its full set of varying mesh axes (fresh zeros would be
+                # device-invariant and fail the carry typing)
+                aux0 = (hm.astype(jnp.float32) * 0).sum()
+                (hh, aux), _ = jax.lax.scan(body, (hm, aux0), stack)
+                return hh, aux
+
+            hm = pl.microbatch(h, self.pp_microbatches)
+            hm, aux_sum = pl.pipeline_apply(stage_fn, params["blocks"], hm,
+                                            with_aux=True)
+            h = pl.unmicrobatch(hm)
+            aux = aux_sum / (self.pp_microbatches * self.n_layer)
+        else:
+            aux = jnp.zeros((), jnp.float32)
+            n_moe = 0
+            for blk in self.blocks:
+                out = blk.apply(params[blk.name], h, train=train)
+                if isinstance(blk, MoEBlock):
+                    h, a = out
+                    aux = aux + a
+                    n_moe += 1
+                else:
+                    h = out
+            aux = aux / max(n_moe, 1)
         h = self.ln_f.apply(params["ln_f"], h)
         logits = self.head.apply(params["head"], h)
-        return logits, aux / max(n_moe, 1)
+        return logits, aux
 
     def apply_model(self, params, x, *, train, rng, state):
         logits, _ = self._forward(params, x, train=train)

@@ -33,7 +33,8 @@ from .steps import _vary as _pvary
 
 
 def pipeline_apply(stage_fn: Callable, stage_params, x_micro,
-                   axis: str = PIPE_AXIS, remat: bool = True):
+                   axis: str = PIPE_AXIS, remat: bool = True,
+                   with_aux: bool = False):
     """Stream microbatches through pipeline stages (inside ``shard_map``).
 
     ``stage_fn(stage_params, x) -> y`` applies THIS stage's local layers to
@@ -46,19 +47,30 @@ def pipeline_apply(stage_fn: Callable, stage_params, x_micro,
     ``remat``: rematerialize each stage application on the backward pass —
     the standard GPipe memory trade (activations for the whole scan would
     otherwise be saved per tick).
+
+    ``with_aux``: ``stage_fn`` returns ``(y, aux_scalar)`` (MoE stacks ride
+    their load-balance loss through the pipeline); the return becomes
+    ``(outputs, aux_total)`` where ``aux_total`` sums every stage's aux over
+    the REAL microbatch ticks only — warm-up/drain bubble ticks process
+    zeros/garbage and are masked out — then ``psum``s over the stages.
     """
     pp = lax.psum(1, axis)
     rank = lax.axis_index(axis)
     m = x_micro.shape[0]
-    fn = jax.checkpoint(stage_fn) if remat else stage_fn
+    raw = stage_fn if with_aux \
+        else (lambda p, x: (stage_fn(p, x), jnp.zeros((), jnp.float32)))
+    fn = jax.checkpoint(raw) if remat else raw
 
     shift = [(i, i + 1) for i in range(pp - 1)] if pp > 1 else []
 
     def tick(carry, t):
-        state, outputs = carry
+        state, outputs, aux_acc = carry
         inject = jnp.take(x_micro, jnp.clip(t, 0, m - 1), axis=0)
         inp = jnp.where(rank == 0, inject, state)
-        out = fn(stage_params, inp)
+        out, aux = fn(stage_params, inp)
+        # this stage processed microbatch t-rank this tick iff in [0, M)
+        real = (t >= rank) & (t - rank < m)
+        aux_acc = aux_acc + jnp.where(real, aux, 0.0)
         # the last stage finished microbatch t-(pp-1) this tick
         j = jnp.clip(t - (pp - 1), 0, m - 1)
         collect = (rank == pp - 1) & (t >= pp - 1)
@@ -66,14 +78,20 @@ def pipeline_apply(stage_fn: Callable, stage_params, x_micro,
         outputs = lax.dynamic_update_index_in_dim(
             outputs, jnp.where(collect, out, cur), j, axis=0)
         state = lax.ppermute(out, axis, shift) if shift else out
-        return (state, outputs), None
+        return (state, outputs, aux_acc), None
 
     state0 = _pvary(jnp.zeros_like(x_micro[0]), axis)
     out0 = _pvary(jnp.zeros_like(x_micro), axis)
+    # zero scalar derived from the data so it inherits x_micro's full set of
+    # varying mesh axes (e.g. 'workers') on top of the pipe axis
+    aux0 = _pvary((x_micro.astype(jnp.float32) * 0).sum(), axis)
     ticks = _pvary(jnp.arange(m + pp - 1), axis)
-    (_, outputs), _ = lax.scan(tick, (state0, out0), ticks)
-    # only the last stage wrote non-zeros — masked psum broadcasts to all
-    return lax.psum(outputs, axis)
+    (_, outputs, aux_acc), _ = lax.scan(tick, (state0, out0, aux0), ticks)
+    # only the last stage wrote non-zero outputs — masked psum broadcasts
+    outputs = lax.psum(outputs, axis)
+    if with_aux:
+        return outputs, lax.psum(aux_acc, axis)
+    return outputs
 
 
 def microbatch(x, n_micro: int):
