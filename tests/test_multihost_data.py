@@ -65,13 +65,9 @@ def test_launcher_emit_only_composes_per_host_commands(capsys):
     assert "coordinator_address=h0:1234" in out
 
 
-def test_two_process_jax_distributed_bsp_step():
-    """REAL 2-process jax.distributed run (VERDICT round-1 Weak #6): two
-    subprocesses × 2 virtual CPU devices form a 4-worker global mesh, load
-    per-host data shards, stitch them with make_per_host_array inside
-    put_batch, run 2 compiled BSP steps, and gather state multi-host.  Both
-    processes must agree with each other AND with a single-process 4-worker
-    oracle."""
+def _run_twoproc_and_compare(mode, oracle):
+    """Spawn 2 jax.distributed subprocesses via twoproc_helper.py, parse
+    their 'FP ' fingerprint lines, and assert both agree with ``oracle``."""
     helper = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                           "twoproc_helper.py")
     with socket.socket() as s:
@@ -79,7 +75,7 @@ def test_two_process_jax_distributed_bsp_step():
         port = s.getsockname()[1]
 
     procs = [subprocess.Popen(
-        [sys.executable, helper, str(i), str(port)],
+        [sys.executable, helper, str(i), str(port), mode],
         stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True)
         for i in (0, 1)]
     outs = []
@@ -88,19 +84,35 @@ def test_two_process_jax_distributed_bsp_step():
         assert p.returncode == 0, f"proc failed:\n{out}\n{err}"
         outs.append(out)
 
-    fps = []
     for out in outs:
         lines = [l for l in out.splitlines() if l.startswith("FP ")]
         assert lines, out
-        fps.append(json.loads(lines[0][3:]))
-
-    from tests.twoproc_model import fingerprint_after_steps
-    oracle = fingerprint_after_steps(n_workers=4)
-    for fp in fps:
+        fp = json.loads(lines[0][3:])
         np.testing.assert_allclose(fp["sums"], oracle["sums"],
                                    rtol=1e-5, atol=1e-6)
         np.testing.assert_allclose(fp["first"], oracle["first"],
                                    rtol=1e-5, atol=1e-6)
+
+
+def test_two_process_jax_distributed_bsp_step():
+    """REAL 2-process jax.distributed run (VERDICT round-1 Weak #6): two
+    subprocesses × 2 virtual CPU devices form a 4-worker global mesh, load
+    per-host data shards, stitch them with make_per_host_array inside
+    put_batch, run 2 compiled BSP steps, and gather state multi-host.  Both
+    processes must agree with each other AND with a single-process 4-worker
+    oracle."""
+    from tests.twoproc_model import fingerprint_after_steps
+    _run_twoproc_and_compare("dense", fingerprint_after_steps(n_workers=4))
+
+
+def test_two_process_tp_transformer_step():
+    """Multi-host × tensor parallelism — the real-scale layout (dp across
+    hosts, tp within a host): two jax.distributed processes × 2 virtual
+    devices form a (workers=2, model=2) global mesh; each process feeds its
+    worker group's batch shard, the tp-sharded params train 2 BSP steps, and
+    the multi-host gather must agree with a single-process oracle."""
+    from tests.twoproc_model import fingerprint_after_steps_tp
+    _run_twoproc_and_compare("tp", fingerprint_after_steps_tp(dp=2, tp=2))
 
 
 def test_database_host_slices_partition_global_batch():

@@ -31,8 +31,14 @@ def main() -> int:
     assert jax.process_count() == 2, jax.process_count()
     assert len(jax.devices()) == 4
 
-    from tests.twoproc_model import fingerprint_after_steps
-    fp = fingerprint_after_steps(n_workers=4)
+    mode = sys.argv[3] if len(sys.argv) > 3 else "dense"
+    if mode == "tp":
+        # dp=2 across the processes × tp=2 within each process's 2 devices
+        from tests.twoproc_model import fingerprint_after_steps_tp
+        fp = fingerprint_after_steps_tp(dp=2, tp=2)
+    else:
+        from tests.twoproc_model import fingerprint_after_steps
+        fp = fingerprint_after_steps(n_workers=4)
     print("FP " + json.dumps({"proc": proc_id, **fp}), flush=True)
     return 0
 

@@ -63,3 +63,33 @@ def fingerprint_after_steps(n_workers: int, n_steps: int = 2) -> dict:
     leaves = jax.tree_util.tree_leaves(jax.device_get(host))
     return {"sums": [float(np.asarray(l).sum()) for l in leaves],
             "first": [float(np.asarray(l).reshape(-1)[0]) for l in leaves]}
+
+
+def fingerprint_after_steps_tp(dp: int = 2, tp: int = 2,
+                               n_steps: int = 2) -> dict:
+    """The real-scale layout: dp ACROSS hosts × tp WITHIN a host.  Each
+    process contributes one tensor-parallel worker group; the tp psums ride
+    intra-host links, the dp gradient reduce crosses hosts."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from theanompi_tpu.models.transformer_lm import TransformerLM
+    from theanompi_tpu.parallel import steps
+    from theanompi_tpu.parallel.exchanger import BSP_Exchanger
+    from theanompi_tpu.parallel.mesh import worker_mesh
+
+    mesh = worker_mesh(dp, tp=tp)
+    cfg = {"mesh": mesh, "size": dp, "rank": 0, "tp": tp, "verbose": False,
+           "batch_size": 8, "seq_len": 16, "vocab": 16, "d_model": 16,
+           "n_head": 2, "n_layer": 1, "synthetic_train": 64,
+           "synthetic_val": 32, "compute_dtype": jnp.float32, "seed": 5}
+    m = TransformerLM(cfg)
+    m.compile_iter_fns(BSP_Exchanger(cfg))
+    m.data.shuffle_data(0)
+    for i in range(1, n_steps + 1):
+        m.train_iter(i, None)
+    host = steps.tree_to_host(m.step_state["params"])
+    leaves = jax.tree_util.tree_leaves(jax.device_get(host))
+    return {"sums": [float(np.asarray(l).sum()) for l in leaves],
+            "first": [float(np.asarray(l).reshape(-1)[0]) for l in leaves]}

@@ -424,10 +424,11 @@ class MoETransformerLM(TransformerLM):
                     y, a = tpl.apply(lp, hh, train=train)
                     return (y, aux + a), None
 
-                # zero scalar derived from hm so the scan carry inherits
-                # its full set of varying mesh axes (fresh zeros would be
-                # device-invariant and fail the carry typing)
-                aux0 = (hm.astype(jnp.float32) * 0).sum()
+                # zero scalar derived from ONE element of hm so the scan
+                # carry inherits its full set of varying mesh axes (fresh
+                # zeros would be device-invariant and fail the carry typing;
+                # a full-tensor reduce would pay O(mb·T·D) per tick)
+                aux0 = hm.reshape(-1)[0].astype(jnp.float32) * 0
                 (hh, aux), _ = jax.lax.scan(body, (hm, aux0), stack)
                 return hh, aux
 

@@ -82,9 +82,10 @@ def pipeline_apply(stage_fn: Callable, stage_params, x_micro,
 
     state0 = _pvary(jnp.zeros_like(x_micro[0]), axis)
     out0 = _pvary(jnp.zeros_like(x_micro), axis)
-    # zero scalar derived from the data so it inherits x_micro's full set of
-    # varying mesh axes (e.g. 'workers') on top of the pipe axis
-    aux0 = _pvary((x_micro.astype(jnp.float32) * 0).sum(), axis)
+    # zero scalar derived from ONE element of the data so it inherits
+    # x_micro's full set of varying mesh axes (e.g. 'workers') on top of the
+    # pipe axis, without a full-tensor reduce
+    aux0 = _pvary(x_micro.reshape(-1)[0].astype(jnp.float32) * 0, axis)
     ticks = _pvary(jnp.arange(m + pp - 1), axis)
     (_, outputs, aux_acc), _ = lax.scan(tick, (state0, out0, aux0), ticks)
     # only the last stage wrote non-zero outputs — masked psum broadcasts
