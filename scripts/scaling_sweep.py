@@ -36,7 +36,7 @@ from theanompi_tpu.models.registry import MODELS  # noqa: E402
 
 
 def measure(modelfile, modelclass, extra, n_workers, strategy, batch_size,
-            iters, warmup):
+            iters, warmup, tp=1, pp=1, sp=1):
     import importlib
 
     import jax
@@ -46,13 +46,14 @@ def measure(modelfile, modelclass, extra, n_workers, strategy, batch_size,
     from theanompi_tpu.parallel.exchanger import BSP_Exchanger
     from theanompi_tpu.parallel.mesh import worker_mesh
 
-    mesh = worker_mesh(n_workers)
+    mesh = worker_mesh(n_workers, tp=tp, pp=pp, sp=sp)
     config = {"mesh": mesh, "size": n_workers, "verbose": False,
-              "exch_strategy": strategy, "batch_size": batch_size, **extra}
+              "exch_strategy": strategy, "batch_size": batch_size,
+              "tp": tp, "pp": pp, "sp": sp, **extra}
     model = getattr(importlib.import_module(modelfile), modelclass)(config)
     model.compile_iter_fns(BSP_Exchanger(config))
     batch = model.data.next_train_batch(0)
-    dev = steps.put_batch(mesh, batch)
+    dev = steps.put_batch(mesh, batch, model.batch_spec())
     n_images = int(batch["y"].shape[0])
     lr, rng = jnp.float32(model.current_lr), jax.random.key(0)
     st = model.step_state
@@ -65,9 +66,10 @@ def measure(modelfile, modelclass, extra, n_workers, strategy, batch_size,
     jax.block_until_ready(st["params"])
     dt = time.time() - t0
     ips = n_images * iters / dt
+    n_chips = n_workers * tp * pp * sp      # a worker is a GROUP of chips
     return {"workers": n_workers, "strategy": strategy,
             "images_per_sec": round(ips, 1),
-            "images_per_sec_per_chip": round(ips / n_workers, 1),
+            "images_per_sec_per_chip": round(ips / n_chips, 1),
             "time_per_5120": round(5120.0 / ips, 3)}
 
 
@@ -87,10 +89,19 @@ def main(argv=None) -> int:
     p.add_argument("--measure-comm", action="store_true",
                    help="add a comm-share column per strategy (differences "
                         "each fused step against the 'none' strategy)")
+    p.add_argument("--tp", type=int, default=1,
+                   help="tensor-parallel degree per worker group "
+                        "(transformer family; sweeps dp GROUPS)")
+    p.add_argument("--pp", type=int, default=1, help="pipeline degree")
+    p.add_argument("--sp", type=int, default=1, help="sequence degree")
     args = p.parse_args(argv)
 
     import jax
-    n_dev = len(jax.devices())
+    group = args.tp * args.pp * args.sp
+    n_dev = len(jax.devices()) // group
+    if n_dev == 0:
+        p.error(f"group size tp*pp*sp = {group} exceeds the "
+                f"{len(jax.devices())} visible devices — nothing to sweep")
     counts, c = [], 1
     while c <= n_dev:
         counts.append(c)
@@ -108,7 +119,8 @@ def main(argv=None) -> int:
                 base_step[n] = None     # no comm at 1 worker by definition
                 continue
             r0 = measure(modelfile, modelclass, extra, n, "none",
-                         args.batch_size, args.iters, args.warmup)
+                         args.batch_size, args.iters, args.warmup,
+                         tp=args.tp, pp=args.pp, sp=args.sp)
             base_step[n] = r0["time_per_5120"]
 
     base_ips = {}
@@ -116,7 +128,8 @@ def main(argv=None) -> int:
     for strategy in args.strategies:
         for n in counts:
             r = measure(modelfile, modelclass, extra, n, strategy,
-                        args.batch_size, args.iters, args.warmup)
+                        args.batch_size, args.iters, args.warmup,
+                        tp=args.tp, pp=args.pp, sp=args.sp)
             key = strategy
             if n == 1:
                 base_ips[key] = r["images_per_sec"]

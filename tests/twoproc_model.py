@@ -8,18 +8,34 @@ single-process mode).
 from __future__ import annotations
 
 
+def _train_and_fingerprint(m, exchanger, n_steps: int) -> dict:
+    """Shared tail: compile, train ``n_steps``, gather multi-host, and
+    fingerprint the params (per-leaf sums + first elements)."""
+    import jax
+    import numpy as np
+
+    from theanompi_tpu.parallel import steps
+
+    m.compile_iter_fns(exchanger)
+    m.data.shuffle_data(0)
+    for i in range(1, n_steps + 1):
+        m.train_iter(i, None)
+    host = steps.tree_to_host(m.step_state["params"])
+    leaves = jax.tree_util.tree_leaves(jax.device_get(host))
+    return {"sums": [float(np.asarray(l).sum()) for l in leaves],
+            "first": [float(np.asarray(l).reshape(-1)[0]) for l in leaves]}
+
+
 def fingerprint_after_steps(n_workers: int, n_steps: int = 2) -> dict:
     """Run ``n_steps`` BSP iterations on a tiny MLP over ``n_workers`` and
     return a params fingerprint (per-leaf sums + first elements) computed
     from the gathered global state."""
-    import jax
     import jax.numpy as jnp
     import numpy as np
 
     from theanompi_tpu.models import layers as L
     from theanompi_tpu.models.data import DataBase
     from theanompi_tpu.models.model_base import ModelBase
-    from theanompi_tpu.parallel import steps
     from theanompi_tpu.parallel.exchanger import BSP_Exchanger
     from theanompi_tpu.parallel.mesh import worker_mesh
 
@@ -54,15 +70,7 @@ def fingerprint_after_steps(n_workers: int, n_steps: int = 2) -> dict:
 
     mesh = worker_mesh(n_workers)
     config = {"mesh": mesh, "size": n_workers, "rank": 0, "verbose": False}
-    m = M(config)
-    m.compile_iter_fns(BSP_Exchanger(config))
-    m.data.shuffle_data(0)
-    for i in range(1, n_steps + 1):
-        m.train_iter(i, None)
-    host = steps.tree_to_host(m.step_state["params"])
-    leaves = jax.tree_util.tree_leaves(jax.device_get(host))
-    return {"sums": [float(np.asarray(l).sum()) for l in leaves],
-            "first": [float(np.asarray(l).reshape(-1)[0]) for l in leaves]}
+    return _train_and_fingerprint(M(config), BSP_Exchanger(config), n_steps)
 
 
 def fingerprint_after_steps_tp(dp: int = 2, tp: int = 2,
@@ -70,12 +78,9 @@ def fingerprint_after_steps_tp(dp: int = 2, tp: int = 2,
     """The real-scale layout: dp ACROSS hosts × tp WITHIN a host.  Each
     process contributes one tensor-parallel worker group; the tp psums ride
     intra-host links, the dp gradient reduce crosses hosts."""
-    import jax
     import jax.numpy as jnp
-    import numpy as np
 
     from theanompi_tpu.models.transformer_lm import TransformerLM
-    from theanompi_tpu.parallel import steps
     from theanompi_tpu.parallel.exchanger import BSP_Exchanger
     from theanompi_tpu.parallel.mesh import worker_mesh
 
@@ -84,12 +89,5 @@ def fingerprint_after_steps_tp(dp: int = 2, tp: int = 2,
            "batch_size": 8, "seq_len": 16, "vocab": 16, "d_model": 16,
            "n_head": 2, "n_layer": 1, "synthetic_train": 64,
            "synthetic_val": 32, "compute_dtype": jnp.float32, "seed": 5}
-    m = TransformerLM(cfg)
-    m.compile_iter_fns(BSP_Exchanger(cfg))
-    m.data.shuffle_data(0)
-    for i in range(1, n_steps + 1):
-        m.train_iter(i, None)
-    host = steps.tree_to_host(m.step_state["params"])
-    leaves = jax.tree_util.tree_leaves(jax.device_get(host))
-    return {"sums": [float(np.asarray(l).sum()) for l in leaves],
-            "first": [float(np.asarray(l).reshape(-1)[0]) for l in leaves]}
+    return _train_and_fingerprint(TransformerLM(cfg), BSP_Exchanger(cfg),
+                                  n_steps)
