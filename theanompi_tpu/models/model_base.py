@@ -330,6 +330,22 @@ class ModelBase:
         self._lr_scale = float(size)
         self.current_lr = self.current_lr * size
 
+    def canonical_host_params(self):
+        """Host copy of the parameters inference/analysis should use: the
+        EASGD center / GoSGD consensus via the exchanger's
+        ``canonical_params`` (fed only the params+extra it reads — not the
+        optimizer state), replica 0 for BSP, or the init params before
+        ``compile_iter_fns``."""
+        if self.step_state is None:
+            return self.params
+        if self.exchanger is not None and hasattr(self.exchanger,
+                                                  "canonical_params"):
+            state = {k: steps.tree_to_host(self.step_state[k])
+                     for k in ("params", "extra")}
+            return jax.device_get(self.exchanger.canonical_params(state))
+        return steps.unbox(jax.device_get(
+            steps.tree_to_host(self.step_state["params"])))
+
     def next_exchange_key(self):
         self._exch_key, sub = jax.random.split(self._exch_key)
         return sub

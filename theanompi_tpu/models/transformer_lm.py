@@ -38,6 +38,7 @@ class LMData(DataBase):
         vocab = int(self.config.get("vocab", vocab))
         n_train = int(self.config.get("synthetic_train", n_train))
         n_val = int(self.config.get("synthetic_val", n_val))
+        noise = float(self.config.get("noise", noise))
 
         def make(n, seed):
             r = np.random.RandomState(seed)
@@ -362,6 +363,72 @@ class TransformerLM(ModelBase):
             from ..parallel.sp import sp_mean
             cost, err, err5 = sp_mean(cost), sp_mean(err), sp_mean(err5)
         return cost, (err, err5)
+
+
+    # -- inference ---------------------------------------------------------
+
+    def generate(self, prompt, max_new_tokens: int, temperature: float = 0.0,
+                 seed: int = 0):
+        """Sample continuations — greedy (``temperature=0``) or categorical.
+
+        One jit-compiled ``lax.scan`` over decode steps on a fixed
+        ``[B, seq_len]`` token buffer (static shapes; causal masking makes
+        the not-yet-written tail irrelevant), running the FULL forward per
+        step — the right trade below ``seq_len`` caps like these; a KV cache
+        is the next lever for long generations.  Uses the canonical params
+        (EASGD center / GoSGD consensus / BSP replica 0) gathered to one
+        device, so it works after training under any rule; model-parallel
+        layouts (tp/pp/sp) gather to a dense run the same way but are not
+        wired yet.
+        """
+        assert self.tp == 1 and self.pp == 1 and self.sp == 1, (
+            "generate() runs the gathered params densely; model-parallel "
+            "layouts are not wired into the sampler yet")
+        import numpy as np
+
+        prompt = np.asarray(prompt, dtype=np.int32)
+        if prompt.ndim == 1:
+            prompt = prompt[None]
+        b, p_len = prompt.shape
+        assert p_len >= 1, "generate() needs at least one prompt token"
+        assert p_len + max_new_tokens <= self.seq_len, (
+            f"prompt {p_len} + {max_new_tokens} new tokens exceeds "
+            f"seq_len={self.seq_len} (the position-embedding table)")
+
+        params = self.canonical_host_params()
+        toks0 = np.zeros((b, self.seq_len), np.int32)
+        toks0[:, :p_len] = prompt
+
+        if getattr(self, "_gen_jit", None) is None:
+            # bound method + static max_new: jit's own cache memoizes per
+            # length, one sampler object per model instance
+            self._gen_jit = jax.jit(self._gen_body,
+                                    static_argnames=("max_new",))
+        toks, new = self._gen_jit(params, jnp.asarray(toks0),
+                                  jnp.int32(p_len), jax.random.key(seed),
+                                  jnp.float32(temperature),
+                                  max_new=int(max_new_tokens))
+        return np.asarray(jax.device_get(new))
+
+    def _gen_body(self, params, toks, start_pos, key, temp, *, max_new):
+        def body(carry, _):
+            toks, pos, key = carry
+            logits, _ = self.apply_model(params, toks, train=False,
+                                         rng=None, state={})
+            row = jax.lax.dynamic_index_in_dim(
+                logits, pos - 1, axis=1, keepdims=False)       # [B, V]
+            key, sub = jax.random.split(key)
+            greedy = jnp.argmax(row, axis=-1).astype(jnp.int32)
+            sampled = jax.random.categorical(
+                sub, row.astype(jnp.float32) /
+                jnp.maximum(temp, 1e-6)).astype(jnp.int32)
+            nxt = jnp.where(temp > 0, sampled, greedy)
+            toks = jax.lax.dynamic_update_slice(toks, nxt[:, None], (0, pos))
+            return (toks, pos + 1, key), nxt
+
+        (toks, _, _), out = jax.lax.scan(body, (toks, start_pos, key), None,
+                                         length=max_new)
+        return toks, out.T              # [B, max_new]
 
 
 class MoETransformerLM(TransformerLM):
