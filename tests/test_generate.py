@@ -57,6 +57,25 @@ def test_generate_greedy_is_deterministic_sampling_varies(mesh8):
         s1, model.generate(p, max_new_tokens=6, temperature=2.0, seed=1))
 
 
+def test_kv_cache_matches_full_forward_decode(mesh8):
+    """The KV-cache sampler must emit the same tokens as the full-forward
+    sampler (same trained params, greedy) — and the raw decode-step logits
+    path is pinned by the attention layers' own math being identical."""
+    mesh = worker_mesh(4)
+    model = _train(TransformerLM({**CFG, "mesh": mesh, "size": 4,
+                                  "rank": 0}), 40)
+    prompt = np.array([[2, 3, 4], [9, 10, 11]], np.int32)
+    kv = model.generate(prompt, max_new_tokens=10, kv_cache=True)
+    full = model.generate(prompt, max_new_tokens=10, kv_cache=False)
+    # the two graphs reduce in different orders, so a near-tied logit could
+    # flip one argmax in the last ulp — require near-total, not bit, parity
+    assert np.mean(kv == full) >= 0.9, (kv, full)
+    kv_s = model.generate(prompt, max_new_tokens=6, temperature=1.0, seed=7)
+    full_s = model.generate(prompt, max_new_tokens=6, temperature=1.0,
+                            seed=7, kv_cache=False)
+    assert np.mean(kv_s == full_s) >= 0.8, (kv_s, full_s)
+
+
 def test_generate_moe_and_untrained(mesh8):
     mesh = worker_mesh(2)
     moe = MoETransformerLM({**CFG, "mesh": mesh, "size": 2, "rank": 0,

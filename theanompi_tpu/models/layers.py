@@ -471,20 +471,63 @@ class MultiHeadAttention(Layer):
         return {"wq": mk(ks[0]), "wk": mk(ks[1]), "wv": mk(ks[2]),
                 "wo": mk(ks[3])}
 
+    def _proj(self, params, x, name):
+        cd = self.compute_dtype
+        b, t, _ = x.shape
+        h, hd = self.n_head, self.dim // self.n_head
+        y = jnp.dot(x.astype(cd), params[name].astype(cd))
+        return y.reshape(b, t, h, hd).transpose(0, 2, 1, 3)    # [B,H,T,hd]
+
     def apply(self, params, x, *, train=False, rng=None, state=None):
         cd = self.compute_dtype
         b, t, d = x.shape
-        h, hd = self.n_head, self.dim // self.n_head
-        xc = x.astype(cd)
-
-        def proj(w):
-            y = jnp.dot(xc, w.astype(cd))
-            return y.reshape(b, t, h, hd).transpose(0, 2, 1, 3)  # [B,H,T,hd]
-
-        q, k, v = proj(params["wq"]), proj(params["wk"]), proj(params["wv"])
+        q = self._proj(params, x, "wq")
+        k = self._proj(params, x, "wk")
+        v = self._proj(params, x, "wv")
         o = self._attend(q, k, v)
         o = o.transpose(0, 2, 1, 3).reshape(b, t, d)
         return jnp.dot(o.astype(cd), params["wo"].astype(cd))
+
+    # -- KV-cache decode path (inference; tests pin it against apply) ------
+
+    def apply_prefill(self, params, x):
+        """Full causal forward over the prompt buffer that ALSO returns the
+        projected K/V as the decode cache: ``(y, (k, v))``,
+        k/v ``[B, H, S, hd]``."""
+        cd = self.compute_dtype
+        b, t, d = x.shape
+        q = self._proj(params, x, "wq")
+        k = self._proj(params, x, "wk")
+        v = self._proj(params, x, "wv")
+        o = self._attend(q, k, v)
+        o = o.transpose(0, 2, 1, 3).reshape(b, t, d)
+        return jnp.dot(o.astype(cd), params["wo"].astype(cd)), (k, v)
+
+    def apply_decode(self, params, x1, cache, pos):
+        """One decode step: ``x1`` is the CURRENT token's activation
+        ``[B, 1, D]`` at position ``pos``; the projected K/V are written
+        into the cache at ``pos`` and the query attends to positions
+        ``≤ pos`` only.  Returns ``(y [B, 1, D], new_cache)``."""
+        cd = self.compute_dtype
+        b, _, d = x1.shape
+        k_cache, v_cache = cache                      # [B, H, S, hd]
+        s = k_cache.shape[2]
+        q = self._proj(params, x1, "wq")              # [B, H, 1, hd]
+        k1 = self._proj(params, x1, "wk")
+        v1 = self._proj(params, x1, "wv")
+        k_cache = jax.lax.dynamic_update_slice(k_cache, k1, (0, 0, pos, 0))
+        v_cache = jax.lax.dynamic_update_slice(v_cache, v1, (0, 0, pos, 0))
+        hd = self.dim // self.n_head
+        scores = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
+                            k_cache.astype(jnp.float32)) / (hd ** 0.5)
+        mask = jnp.arange(s) <= pos                    # causal over cache
+        scores = jnp.where(mask[None, None, None], scores, -1e30)
+        p = jax.nn.softmax(scores, axis=-1)
+        o = jnp.einsum("bhqk,bhkd->bhqd", p,
+                       v_cache.astype(jnp.float32)).astype(x1.dtype)
+        o = o.transpose(0, 2, 1, 3).reshape(b, 1, d)
+        y = jnp.dot(o.astype(cd), params["wo"].astype(cd))
+        return y, (k_cache, v_cache)
 
 
 class Flatten(Layer):

@@ -129,10 +129,25 @@ class Block(L.Layer):
     def apply(self, params, x, *, train=False, rng=None, state=None):
         h = self.ln1.apply(params["ln1"], x)
         x = x + self.attn.apply(params["attn"], h, train=train)
-        h = self.ln2.apply(params["ln2"], x)
+        return x + self._mlp(params, self.ln2.apply(params["ln2"], x))
+
+    def _mlp(self, params, h):
         h = self.fc1.apply(params["fc1"], h)
-        h = self.fc2.apply(params["fc2"], h)
-        return x + h
+        return self.fc2.apply(params["fc2"], h)
+
+    def apply_prefill(self, params, x):
+        """Forward + the attention K/V cache (dense decode path)."""
+        h = self.ln1.apply(params["ln1"], x)
+        a, cache = self.attn.apply_prefill(params["attn"], h)
+        x = x + a
+        return x + self._mlp(params, self.ln2.apply(params["ln2"], x)), cache
+
+    def apply_decode(self, params, x1, cache, pos):
+        h = self.ln1.apply(params["ln1"], x1)
+        a, cache = self.attn.apply_decode(params["attn"], h, cache, pos)
+        x1 = x1 + a
+        return (x1 + self._mlp(params, self.ln2.apply(params["ln2"], x1)),
+                cache)
 
 
 class MoEBlock(Block):
@@ -173,6 +188,19 @@ class MoEBlock(Block):
         h = self.ln2.apply(params["ln2"], x)
         y, aux = self.moe.apply(params["moe"], h, train=train)
         return x + y, aux
+
+    # Block's decode methods reach through self.fc1/fc2, which this class
+    # deletes — surface a clear error instead of an AttributeError if a
+    # caller gates on hasattr(blk, 'apply_prefill')
+    def apply_prefill(self, params, x):
+        raise NotImplementedError(
+            "MoE blocks have no KV-decode path yet; generate() falls back "
+            "to the full-forward sampler")
+
+    def apply_decode(self, params, x1, cache, pos):
+        raise NotImplementedError(
+            "MoE blocks have no KV-decode path yet; generate() falls back "
+            "to the full-forward sampler")
 
 
 class TransformerLM(ModelBase):
@@ -368,18 +396,19 @@ class TransformerLM(ModelBase):
     # -- inference ---------------------------------------------------------
 
     def generate(self, prompt, max_new_tokens: int, temperature: float = 0.0,
-                 seed: int = 0):
+                 seed: int = 0, kv_cache: bool = True):
         """Sample continuations — greedy (``temperature=0``) or categorical.
 
         One jit-compiled ``lax.scan`` over decode steps on a fixed
-        ``[B, seq_len]`` token buffer (static shapes; causal masking makes
-        the not-yet-written tail irrelevant), running the FULL forward per
-        step — the right trade below ``seq_len`` caps like these; a KV cache
-        is the next lever for long generations.  Uses the canonical params
-        (EASGD center / GoSGD consensus / BSP replica 0) gathered to one
-        device, so it works after training under any rule; model-parallel
-        layouts (tp/pp/sp) gather to a dense run the same way but are not
-        wired yet.
+        ``[B, seq_len]`` token buffer (static shapes).  ``kv_cache=True``
+        (default, plain Block stacks): prefill the prompt once, then each
+        step projects only the new token and attends to the cached K/V —
+        O(T) per token instead of the full O(T²) forward.  The fallback
+        full-forward path remains for stacks without a decode method (MoE).
+        Uses the canonical params (EASGD center / GoSGD consensus / BSP
+        replica 0) gathered to one device, so it works after training under
+        any rule; model-parallel layouts (tp/pp/sp) gather to a dense run
+        the same way but are not wired yet.
         """
         assert self.tp == 1 and self.pp == 1 and self.sp == 1, (
             "generate() runs the gathered params densely; model-parallel "
@@ -391,6 +420,7 @@ class TransformerLM(ModelBase):
             prompt = prompt[None]
         b, p_len = prompt.shape
         assert p_len >= 1, "generate() needs at least one prompt token"
+        assert max_new_tokens >= 1, "generate() needs max_new_tokens >= 1"
         assert p_len + max_new_tokens <= self.seq_len, (
             f"prompt {p_len} + {max_new_tokens} new tokens exceeds "
             f"seq_len={self.seq_len} (the position-embedding table)")
@@ -399,15 +429,19 @@ class TransformerLM(ModelBase):
         toks0 = np.zeros((b, self.seq_len), np.int32)
         toks0[:, :p_len] = prompt
 
+        use_kv = kv_cache and all(type(b) is Block for b in self.blocks)
         if getattr(self, "_gen_jit", None) is None:
-            # bound method + static max_new: jit's own cache memoizes per
+            # bound methods + static max_new: jit's own cache memoizes per
             # length, one sampler object per model instance
             self._gen_jit = jax.jit(self._gen_body,
                                     static_argnames=("max_new",))
-        toks, new = self._gen_jit(params, jnp.asarray(toks0),
-                                  jnp.int32(p_len), jax.random.key(seed),
-                                  jnp.float32(temperature),
-                                  max_new=int(max_new_tokens))
+            self._gen_jit_kv = jax.jit(self._gen_body_kv,
+                                       static_argnames=("max_new",))
+        fn = self._gen_jit_kv if use_kv else self._gen_jit
+        toks, new = fn(params, jnp.asarray(toks0),
+                       jnp.int32(p_len), jax.random.key(seed),
+                       jnp.float32(temperature),
+                       max_new=int(max_new_tokens))
         return np.asarray(jax.device_get(new))
 
     def _gen_body(self, params, toks, start_pos, key, temp, *, max_new):
@@ -417,18 +451,63 @@ class TransformerLM(ModelBase):
                                          rng=None, state={})
             row = jax.lax.dynamic_index_in_dim(
                 logits, pos - 1, axis=1, keepdims=False)       # [B, V]
-            key, sub = jax.random.split(key)
-            greedy = jnp.argmax(row, axis=-1).astype(jnp.int32)
-            sampled = jax.random.categorical(
-                sub, row.astype(jnp.float32) /
-                jnp.maximum(temp, 1e-6)).astype(jnp.int32)
-            nxt = jnp.where(temp > 0, sampled, greedy)
+            nxt, key = self._next_token(row, key, temp)
             toks = jax.lax.dynamic_update_slice(toks, nxt[:, None], (0, pos))
             return (toks, pos + 1, key), nxt
 
         (toks, _, _), out = jax.lax.scan(body, (toks, start_pos, key), None,
                                          length=max_new)
         return toks, out.T              # [B, max_new]
+
+    def _next_token(self, row, key, temp):
+        """Greedy/categorical selection from one [B, V] logit row."""
+        key, sub = jax.random.split(key)
+        greedy = jnp.argmax(row, axis=-1).astype(jnp.int32)
+        sampled = jax.random.categorical(
+            sub, row.astype(jnp.float32) /
+            jnp.maximum(temp, 1e-6)).astype(jnp.int32)
+        return jnp.where(temp > 0, sampled, greedy), key
+
+    def _gen_body_kv(self, params, toks, start_pos, key, temp, *, max_new):
+        """KV-cache sampler: one prefill forward builds the per-layer K/V
+        caches, then each decode step projects only the new token."""
+        t = toks.shape[1]
+        h = self.embed.apply(params["embed"], toks) + \
+            self.pos.apply(params["pos"], jnp.arange(t))[None]
+        caches = []
+        for blk in self.blocks:
+            h, cache = blk.apply_prefill(params[blk.name], h)
+            caches.append(cache)
+        # only the row at start_pos-1 is consumed — index BEFORE the [D, V]
+        # head projection so prefill doesn't pay a full-buffer head matmul
+        h_row = jax.lax.dynamic_index_in_dim(h, start_pos - 1, axis=1)
+        row0 = self.head.apply(params["head"],
+                               self.ln_f.apply(params["ln_f"], h_row))[:, 0]
+        nxt0, key = self._next_token(row0, key, temp)
+        toks = jax.lax.dynamic_update_slice(toks, nxt0[:, None],
+                                            (0, start_pos))
+
+        def body(carry, _):
+            toks, pos, key, caches, tok = carry
+            x1 = self.embed.apply(params["embed"], tok[:, None]) + \
+                self.pos.apply(params["pos"], pos[None])[None]
+            new_caches = []
+            for blk, cache in zip(self.blocks, caches):
+                x1, cache = blk.apply_decode(params[blk.name], x1, cache,
+                                             pos)
+                new_caches.append(cache)
+            x1 = self.ln_f.apply(params["ln_f"], x1)
+            row = self.head.apply(params["head"], x1)[:, 0]
+            nxt, key = self._next_token(row, key, temp)
+            toks = jax.lax.dynamic_update_slice(toks, nxt[:, None],
+                                                (0, pos + 1))
+            return (toks, pos + 1, key, tuple(new_caches), nxt), nxt
+
+        (toks, _, _, _, _), rest = jax.lax.scan(
+            body, (toks, start_pos, key, tuple(caches), nxt0), None,
+            length=max_new - 1)
+        out = jnp.concatenate([nxt0[:, None], rest.T], axis=1)
+        return toks, out                # [B, max_new]
 
 
 class MoETransformerLM(TransformerLM):
