@@ -517,11 +517,12 @@ class MultiHeadAttention(Layer):
         v1 = self._proj(params, x1, "wv")
         k_cache = jax.lax.dynamic_update_slice(k_cache, k1, (0, 0, pos, 0))
         v_cache = jax.lax.dynamic_update_slice(v_cache, v1, (0, 0, pos, 0))
+        from ..ops.ring_attention import NEG_INF
         hd = self.dim // self.n_head
         scores = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
                             k_cache.astype(jnp.float32)) / (hd ** 0.5)
         mask = jnp.arange(s) <= pos                    # causal over cache
-        scores = jnp.where(mask[None, None, None], scores, -1e30)
+        scores = jnp.where(mask[None, None, None], scores, NEG_INF)
         p = jax.nn.softmax(scores, axis=-1)
         o = jnp.einsum("bhqk,bhkd->bhqd", p,
                        v_cache.astype(jnp.float32)).astype(x1.dtype)

@@ -93,6 +93,16 @@ class ModelBase:
                                  weight_decay=self.weight_decay) \
             if self.optimizer in ("momentum", "nesterov") \
             else get_optimizer(self.optimizer, weight_decay=self.weight_decay)
+        if self.config.get("zero_opt", False):
+            # ZeRO-1 (parallel/zero.py): optimizer state sharded over the
+            # workers axis — per-chip optimizer memory /N, bit-equal updates
+            assert self.param_specs() is None, (
+                "zero_opt shards the flat optimizer state over 'workers'; "
+                "composing it with tensor/pipeline param specs is a later "
+                "round")
+            from ..parallel.zero import zero1
+            self.opt = zero1(self.opt, self.mesh.shape[WORKER_AXIS],
+                             self.params)
 
         self.step_state: Optional[Dict[str, Any]] = None
         self._state_specs = None
@@ -171,6 +181,20 @@ class ModelBase:
         here: jit the SPMD train/val steps and box the state onto the mesh."""
         from ..parallel.exchanger import BSP_Exchanger
         self.exchanger = exchanger or BSP_Exchanger(self.config)
+        if self.config.get("zero_opt", False):
+            # ZeRO-1 assumes every worker sees the SAME reduced gradient and
+            # holds identical params — true only under BSP grads mode with a
+            # real collective; params mode / the 'none' strategy would slice
+            # UN-reduced per-worker grads and train silently wrong, and
+            # async rules' workers would never update chunks other ranks own
+            assert (isinstance(self.exchanger, BSP_Exchanger)
+                    and self.exchanger.mode == "grads"
+                    and self.exchanger.strategy.name != "none"), (
+                "zero_opt requires BSP grads mode with a gradient "
+                "collective (identical grads across workers); got "
+                f"{type(self.exchanger).__name__} mode="
+                f"{getattr(self.exchanger, 'mode', '?')} strategy="
+                f"{getattr(getattr(self.exchanger, 'strategy', None), 'name', '?')}")
         self.exchanger.prepare(self.mesh, self)
         n = self.mesh.shape[WORKER_AXIS]
 

@@ -72,6 +72,7 @@ class Block(L.Layer):
     ``tests/test_tp.py``), two psums per block."""
 
     has_state = False
+    supports_kv_decode = True     # apply_prefill/apply_decode work (dense)
 
     def __init__(self, dim, n_head, mlp_ratio=4, cd=jnp.bfloat16, tp=1,
                  sp=1, attn_impl="reference", name="block"):
@@ -190,17 +191,9 @@ class MoEBlock(Block):
         return x + y, aux
 
     # Block's decode methods reach through self.fc1/fc2, which this class
-    # deletes — surface a clear error instead of an AttributeError if a
-    # caller gates on hasattr(blk, 'apply_prefill')
-    def apply_prefill(self, params, x):
-        raise NotImplementedError(
-            "MoE blocks have no KV-decode path yet; generate() falls back "
-            "to the full-forward sampler")
-
-    def apply_decode(self, params, x1, cache, pos):
-        raise NotImplementedError(
-            "MoE blocks have no KV-decode path yet; generate() falls back "
-            "to the full-forward sampler")
+    # deletes — the capability flag routes generate() to the full-forward
+    # sampler instead
+    supports_kv_decode = False
 
 
 class TransformerLM(ModelBase):
@@ -429,7 +422,8 @@ class TransformerLM(ModelBase):
         toks0 = np.zeros((b, self.seq_len), np.int32)
         toks0[:, :p_len] = prompt
 
-        use_kv = kv_cache and all(type(b) is Block for b in self.blocks)
+        use_kv = kv_cache and all(
+            getattr(b, "supports_kv_decode", False) for b in self.blocks)
         if getattr(self, "_gen_jit", None) is None:
             # bound methods + static max_new: jit's own cache memoizes per
             # length, one sampler object per model instance

@@ -158,9 +158,12 @@ class BSP_Exchanger(Exchanger):
         # grads mode: every worker applies the same reduced gradient; params
         # mode keeps per-worker momentum; stateful strategies carry
         # per-worker error feedback; the measurement-only 'none' strategy
-        # skips the collective entirely — all of those break replica identity.
+        # skips the collective entirely; ZeRO-1 deliberately shards the
+        # optimizer state per worker — all of those break replica identity
+        # (for checkpoint dedup purposes).
         return (self.mode == "grads" and not self.strategy.stateful
-                and self.strategy.name != "none")
+                and self.strategy.name != "none"
+                and not self.config.get("zero_opt", False))
 
     def extra_specs(self, param_specs):
         if self.strategy.stateful:
