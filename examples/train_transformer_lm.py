@@ -1,10 +1,12 @@
 #!/usr/bin/env python
 """Causal transformer LM under BSP — the beyond-parity sequence model.
 
-Runs on the synthetic next-token stream with zero data setup (swap in a
-real token dataset by subclassing ``transformer_lm.LMData``).  The
-sequence-SHARDED long-context path is ``ops/ring_attention.py`` on a 2-D
-data×seq mesh; this session trains data-parallel like any zoo model.
+Runs on the synthetic next-token stream with zero data setup; pass
+``data_dir=/path/to/corpus`` holding nanoGPT-style ``train.bin``/``val.bin``
+flat token files (``token_dtype`` defaults to uint16) to train on a real,
+memory-mapped corpus (``models/data/tokens.py``).  The sequence-SHARDED
+long-context path is ``ops/ring_attention.py`` on a 2-D data×seq mesh;
+this session trains data-parallel like any zoo model.
 """
 
 from _common import setup, n_devices

@@ -67,6 +67,17 @@ def test_zero1_checkpoint_roundtrip(tmp_path, mesh4):
     model, _ = _make_tiny(True, mesh4, optimizer="momentum")
     _train(model, BSP_Exchanger(model.config), 3)
     model.save(str(tmp_path), epoch=0, count=3)
+    # per-part dedup: params (bit-identical replicas) stored ONCE, only the
+    # genuinely per-worker ZeRO chunks stored boxed
+    import json, os
+    with open(os.path.join(str(tmp_path), "ckpt_epoch0.json")) as f:
+        meta = json.load(f)
+    assert meta["boxed_parts"] == ["opt_state"], meta
+    import numpy as np_
+    data = np_.load(os.path.join(str(tmp_path), "ckpt_epoch0.npz"))
+    p_leaf = data["params__0"]
+    unboxed = steps.unbox(jax.device_get(model.step_state["params"]))
+    assert p_leaf.shape == jax.tree.leaves(unboxed)[0].shape
     before = jax.device_get(steps.tree_to_host(model.step_state["opt_state"]))
     m2, _ = _make_tiny(True, mesh4, optimizer="momentum")
     m2.compile_iter_fns(BSP_Exchanger(m2.config))

@@ -1,0 +1,78 @@
+"""Token-file dataset for the transformer family.
+
+Real-data counterpart of ``transformer_lm.LMData``'s synthetic stream, in
+the de-facto standard flat-token-file format (nanoGPT's ``train.bin`` /
+``val.bin``: one raw little-endian token array per split; ``.npy`` accepted
+too).  The files are memory-mapped — nothing is loaded until a batch
+gathers its windows, so corpora far larger than RAM stream fine.
+
+Integration is pure :class:`..DataBase`: a "sample" is a NON-OVERLAPPING
+``seq_len+1`` token window, represented as a window id in the base class's
+index arrays — the common-seed shuffle, multi-host contiguous sub-blocks,
+and the exact-resume cursor all apply unchanged (reference semantics,
+SURVEY.md §2.8); only ``_make_batch`` turns ids into gathered token
+windows (one fancy-indexed mmap read, next-token targets shifted by one).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import numpy as np
+
+from . import DataBase
+
+
+def _load_tokens(path_base: str, dtype) -> np.ndarray:
+    """Memory-map ``<base>.bin`` (raw) or ``<base>.npy`` / ``<base>_tokens.npy``."""
+    for p, loader in ((path_base + ".bin",
+                       lambda p: np.memmap(p, dtype=dtype, mode="r")),
+                      (path_base + ".npy",
+                       lambda p: np.load(p, mmap_mode="r")),
+                      (path_base + "_tokens.npy",
+                       lambda p: np.load(p, mmap_mode="r"))):
+        if os.path.exists(p):
+            return loader(p)
+    raise FileNotFoundError(
+        f"no token file at {path_base}.bin/.npy/_tokens.npy")
+
+
+class TokenFileData(DataBase):
+    """``data_dir/train.bin`` + ``data_dir/val.bin`` next-token dataset."""
+
+    def __init__(self, config: Optional[dict] = None, batch_size: int = 16,
+                 seq_len: int = 64):
+        super().__init__(config, batch_size)
+        self.seq_len = int(self.config.get("seq_len", seq_len))
+        data_dir = self.config["data_dir"]
+        dtype = np.dtype(self.config.get("token_dtype", "uint16"))
+        self._toks = {
+            True: _load_tokens(os.path.join(data_dir, "train"), dtype),
+            False: _load_tokens(os.path.join(data_dir, "val"), dtype),
+        }
+
+        def n_windows(split):
+            return max(0, (len(self._toks[split]) - 1) // self.seq_len)
+
+        # DataBase's index arrays hold WINDOW IDS; _make_batch gathers them
+        self.x_train = self.y_train = np.arange(n_windows(True))
+        self.x_val = self.y_val = np.arange(n_windows(False))
+        self._finalize()
+
+    def _make_batch(self, ids, _ids, train: bool):
+        toks = self._toks[train]
+        starts = np.asarray(ids, dtype=np.int64) * self.seq_len
+        seq = np.asarray(
+            toks[starts[:, None] + np.arange(self.seq_len + 1)],
+            dtype=np.int32)
+        vocab = self.config.get("vocab")
+        if vocab is not None:
+            # jit-side embedding gathers CLAMP out-of-range ids — a corpus
+            # tokenized with a larger vocabulary would train silently wrong
+            mx = int(seq.max())
+            assert mx < int(vocab), (
+                f"token id {mx} >= vocab={vocab} — the corpus was tokenized "
+                f"with a larger vocabulary than the model's")
+        return {"x": np.ascontiguousarray(seq[:, :-1]),
+                "y": np.ascontiguousarray(seq[:, 1:])}

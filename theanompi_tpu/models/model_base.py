@@ -392,11 +392,14 @@ class ModelBase:
                 self.exchanger.canonical_params(state))
         else:
             params_npy = steps.unbox(state["params"])
-        if getattr(self.exchanger, "replicas_identical", False):
-            # BSP grads-mode replicas are bit-identical — persist ONE replica
-            # instead of n copies (an 8-chip VGG-16 checkpoint shrinks 8×);
-            # load() re-replicates from the meta flag.
-            state = {k: steps.unbox(v) for k, v in state.items()}
+        # PER-PART dedup: bit-identical parts persist ONE replica instead of
+        # n (an 8-chip VGG-16 checkpoint shrinks 8×); parts that genuinely
+        # differ per worker (async replicas, EF buffers, ZeRO optimizer
+        # chunks) stay boxed.  load() re-shapes from the meta list.
+        ident = set(getattr(self.exchanger, "identical_parts", tuple)())
+        state = {k: (steps.unbox(v) if k in ident else v)
+                 for k, v in state.items()}
+        if "params" in ident:
             params_npy = state["params"]
         cursor = self.data.get_cursor() \
             if hasattr(self.data, "get_cursor") else None
@@ -408,8 +411,8 @@ class ModelBase:
         kwargs = dict(
             rng_keys={"step": self._step_rng, "exch": self._exch_key},
             cursor=cursor, params_npy=params_npy,
-            extra_meta={"boxed": not getattr(self.exchanger,
-                                             "replicas_identical", False)})
+            extra_meta={"boxed_parts": sorted(k for k in state
+                                              if k not in ident)})
         if self.config.get("async_ckpt", False):
             # the device→host gather above is the only part that must block
             # the training loop; the disk write runs on a background thread
@@ -454,15 +457,19 @@ class ModelBase:
             shape = x.shape if boxed else x.shape[1:]
             return jax.ShapeDtypeStruct(shape, x.dtype)
 
-        # peek at the meta to learn the stored layout (boxed per-worker state
-        # vs one BSP replica) before shaping the template
+        # peek at the meta to learn the stored layout (which parts are boxed
+        # per-worker state vs one dedup'd replica) before shaping templates
         peek = ckpt_lib.peek_meta(ckpt_dir, epoch)
         if peek is None:
             return None
-        # legacy checkpoints (no 'boxed' flag) were always saved unboxed
-        boxed = bool(peek.get("boxed", False))
+        if "boxed_parts" in peek:
+            boxed_parts = set(peek["boxed_parts"])
+        elif peek.get("boxed", False):      # older all-or-nothing flag
+            boxed_parts = set(self.step_state)
+        else:                               # legacy: always saved unboxed
+            boxed_parts = set()
         template = {
-            k: jax.tree.map(lambda x: shape_of(x, boxed), v)
+            k: jax.tree.map(lambda x: shape_of(x, k in boxed_parts), v)
             for k, v in self.step_state.items()}
         restored = ckpt_lib.load_checkpoint(ckpt_dir, template, epoch)
         if restored is None:
@@ -471,16 +478,13 @@ class ModelBase:
         rngs = restored.pop("_rng_keys", None)
         cursor = restored.pop("_cursor", None)
         sp = self._state_specs
-        if boxed:
-            self.step_state = {
-                k: steps.place_boxed(v, self.mesh,
-                                     None if sp is None else sp[k])
-                for k, v in restored.items()}
-        else:
-            self.step_state = {
-                k: steps.replicate_tree(v, n, self.mesh,
-                                        None if sp is None else sp[k])
-                for k, v in restored.items()}
+        self.step_state = {
+            k: (steps.place_boxed(v, self.mesh,
+                                  None if sp is None else sp[k])
+                if k in boxed_parts else
+                steps.replicate_tree(v, n, self.mesh,
+                                     None if sp is None else sp[k]))
+            for k, v in restored.items()}
         if rngs:
             self._step_rng = rngs.get("step", self._step_rng)
             self._exch_key = rngs.get("exch", self._exch_key)

@@ -192,8 +192,19 @@ class MoEBlock(Block):
 
     # Block's decode methods reach through self.fc1/fc2, which this class
     # deletes — the capability flag routes generate() to the full-forward
-    # sampler instead
+    # sampler, and the overrides keep any direct caller from hitting a raw
+    # AttributeError
     supports_kv_decode = False
+
+    def apply_prefill(self, params, x):
+        raise NotImplementedError(
+            "MoE blocks have no KV-decode path yet (supports_kv_decode is "
+            "False); generate() falls back to the full-forward sampler")
+
+    def apply_decode(self, params, x1, cache, pos):
+        raise NotImplementedError(
+            "MoE blocks have no KV-decode path yet (supports_kv_decode is "
+            "False); generate() falls back to the full-forward sampler")
 
 
 class TransformerLM(ModelBase):
@@ -269,7 +280,13 @@ class TransformerLM(ModelBase):
         # directly on the sharded logits (vocab-parallel cross-entropy)
         self.head = L.FC(self.d_model, self.vocab, w_init=("normal", 0.02),
                          activation=None, compute_dtype=cd, name="head")
-        self.data = LMData(self.config, self.batch_size)
+        if self.config.get("data_dir"):
+            # real corpus: nanoGPT-style flat token files, memory-mapped
+            from .data.tokens import TokenFileData
+            self.data = TokenFileData(self.config, self.batch_size,
+                                      self.seq_len)
+        else:
+            self.data = LMData(self.config, self.batch_size)
 
     def param_specs(self):
         from jax.sharding import PartitionSpec as P

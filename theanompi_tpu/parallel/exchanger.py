@@ -70,6 +70,12 @@ class Exchanger:
     # a stateless strategy; never async rules or per-worker EF state.
     replicas_identical = False
 
+    def identical_parts(self):
+        """State parts bit-identical across workers — checkpoint dedup is
+        PER PART (e.g. ZeRO-1 shards only the optimizer state, so params
+        still dedup to one replica on disk)."""
+        return ()
+
     def __init__(self, config: Optional[dict] = None):
         self.config = dict(config or {})
         self.exchange_freq = 1
@@ -164,6 +170,15 @@ class BSP_Exchanger(Exchanger):
         return (self.mode == "grads" and not self.strategy.stateful
                 and self.strategy.name != "none"
                 and not self.config.get("zero_opt", False))
+
+    def identical_parts(self):
+        if not (self.mode == "grads" and not self.strategy.stateful
+                and self.strategy.name != "none"):
+            return ()
+        parts = {"params", "opt_state", "bn_state", "extra"}
+        if self.config.get("zero_opt", False):
+            parts.discard("opt_state")    # the ZeRO partition differs/worker
+        return tuple(sorted(parts))
 
     def extra_specs(self, param_specs):
         if self.strategy.stateful:

@@ -27,9 +27,7 @@ update math on disjoint chunks; no reduction-order change) and is pinned in
 
 from __future__ import annotations
 
-import jax
 import jax.numpy as jnp
-import numpy as np
 from jax import lax
 
 from ..utils import helper_funcs
