@@ -1,0 +1,57 @@
+#!/usr/bin/env python
+"""Text → flat token files for the LM family (models/data/tokens.py).
+
+Byte-level tokenization (vocab 256, zero dependencies — the fallback
+GPT-2-style byte alphabet): reads one or more text files, concatenates,
+splits train/val, and writes ``train.bin`` / ``val.bin`` as raw uint16
+arrays — the nanoGPT format ``TokenFileData`` memory-maps.
+
+Usage:
+  python scripts/make_token_dataset.py corpus.txt [more.txt ...] \
+      --out data/mycorpus [--val-frac 0.05]
+
+Then train with:
+  rule.init(..., modelfile='theanompi_tpu.models.transformer_lm',
+            modelclass='TransformerLM', data_dir='data/mycorpus', vocab=256)
+
+For BPE corpora, tokenize externally and drop the id arrays in the same
+``train.bin``/``val.bin`` shape (uint16 for vocab ≤ 65536) — set
+``token_dtype``/``vocab`` accordingly.
+"""
+
+import argparse
+import os
+import sys
+
+import numpy as np
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("inputs", nargs="+", help="text files (utf-8/binary)")
+    p.add_argument("--out", required=True, help="output directory")
+    p.add_argument("--val-frac", type=float, default=0.05)
+    args = p.parse_args(argv)
+    if not (0.0 <= args.val_frac < 1.0):
+        p.error(f"--val-frac must be in [0, 1); got {args.val_frac} "
+                f"(>= 1 would leave an empty train split)")
+
+    chunks = []
+    for path in args.inputs:
+        with open(path, "rb") as f:
+            chunks.append(np.frombuffer(f.read(), dtype=np.uint8))
+    toks = np.concatenate(chunks).astype(np.uint16)
+    if len(toks) < 2:
+        print(f"corpus too small ({len(toks)} bytes)", file=sys.stderr)
+        return 2
+    n_val = max(1, int(len(toks) * args.val_frac))
+    os.makedirs(args.out, exist_ok=True)
+    toks[:-n_val].tofile(os.path.join(args.out, "train.bin"))
+    toks[-n_val:].tofile(os.path.join(args.out, "val.bin"))
+    print(f"{len(toks) - n_val} train + {n_val} val byte-tokens "
+          f"(vocab 256) -> {args.out}/train.bin, val.bin")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

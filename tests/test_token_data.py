@@ -87,6 +87,29 @@ def test_lm_trains_and_generates_from_token_files(tmp_path, mesh8):
     model.end_val()
 
 
+def test_make_token_dataset_script(tmp_path):
+    """Text → byte-token files → loadable by TokenFileData."""
+    import os
+    import subprocess
+    import sys
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    txt = tmp_path / "corpus.txt"
+    txt.write_text("hello token world! " * 400)
+    out = tmp_path / "toks"
+    r = subprocess.run(
+        [sys.executable, os.path.join(repo, "scripts/make_token_dataset.py"),
+         str(txt), "--out", str(out), "--val-frac", "0.1"],
+        capture_output=True, text=True)
+    assert r.returncode == 0, r.stderr
+    data = TokenFileData({"size": 2, "data_dir": str(out), "seq_len": 16,
+                          "vocab": 256}, batch_size=4)
+    b = data.next_train_batch(0)
+    assert b["x"].shape == (8, 16)
+    # byte-level: tokens are the utf-8 bytes of the corpus
+    assert bytes(b["x"][0].astype(np.uint8)).decode() in \
+        "hello token world! " * 3
+
+
 def test_missing_files_error(tmp_path):
     (tmp_path / "empty").mkdir()
     with pytest.raises(FileNotFoundError, match="token file"):

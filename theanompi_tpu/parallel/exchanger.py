@@ -50,6 +50,17 @@ from .mesh import WORKER_AXIS
 from .strategies import Strategy, get_strategy
 
 
+def _spec_axes(s):
+    """Mesh axes named anywhere in one PartitionSpec (tuple entries too)."""
+    out = set()
+    for e in (s or ()):
+        if isinstance(e, (tuple, list)):
+            out.update(e)
+        elif e is not None:
+            out.add(e)
+    return out
+
+
 class Exchanger:
     """Base exchanger.
 
@@ -75,6 +86,11 @@ class Exchanger:
         PER PART (e.g. ZeRO-1 shards only the optimizer state, so params
         still dedup to one replica on disk)."""
         return ()
+
+    def _group_axes(self):
+        """Non-worker mesh axes (model/pipe) — under model parallelism each
+        device along these axes holds a DIFFERENT local shard."""
+        return tuple(a for a in self.mesh.axis_names if a != WORKER_AXIS)
 
     def __init__(self, config: Optional[dict] = None):
         self.config = dict(config or {})
@@ -107,12 +123,46 @@ class Exchanger:
 
     # -- in-step (traced) --------------------------------------------------
 
+    def _clip_grads(self, grads):
+        """Global-L2-norm gradient clipping (config ``grad_clip``, off by
+        default — the reference predates it; modern LM training expects it).
+        Applied to the gradients the optimizer actually consumes: the
+        REDUCED gradient under BSP, the local gradient under async rules.
+
+        Under model parallelism the TRUE global norm needs each sharded
+        leaf's squared sum ``psum``'d over the axes it is sharded on (and
+        replicated leaves counted once) — every rank then clips by the same
+        scale, keeping cross-rank replication intact."""
+        clip = float(self.config.get("grad_clip", 0.0) or 0.0)
+        if clip <= 0.0:
+            return grads
+        pspecs = self.model.param_specs()
+        group = self._group_axes()
+
+        def leaf_sq(g, spec=None):
+            v = jnp.sum(jnp.square(g.astype(jnp.float32)))
+            if spec is not None:
+                axes = tuple(a for a in _spec_axes(spec) if a in group)
+                if axes:
+                    v = lax.psum(v, axes)
+            return v
+
+        if pspecs is None or not group:
+            sq = sum(leaf_sq(g) for g in jax.tree.leaves(grads))
+        else:
+            sq = sum(jax.tree.leaves(
+                jax.tree.map(leaf_sq, grads, pspecs)))
+        norm = jnp.sqrt(sq)
+        scale = jnp.minimum(1.0, clip / jnp.maximum(norm, 1e-12))
+        return jax.tree.map(lambda g: (g * scale).astype(g.dtype), grads)
+
     def step_update(self, params, opt_state, grads, extra, lr, *, axis, size,
                     count):
         """Default: purely local optimizer step (async rules train locally
         between exchanges)."""
         opt = self.model.opt
-        params, opt_state = opt.update(grads, opt_state, params, lr)
+        params, opt_state = opt.update(self._clip_grads(grads), opt_state,
+                                       params, lr)
         return params, opt_state, extra
 
     def sync_bn(self, bn_state, *, axis, size):
@@ -212,11 +262,6 @@ class BSP_Exchanger(Exchanger):
                                out_specs=state_spec)
             self._exchange_fn = jax.jit(sm, donate_argnums=(0,))
 
-    def _group_axes(self):
-        """Non-worker mesh axes (model/pipe) — under model parallelism each
-        device along these axes compresses a DIFFERENT local grad shard."""
-        return tuple(a for a in self.mesh.axis_names if a != WORKER_AXIS)
-
     def extra_state_template(self) -> Dict[str, Any]:
         if self.strategy.stateful:
             pspecs = self.model.param_specs()
@@ -244,7 +289,8 @@ class BSP_Exchanger(Exchanger):
                 extra = dict(extra, strat=strat_state)
             grads = self._restore_replication(grads)
         opt = self.model.opt
-        params, opt_state = opt.update(grads, opt_state, params, lr)
+        params, opt_state = opt.update(self._clip_grads(grads), opt_state,
+                                       params, lr)
         return params, opt_state, extra
 
     def _restore_replication(self, grads):
@@ -259,17 +305,8 @@ class BSP_Exchanger(Exchanger):
         if pspecs is None or not group or not self.strategy.flattens:
             return grads
 
-        def sharded_axes(s):
-            out = set()
-            for e in (s or ()):
-                if isinstance(e, (tuple, list)):
-                    out.update(e)
-                elif e is not None:
-                    out.add(e)
-            return out
-
         def fix(g, s):
-            missing = tuple(a for a in group if a not in sharded_axes(s))
+            missing = tuple(a for a in group if a not in _spec_axes(s))
             return lax.pmean(g, missing) if missing else g
 
         return jax.tree.map(fix, grads, pspecs)
