@@ -44,10 +44,10 @@ def main(argv=None) -> int:
     if len(toks) < 2:
         print(f"corpus too small ({len(toks)} bytes)", file=sys.stderr)
         return 2
-    n_val = max(1, int(len(toks) * args.val_frac))
+    n_val = int(len(toks) * args.val_frac)
     os.makedirs(args.out, exist_ok=True)
-    toks[:-n_val].tofile(os.path.join(args.out, "train.bin"))
-    toks[-n_val:].tofile(os.path.join(args.out, "val.bin"))
+    toks[:len(toks) - n_val].tofile(os.path.join(args.out, "train.bin"))
+    toks[len(toks) - n_val:].tofile(os.path.join(args.out, "val.bin"))
     print(f"{len(toks) - n_val} train + {n_val} val byte-tokens "
           f"(vocab 256) -> {args.out}/train.bin, val.bin")
     return 0

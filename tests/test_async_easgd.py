@@ -63,6 +63,25 @@ def test_easgd_rule_async_mode():
     assert all(i.error is None for i in tr.islands)
 
 
+def test_async_easgd_drives_the_transformer():
+    """The islands machinery is model-agnostic: the LM family trains under
+    genuinely asynchronous EASGD through the same session config."""
+    import jax.numpy as jnp
+    import theanompi_tpu as tmpi
+    rule = tmpi.EASGD()
+    rule.init(devices=4,
+              modelfile="theanompi_tpu.models.transformer_lm",
+              modelclass="TransformerLM",
+              easgd_mode="async", async_islands=2, sync_freq=2,
+              run_seconds=30.0, batch_size=8, seq_len=16, vocab=32,
+              d_model=32, n_head=4, n_layer=1, synthetic_train=64,
+              compute_dtype="float32", verbose=False)
+    tr = rule.wait()
+    assert tr.center.n_updates > 0
+    assert all(i.error is None for i in tr.islands)
+    assert all(i.steps_done > 0 for i in tr.islands)
+
+
 def test_center_update_algebra():
     """center += α·mean_i delta_i, serialized under the lock."""
     params = {"w": np.zeros((2,), np.float32)}

@@ -54,3 +54,30 @@ def test_nesterov_oracle():
 def test_unknown_optimizer_raises():
     with pytest.raises(ValueError, match="unknown optimizer"):
         get_optimizer("adamw")
+
+
+def test_cosine_lr_schedule():
+    """lr_schedule='cosine': base -> min_lr_frac*base over `epochs`, with
+    the step schedule untouched by default."""
+    import math
+    from tests.conftest import TinyModel
+    from theanompi_tpu.parallel.mesh import worker_mesh
+    mesh = worker_mesh(2)
+    m = TinyModel({"mesh": mesh, "size": 2, "rank": 0, "verbose": False,
+                   "lr_schedule": "cosine", "epochs": 10,
+                   "min_lr_frac": 0.1, "learning_rate": 1.0})
+    m.adjust_hyperp(0)
+    assert m.current_lr == 1.0
+    m.adjust_hyperp(5)
+    want_mid = 0.1 + 0.9 * 0.5 * (1 + math.cos(math.pi * 0.5))
+    assert abs(m.current_lr - want_mid) < 1e-9
+    m.adjust_hyperp(10)
+    assert abs(m.current_lr - 0.1) < 1e-9
+    # default remains the reference step schedule
+    m2 = TinyModel({"mesh": mesh, "size": 2, "rank": 0, "verbose": False,
+                    "learning_rate": 1.0})
+    m2.lr_adjust_epochs = (3,)
+    m2.adjust_hyperp(2)
+    assert m2.current_lr == 1.0
+    m2.adjust_hyperp(3)
+    assert m2.current_lr == pytest.approx(0.1)

@@ -329,18 +329,33 @@ class ModelBase:
     # -- contract: hyperparameters ----------------------------------------
 
     def adjust_hyperp(self, epoch: int) -> None:
-        """Step LR decay (÷10 at the epochs in ``lr_adjust_epochs``) — the
-        schedule style every reference zoo model used.
+        """LR schedule per epoch.  ``lr_schedule='step'`` (default): decay
+        ÷10 at the epochs in ``lr_adjust_epochs`` — the schedule style every
+        reference zoo model used.  ``'cosine'``: cosine decay from the base
+        LR to ``min_lr_frac``·base over ``epochs`` (the modern LM default).
 
         ``warmup_epochs`` (config, default 0 = reference behavior) ramps the
         LR-scale factor linearly over the first epochs: the reference's
         linear ``scale_lr(size)`` rule applied instantly, which at high
         worker counts diverges before the first decay (Goyal et al.'s
         gradual-warmup fix postdates it)."""
-        lr = float(self.learning_rate)
-        for e in self.lr_adjust_epochs:
-            if epoch >= e:
-                lr /= 10.0
+        base = float(self.learning_rate)
+        sched = str(self.config.get("lr_schedule", "step"))
+        if sched == "cosine":
+            import math
+            frac = float(self.config.get("min_lr_frac", 0.1))
+            total = max(1, int(self.config.get("epochs", self.epochs)))
+            t = min(epoch, total) / total
+            lr = base * (frac + (1.0 - frac) * 0.5
+                         * (1.0 + math.cos(math.pi * t)))
+        else:
+            if sched != "step":
+                raise ValueError(f"unknown lr_schedule {sched!r}; "
+                                 f"have 'step', 'cosine'")
+            lr = base
+            for e in self.lr_adjust_epochs:
+                if epoch >= e:
+                    lr /= 10.0
         scale = self._lr_scale
         warmup = int(self.config.get("warmup_epochs", 0))
         if warmup > 0 and epoch < warmup and scale > 1.0:
