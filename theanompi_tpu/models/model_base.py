@@ -93,6 +93,17 @@ class ModelBase:
                                  weight_decay=self.weight_decay) \
             if self.optimizer in ("momentum", "nesterov") \
             else get_optimizer(self.optimizer, weight_decay=self.weight_decay)
+        if self.config.get("ema_decay"):
+            # EMA shadow params (utils/opt.py ema_wrap); validation and
+            # generate() read the shadow.  INSIDE the ZeRO wrapper below:
+            # under zero_opt the shadow then tracks each worker's parameter
+            # CHUNK — EMA memory shards with the optimizer state, and the
+            # full shadow is assembled only at read time.
+            assert self.param_specs() is None, (
+                "ema_decay with tensor/pipeline param specs is a later "
+                "round (the shadow changes the optimizer-state layout)")
+            from ..utils.opt import ema_wrap
+            self.opt = ema_wrap(self.opt, float(self.config["ema_decay"]))
         if self.config.get("zero_opt", False):
             # ZeRO-1 (parallel/zero.py): optimizer state sharded over the
             # workers axis — per-chip optimizer memory /N, bit-equal updates
@@ -181,16 +192,19 @@ class ModelBase:
         here: jit the SPMD train/val steps and box the state onto the mesh."""
         from ..parallel.exchanger import BSP_Exchanger
         self.exchanger = exchanger or BSP_Exchanger(self.config)
-        if self.config.get("zero_opt", False):
+        if self.config.get("zero_opt", False) or self.config.get("ema_decay"):
             # ZeRO-1 assumes every worker sees the SAME reduced gradient and
             # holds identical params — true only under BSP grads mode with a
             # real collective; params mode / the 'none' strategy would slice
-            # UN-reduced per-worker grads and train silently wrong, and
+            # UN-reduced per-worker grads and train silently wrong (and the
+            # EMA shadow would track per-worker divergent params), and
             # async rules' workers would never update chunks other ranks own
+            # (their canonical/center validation also never reads a shadow)
+            which = "zero_opt" if self.config.get("zero_opt") else "ema_decay"
             assert (isinstance(self.exchanger, BSP_Exchanger)
                     and self.exchanger.mode == "grads"
                     and self.exchanger.strategy.name != "none"), (
-                "zero_opt requires BSP grads mode with a gradient "
+                f"{which} requires BSP grads mode with a gradient "
                 "collective (identical grads across workers); got "
                 f"{type(self.exchanger).__name__} mode="
                 f"{getattr(self.exchanger, 'mode', '?')} strategy="
@@ -301,7 +315,14 @@ class ModelBase:
                                    bn)
             self._val_bn_boxed = steps.replicate_tree(bn_mean, n, self.mesh)
         else:
-            self._val_params_boxed = self.step_state["params"]
+            # BSP: validate the EMA shadow when enabled, else the replicas
+            if self.config.get("ema_decay"):
+                # _ema_host_params handles the sharded layout and the
+                # unseeded t==0 edge uniformly
+                self._val_params_boxed = steps.replicate_tree(
+                    self._ema_host_params(), n, self.mesh)
+            else:
+                self._val_params_boxed = self.step_state["params"]
             self._val_bn_boxed = self.step_state["bn_state"]
 
     def val_iter(self, count: int, recorder=None) -> None:
@@ -382,8 +403,30 @@ class ModelBase:
             state = {k: steps.tree_to_host(self.step_state[k])
                      for k in ("params", "extra")}
             return jax.device_get(self.exchanger.canonical_params(state))
+        if self.config.get("ema_decay"):
+            return self._ema_host_params()
         return steps.unbox(jax.device_get(
             steps.tree_to_host(self.step_state["params"])))
+
+    def _ema_host_params(self):
+        """The EMA shadow as an unboxed host pytree.  Plain EMA stores the
+        full tree; under zero_opt the shadow is SHARDED chunks, gathered and
+        unflattened here (read-time only).  Before the first update the
+        shadow is unseeded (zeros) — fall back to the live params."""
+        st = self.step_state["opt_state"]
+        inner = st if "ema" in st else st["opt"]
+        t = int(np.asarray(jax.device_get(
+            steps.tree_to_host(inner["t"])))[0])
+        if t == 0:
+            return steps.unbox(jax.device_get(
+                steps.tree_to_host(self.step_state["params"])))
+        if "ema" in st:
+            return steps.unbox(jax.device_get(
+                steps.tree_to_host(st["ema"])))
+        chunks = np.asarray(jax.device_get(
+            steps.tree_to_host(st["opt"]["ema"])))       # [N, chunk]
+        return jax.device_get(helper_funcs.unflatten_like(
+            self.params, jnp.asarray(chunks.reshape(-1))))
 
     def next_exchange_key(self):
         self._exch_key, sub = jax.random.split(self._exch_key)
@@ -405,6 +448,9 @@ class ModelBase:
             # non-addressable shards on multi-host
             params_npy = jax.device_get(
                 self.exchanger.canonical_params(state))
+        elif self.config.get("ema_decay"):
+            # the .npy snapshot holds what inference should use — the shadow
+            params_npy = self._ema_host_params()
         else:
             params_npy = steps.unbox(state["params"])
         # PER-PART dedup: bit-identical parts persist ONE replica instead of
@@ -414,8 +460,6 @@ class ModelBase:
         ident = set(getattr(self.exchanger, "identical_parts", tuple)())
         state = {k: (steps.unbox(v) if k in ident else v)
                  for k, v in state.items()}
-        if "params" in ident:
-            params_npy = state["params"]
         cursor = self.data.get_cursor() \
             if hasattr(self.data, "get_cursor") else None
         import os

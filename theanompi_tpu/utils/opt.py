@@ -146,6 +146,36 @@ OPTIMIZERS = {
 }
 
 
+def ema_wrap(opt: OptPair, decay: float) -> OptPair:
+    """Polyak/EMA parameter averaging as an optimizer wrapper (config
+    ``ema_decay``): a shadow copy tracks ``decay·ema + (1−decay)·params``
+    after every update; validation and inference read the shadow (smoother
+    late-training weights — the modern eval default the reference
+    predates).  The shadow initializes AT the params, so no zero-init bias
+    correction is needed."""
+    decay = float(decay)
+    assert 0.0 < decay < 1.0, f"ema_decay must be in (0, 1); got {decay}"
+
+    def init(params):
+        # the shadow can't be seeded with VALUES here: under zero_opt this
+        # init only sees a shape template (each worker's chunk differs, and
+        # the boxed replicate broadcasts one template to all workers) — the
+        # t==0 branch in update() seeds it from the live pre-update params
+        return {"inner": opt.init(params),
+                "ema": jax.tree.map(jnp.zeros_like, params),
+                "t": jnp.zeros((), jnp.int32)}
+
+    def update(grads, st, params, lr):
+        new_params, inner = opt.update(grads, st["inner"], params, lr)
+        prev = jax.tree.map(
+            lambda e, p: jnp.where(st["t"] == 0, p, e), st["ema"], params)
+        ema = jax.tree.map(lambda e, p: decay * e + (1.0 - decay) * p,
+                           prev, new_params)
+        return new_params, {"inner": inner, "ema": ema, "t": st["t"] + 1}
+
+    return OptPair(init, update)
+
+
 def opt_state_specs(name: str, param_specs):
     """PartitionSpecs for an optimizer's state given the params' per-leaf
     specs (tensor-parallel models, ``parallel/tp.py``): every momentum/second
