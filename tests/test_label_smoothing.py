@@ -1,0 +1,75 @@
+"""Label smoothing (config label_smoothing): exact mixture with the uniform
+term, pinned against a NumPy oracle — dense AND vocab-parallel heads."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from theanompi_tpu.models import layers as L
+
+
+def _oracle(logits, labels, eps):
+    logits = np.asarray(logits, np.float64)
+    logz = np.log(np.exp(logits).sum(-1))
+    logp = logits - logz[:, None]
+    n, v = logits.shape
+    target = np.full((n, v), eps / v)
+    target[np.arange(n), labels] += 1.0 - eps
+    return float(np.mean(-(target * logp).sum(-1)))
+
+
+def test_smoothing_matches_oracle():
+    r = np.random.RandomState(0)
+    logits = jnp.asarray(r.randn(16, 10).astype(np.float32) * 2)
+    labels = jnp.asarray(r.randint(0, 10, 16).astype(np.int32))
+    for eps in (0.0, 0.1, 0.3):
+        got = float(L.softmax_cross_entropy(logits, labels, eps))
+        assert got == pytest.approx(_oracle(logits, labels, eps), rel=1e-5)
+    # eps=0 reduces to plain NLL
+    assert float(L.softmax_cross_entropy(logits, labels, 0.0)) == \
+        pytest.approx(float(L.softmax_cross_entropy(logits, labels)))
+
+
+def test_tp_smoothing_matches_dense(mesh8):
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+    from theanompi_tpu.parallel import tp as tplib
+    from theanompi_tpu.parallel.mesh import MODEL_AXIS
+
+    mesh = Mesh(np.asarray(jax.devices()[:4]), (MODEL_AXIS,))
+    r = np.random.RandomState(1)
+    logits = jnp.asarray(r.randn(16, 32).astype(np.float32) * 2)
+    labels = jnp.asarray(r.randint(0, 32, 16).astype(np.int32))
+    sm = jax.jit(jax.shard_map(
+        lambda lg, lb: tplib.tp_softmax_cross_entropy(
+            lg, lb, label_smoothing=0.2),
+        mesh=mesh, in_specs=(P(None, MODEL_AXIS), P()), out_specs=P()))
+    got = float(sm(
+        jax.device_put(logits, NamedSharding(mesh, P(None, MODEL_AXIS))),
+        jax.device_put(labels, NamedSharding(mesh, P()))))
+    assert got == pytest.approx(
+        float(L.softmax_cross_entropy(logits, labels, 0.2)), rel=1e-5)
+
+
+def test_smoothing_applies_to_train_only(mesh4):
+    from tests.conftest import TinyModel
+
+    cfg = {"mesh": mesh4, "size": 4, "rank": 0, "verbose": False,
+           "label_smoothing": 0.2}
+    m = TinyModel(cfg)
+    plain = TinyModel({**cfg, "label_smoothing": 0.0})
+    batch = {"x": jnp.asarray(np.random.RandomState(0)
+                              .randn(8, 16).astype(np.float32)),
+             "y": jnp.asarray(np.arange(8, dtype=np.int32) % 2)}
+    # training loss differs (smoothed) on identical params/batch ...
+    c_s, _ = m.loss_and_metrics(m.params, {}, batch, None, train=True)
+    c_p, _ = plain.loss_and_metrics(plain.params, {}, batch, None,
+                                    train=True)
+    assert float(c_s) != pytest.approx(float(c_p), abs=1e-6)
+    # ... the eval path never smooths
+    v_s, _ = m.loss_and_metrics(m.params, {}, batch, None, train=False)
+    v_p, _ = plain.loss_and_metrics(plain.params, {}, batch, None,
+                                    train=False)
+    assert float(v_s) == pytest.approx(float(v_p))
+    assert float(v_s) == pytest.approx(float(c_p))   # = plain NLL

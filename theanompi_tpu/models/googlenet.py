@@ -177,14 +177,15 @@ class GoogLeNet(ModelBase):
 
     def loss_and_metrics(self, params, bn_state, batch, rng, train):
         logits, t4a, t4d, rng = self._trunk(params, batch["x"], train, rng)
-        cost = L.softmax_cross_entropy(logits, batch["y"])
+        ls = self._label_smoothing(train)
+        cost = L.softmax_cross_entropy(logits, batch["y"], ls)
         if train:
             r1, r2 = (jax.random.split(rng) if rng is not None
                       else (None, None))
             a1, _ = self.aux1.apply(params["aux1"], t4a, train=True, rng=r1)
             a2, _ = self.aux2.apply(params["aux2"], t4d, train=True, rng=r2)
             cost = cost + self.aux_weight * (
-                L.softmax_cross_entropy(a1, batch["y"]) +
-                L.softmax_cross_entropy(a2, batch["y"]))
+                L.softmax_cross_entropy(a1, batch["y"], ls) +
+                L.softmax_cross_entropy(a2, batch["y"], ls))
         err = L.errors(logits, batch["y"])
         return cost, (err, bn_state)

@@ -578,12 +578,22 @@ def _activate(x, kind: Optional[str]):
 # errors / errors_top_x)
 # ---------------------------------------------------------------------------
 
-def softmax_cross_entropy(logits, labels) -> jnp.ndarray:
-    """Mean NLL of integer ``labels`` under softmax(logits), in float32."""
+def softmax_cross_entropy(logits, labels,
+                          label_smoothing: float = 0.0) -> jnp.ndarray:
+    """Mean NLL of integer ``labels`` under softmax(logits), in float32.
+
+    ``label_smoothing=ε`` mixes the one-hot target with the uniform
+    distribution (Szegedy et al. 2016): loss = (1−ε)·NLL + ε·mean_k(−log
+    p_k) — exact, not the folded approximation."""
     logits = logits.astype(jnp.float32)
     logz = jax.nn.logsumexp(logits, axis=-1)
     ll = jnp.take_along_axis(logits, labels[:, None], axis=-1)[:, 0]
-    return jnp.mean(logz - ll)
+    nll = jnp.mean(logz - ll)
+    if label_smoothing:
+        eps = float(label_smoothing)
+        uniform = logz - jnp.mean(logits, axis=-1)            # −mean log p_k
+        return (1.0 - eps) * nll + eps * jnp.mean(uniform)
+    return nll
 
 
 def errors(logits, labels) -> jnp.ndarray:

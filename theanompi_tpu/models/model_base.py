@@ -146,11 +146,18 @@ class ModelBase:
         """Returns (logits, new_state)."""
         return self.seq.apply(params, x, train=train, rng=rng, state=state)
 
+    def _label_smoothing(self, train: bool) -> float:
+        """The smoothing ε the loss should use — the config knob applies to
+        the TRAINING loss only (validation scores the clean NLL)."""
+        return float(self.config.get("label_smoothing", 0.0)) if train \
+            else 0.0
+
     def loss_and_metrics(self, params, bn_state, batch, rng, train):
         """Default head: softmax cross-entropy + top-1 error."""
         logits, new_bn = self.apply_model(params, batch["x"], train=train,
                                           rng=rng, state=bn_state)
-        cost = L.softmax_cross_entropy(logits, batch["y"])
+        cost = L.softmax_cross_entropy(logits, batch["y"],
+                                       self._label_smoothing(train))
         err = L.errors(logits, batch["y"])
         return cost, (err, new_bn)
 

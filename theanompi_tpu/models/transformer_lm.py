@@ -374,11 +374,13 @@ class TransformerLM(ModelBase):
         v = logits.shape[-1]
         flat = logits.reshape(-1, v)
         y = batch["y"].reshape(-1)
+        ls = self._label_smoothing(train)
         if self.tp > 1:
             from ..parallel import tp as tplib
-            return tplib.tp_softmax_cross_entropy(flat, y), \
+            return tplib.tp_softmax_cross_entropy(
+                flat, y, label_smoothing=ls), \
                 (tplib.tp_errors(flat, y), bn_state)
-        cost = L.softmax_cross_entropy(flat, y)
+        cost = L.softmax_cross_entropy(flat, y, ls)
         err = L.errors(flat, y)
         if self.sp > 1:
             from ..parallel.sp import sp_mean
@@ -619,11 +621,13 @@ class MoETransformerLM(TransformerLM):
         v = logits.shape[-1]
         flat = logits.reshape(-1, v)
         y = batch["y"].reshape(-1)
+        ls = self._label_smoothing(train)
         if self.tp > 1:
             from ..parallel import tp as tplib
-            cost = tplib.tp_softmax_cross_entropy(flat, y)
+            cost = tplib.tp_softmax_cross_entropy(flat, y,
+                                                  label_smoothing=ls)
             err = tplib.tp_errors(flat, y)
         else:
-            cost = L.softmax_cross_entropy(flat, y)
+            cost = L.softmax_cross_entropy(flat, y, ls)
             err = L.errors(flat, y)
         return cost + self.moe_aux * aux, (err, bn_state)

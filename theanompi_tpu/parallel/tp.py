@@ -152,11 +152,14 @@ class VocabParallelEmbedding(L.Embedding):
 # vocab-parallel loss / metric heads (logits sharded [N, V/tp])
 # ---------------------------------------------------------------------------
 
-def tp_softmax_cross_entropy(local_logits, labels, axis: str = MODEL_AXIS):
+def tp_softmax_cross_entropy(local_logits, labels, axis: str = MODEL_AXIS,
+                             label_smoothing: float = 0.0):
     """Mean NLL over VOCAB-SHARDED logits — never materializes ``[N, V]``.
 
     Shard-local sum-exp and label log-likelihood, one ``psum`` each; the max
-    subtraction uses :func:`pmax_sg`.  Output is invariant over ``axis``.
+    subtraction uses :func:`pmax_sg`.  ``label_smoothing`` mixes in the
+    uniform term (its full-vocab logit mean is one more ``psum``).  Output
+    is invariant over ``axis``.
     """
     l32 = local_logits.astype(jnp.float32)
     v_loc = l32.shape[-1]
@@ -169,7 +172,13 @@ def tp_softmax_cross_entropy(local_logits, labels, axis: str = MODEL_AXIS):
     ll_loc = jnp.take_along_axis(
         l32, jnp.clip(loc, 0, v_loc - 1)[:, None], axis=-1)[:, 0]
     ll = lax.psum(jnp.where(ok, ll_loc, 0.0), axis)
-    return jnp.mean(logz - ll)
+    nll = jnp.mean(logz - ll)
+    if label_smoothing:
+        eps = float(label_smoothing)
+        v_tot = v_loc * lax.psum(1, axis)
+        mean_logit = lax.psum(jnp.sum(l32, axis=-1), axis) / v_tot
+        return (1.0 - eps) * nll + eps * jnp.mean(logz - mean_logit)
+    return nll
 
 
 def tp_errors(local_logits, labels, axis: str = MODEL_AXIS):
