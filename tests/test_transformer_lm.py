@@ -76,3 +76,26 @@ def test_lm_session_api():
               synthetic_val=128, verbose=False, scale_lr=False)
     rec = rule.wait()
     assert rec.epoch_records and np.isfinite(rec.epoch_records[-1]["val_cost"])
+
+
+def test_remat_is_loss_equivalent(mesh4):
+    """remat=True (per-block jax.checkpoint) changes memory, not math."""
+    import jax.numpy as jnp
+    from theanompi_tpu.models.transformer_lm import TransformerLM
+    from theanompi_tpu.parallel.exchanger import BSP_Exchanger
+
+    def run(remat):
+        cfg = {"mesh": mesh4, "size": 4, "rank": 0, "verbose": False,
+               "remat": remat, "batch_size": 8, "seq_len": 16, "vocab": 32,
+               "d_model": 32, "n_head": 4, "n_layer": 2,
+               "synthetic_train": 64, "compute_dtype": jnp.float32}
+        m = TransformerLM(cfg)
+        m.compile_iter_fns(BSP_Exchanger(cfg))
+        m.data.shuffle_data(0)
+        costs = []
+        for i in range(4):
+            m.train_iter(i, None)
+            costs.append(float(m.current_info["cost"]))
+        return costs
+
+    np.testing.assert_allclose(run(True), run(False), rtol=1e-6, atol=1e-8)

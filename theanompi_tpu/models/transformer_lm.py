@@ -363,8 +363,17 @@ class TransformerLM(ModelBase):
             hm = pl.pipeline_apply(stage_fn, params["blocks"], hm)
             h = pl.unmicrobatch(hm)
         else:
+            remat = train and self.config.get("remat", False)
             for blk in self.blocks:
-                h = blk.apply(params[blk.name], h, train=train)
+                if remat:
+                    # rematerialize each block on the backward pass —
+                    # activation memory per block trades for recompute
+                    # (jax.checkpoint; the pp path already remats per stage)
+                    h = jax.checkpoint(
+                        lambda p, x, _b=blk: _b.apply(p, x, train=True))(
+                            params[blk.name], h)
+                else:
+                    h = blk.apply(params[blk.name], h, train=train)
         h = self.ln_f.apply(params["ln_f"], h)
         return self.head.apply(params["head"], h), state
 
@@ -408,7 +417,7 @@ class TransformerLM(ModelBase):
     # -- inference ---------------------------------------------------------
 
     def generate(self, prompt, max_new_tokens: int, temperature: float = 0.0,
-                 seed: int = 0, kv_cache: bool = True):
+                 seed: int = 0, kv_cache: bool = True, params=None):
         """Sample continuations — greedy (``temperature=0``) or categorical.
 
         One jit-compiled ``lax.scan`` over decode steps on a fixed
@@ -418,13 +427,18 @@ class TransformerLM(ModelBase):
         O(T) per token instead of the full O(T²) forward.  The fallback
         full-forward path remains for stacks without a decode method (MoE).
         Uses the canonical params (EASGD center / GoSGD consensus / BSP
-        replica 0) gathered to one device, so it works after training under
-        any rule; model-parallel layouts (tp/pp/sp) gather to a dense run
-        the same way but are not wired yet.
+        replica 0 / the EMA shadow) gathered to one device, so it works
+        after training under any rule; model-parallel layouts (tp/pp/sp)
+        gather the global params and sample through a single-device dense
+        twin (same model — dense-parity-pinned).
         """
-        assert self.tp == 1 and self.pp == 1 and self.sp == 1, (
-            "generate() runs the gathered params densely; model-parallel "
-            "layouts are not wired into the sampler yet")
+        if self.tp > 1 or self.pp > 1 or self.sp > 1:
+            # model-parallel layouts: gather the global params and sample on
+            # a DENSE single-device twin (the layouts are the same model —
+            # dense-parity-pinned — so the twin's forward IS this model's)
+            return self._dense_twin().generate(
+                prompt, max_new_tokens, temperature=temperature, seed=seed,
+                kv_cache=kv_cache, params=self._gathered_dense_params())
         import numpy as np
 
         prompt = np.asarray(prompt, dtype=np.int32)
@@ -437,7 +451,8 @@ class TransformerLM(ModelBase):
             f"prompt {p_len} + {max_new_tokens} new tokens exceeds "
             f"seq_len={self.seq_len} (the position-embedding table)")
 
-        params = self.canonical_host_params()
+        if params is None:
+            params = self.canonical_host_params()
         toks0 = np.zeros((b, self.seq_len), np.int32)
         toks0[:, :p_len] = prompt
 
@@ -471,6 +486,38 @@ class TransformerLM(ModelBase):
         (toks, _, _), out = jax.lax.scan(body, (toks, start_pos, key), None,
                                          length=max_new)
         return toks, out.T              # [B, max_new]
+
+    def _dense_twin(self):
+        """A single-device tp=pp=sp=1 copy of this model (same dims/class),
+        built once — the sampler target for model-parallel layouts."""
+        if getattr(self, "_twin", None) is None:
+            from ..parallel.mesh import worker_mesh
+            cfg = {k: v for k, v in self.config.items()
+                   if k not in ("mesh", "tp", "pp", "sp", "size", "rank",
+                                "pp_microbatches", "data_dir")}
+            # the sampler never touches the twin's data object — keep its
+            # synthetic stream (and memory) minimal instead of re-opening
+            # the corpus or materializing the full synthetic arrays
+            cfg.update(mesh=worker_mesh(1, devices=jax.devices()[:1]),
+                       size=1, rank=0, verbose=False, batch_size=1,
+                       synthetic_train=2, synthetic_val=2)
+            self._twin = type(self)(cfg)
+        return self._twin
+
+    def _gathered_dense_params(self):
+        """Global host params reshaped to the DENSE layout: tp/sp gathers
+        are already dense-shaped; pp's stacked ``blocks`` leaves unstack
+        into per-block subtrees."""
+        params = self.canonical_host_params()
+        if self.pp == 1:
+            return params
+        # copy before restructuring: before compile_iter_fns the host params
+        # ARE self.params by reference — popping would corrupt the model
+        params = dict(params)
+        stacked = params.pop("blocks")
+        for i in range(self.n_layer):
+            params[f"block{i}"] = jax.tree.map(lambda x: x[i], stacked)
+        return params
 
     def _next_token(self, row, key, temp):
         """Greedy/categorical selection from one [B, V] logit row."""
