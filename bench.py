@@ -183,16 +183,22 @@ def main() -> int:
         except Exception as e:
             print(f"mfu unavailable: {e}", file=sys.stderr)
 
+    # a sequence model's "image" is a sequence — label it honestly, and
+    # don't divide sequences/sec by an AlexNet images/sec estimate
+    kind = extra.get("sample_kind", "images")
+    base_note = ("vs_baseline is vs ESTIMATED-K80 "
+                 f"{K80_ALEXNET_IPS:.0f} img/s, not a measured reference"
+                 if kind == "images" else
+                 "vs_baseline n/a for sequence models")
     out = {
-        "metric": f"images_per_sec_per_chip ({model_name} batch "
+        "metric": f"{kind}_per_sec_per_chip ({model_name} batch "
                   f"{model.batch_size} {rule.upper()}, {n_chips} chip(s), "
                   f"{jax.devices()[0].platform}, prng={prng or 'default'}"
-                  f"{', spc=' + str(spc) if spc > 1 else ''}; "
-                  f"vs_baseline is vs ESTIMATED-K80 {K80_ALEXNET_IPS:.0f} "
-                  f"img/s, not a measured reference)",
+                  f"{', spc=' + str(spc) if spc > 1 else ''}; {base_note})",
         "value": round(ips_chip, 2),
-        "unit": "images/sec/chip",
-        "vs_baseline": round(ips_chip / K80_ALEXNET_IPS, 3),
+        "unit": f"{kind}/sec/chip",
+        "vs_baseline": round(ips_chip / K80_ALEXNET_IPS, 3)
+        if kind == "images" else None,
     }
     if mfu is not None:
         out["mfu"] = mfu

@@ -141,3 +141,36 @@ def test_gan_multiworker_bsp_and_gossip():
     ex.exchange(None, 1)
     alpha = np.asarray(jax.device_get(m2.step_state["extra"]["alpha"]))
     np.testing.assert_allclose(alpha.sum(), 4.0, rtol=1e-5)
+
+
+def test_gan_rejects_zero_opt_but_composes_with_ema():
+    """ZeRO flattens the optimizer state (no param paths), so the GANs'
+    path-keyed n_critic gating cannot compose with it — rejected at build.
+    EMA nests the state but keeps paths, so the gating (and the shadow)
+    work through it."""
+    with pytest.raises(AssertionError, match="param paths"):
+        _build("WGAN", zero_opt=True)
+    m = _build("WGAN", n=2, n_critic=2, ema_decay=0.9)
+    m.compile_iter_fns(BSP_Exchanger(m.config))
+    m.data.shuffle_data(0)
+    p0 = steps.unbox(jax.device_get(m.step_state["params"]))
+    m.train_iter(1, None)      # count=1: G is GATED on this step
+    st = steps.unbox(jax.device_get(m.step_state["opt_state"]))
+    assert "ema" in st
+    # the gate reverts G's shadow to its INIT value — which must be G's
+    # params (the init-time seed), NOT zeros: a zeroed shadow would make
+    # validation/generate read a near-dead generator for ~1/(1-decay) steps
+    def maxabs(t):
+        return max(float(np.abs(np.asarray(l)).max())
+                   for l in jax.tree.leaves(t))
+    jax.tree.map(lambda e, p: np.testing.assert_allclose(
+        np.asarray(e), np.asarray(p), rtol=1e-6, atol=1e-7),
+        st["ema"]["G"], p0["G"])
+    assert maxabs(st["ema"]["G"]) > 0.0
+    m.train_iter(2, None)      # count=2: G updates; D's shadow keeps moving
+    assert np.isfinite(float(np.asarray(m.current_info["cost"])))
+    st2 = steps.unbox(jax.device_get(m.step_state["opt_state"]))
+    moved = jax.tree.map(lambda e, p: float(np.abs(np.asarray(e)
+                                                   - np.asarray(p)).max()),
+                         st2["ema"]["D"], p0["D"])
+    assert max(jax.tree.leaves(moved)) > 0.0

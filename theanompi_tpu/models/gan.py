@@ -96,6 +96,10 @@ class GAN_ModelBase(ModelBase):
         cd = self.config.get("compute_dtype", jnp.bfloat16)
         self.z_dim = int(self.config.get("z_dim", self.z_dim))
         self.n_critic = int(self.config.get("n_critic", self.n_critic))
+        # the n_critic gate selects optimizer-state subtrees by their "G"
+        # param path — incompatible with layouts that flatten paths away
+        # (model_base's zero_opt guard keys off this)
+        self.gates_opt_state_by_path = self.n_critic > 1
         base = int(self.config.get("base_width", self.base_width))
         self.G = _generator(self.z_dim, base, cd)
         self.D = _critic(base, cd)

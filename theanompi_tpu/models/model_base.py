@@ -111,6 +111,11 @@ class ModelBase:
                 "zero_opt shards the flat optimizer state over 'workers'; "
                 "composing it with tensor/pipeline param specs is a later "
                 "round")
+            assert not getattr(self, "gates_opt_state_by_path", False), (
+                "zero_opt flattens the optimizer state into per-worker "
+                "chunks, losing the param paths — models that gate "
+                "optimizer-state subtrees by path (the GANs' n_critic>1 "
+                "cadence) cannot compose with it")
             from ..parallel.zero import zero1
             self.opt = zero1(self.opt, self.mesh.shape[WORKER_AXIS],
                              self.params)

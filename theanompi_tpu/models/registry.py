@@ -16,10 +16,13 @@ MODELS = {
               {"synthetic_batches": 4}),
     "resnet50": ("theanompi_tpu.models.resnet50", "ResNet50",
                  {"synthetic_batches": 4}),
+    # sample_kind rides the extra dict: bench.py labels throughput honestly
+    # (sequences/sec, no cross-unit vs_baseline) for sequence models
     "transformer_lm": ("theanompi_tpu.models.transformer_lm", "TransformerLM",
-                       {"synthetic_train": 2048}),
+                       {"synthetic_train": 2048,
+                        "sample_kind": "sequences"}),
     "moe_lm": ("theanompi_tpu.models.transformer_lm", "MoETransformerLM",
-               {"synthetic_train": 2048}),
+               {"synthetic_train": 2048, "sample_kind": "sequences"}),
     # 8192 synthetic samples: enough for a 64-worker × batch-128 global
     # batch in the scaling sweep (the bench's per-chip runs need far less)
     "cifar10": ("theanompi_tpu.models.cifar10", "Cifar10_model",

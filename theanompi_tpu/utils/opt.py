@@ -157,12 +157,16 @@ def ema_wrap(opt: OptPair, decay: float) -> OptPair:
     assert 0.0 < decay < 1.0, f"ema_decay must be in (0, 1); got {decay}"
 
     def init(params):
-        # the shadow can't be seeded with VALUES here: under zero_opt this
-        # init only sees a shape template (each worker's chunk differs, and
-        # the boxed replicate broadcasts one template to all workers) — the
-        # t==0 branch in update() seeds it from the live pre-update params
+        # Seed the shadow from whatever init receives: the REAL params in
+        # the plain case (so even a consumer that reverts optimizer-state
+        # subtrees to their init — the GANs' n_critic gate — reverts G's
+        # shadow to G's params, not to zeros), or zero_opt's shape template
+        # (each worker's chunk differs and the boxed replicate broadcasts
+        # one template) — there the t==0 branch in update() re-seeds from
+        # the live pre-update params; both mechanisms agree in the plain
+        # case.
         return {"inner": opt.init(params),
-                "ema": jax.tree.map(jnp.zeros_like, params),
+                "ema": jax.tree.map(jnp.asarray, params),
                 "t": jnp.zeros((), jnp.int32)}
 
     def update(grads, st, params, lr):
