@@ -174,3 +174,9 @@ def test_gan_rejects_zero_opt_but_composes_with_ema():
                                                    - np.asarray(p)).max()),
                          st2["ema"]["D"], p0["D"])
     assert max(jax.tree.leaves(moved)) > 0.0
+    # WGAN projects the shadow's critic into the clip box too — otherwise
+    # validation would score a Lipschitz-violating critic for ~1/(1-decay)
+    # steps (the EMA blend happens before the clip hook)
+    clip = float(m.clip)
+    for leaf in jax.tree.leaves(st2["ema"]["D"]):
+        assert float(np.abs(np.asarray(leaf)).max()) <= clip + 1e-7

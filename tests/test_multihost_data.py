@@ -115,6 +115,15 @@ def test_two_process_tp_transformer_step():
     _run_twoproc_and_compare("tp", fingerprint_after_steps_tp(dp=2, tp=2))
 
 
+def test_two_process_pp_transformer_step():
+    """Multi-host × pipeline parallelism: dp across the processes, both
+    pipeline stages within each process — microbatch ppermutes stay
+    intra-host, the gradient reduce crosses hosts; must match a
+    single-process oracle."""
+    from tests.twoproc_model import fingerprint_after_steps_pp
+    _run_twoproc_and_compare("pp", fingerprint_after_steps_pp(dp=2, pp=2))
+
+
 def test_database_host_slices_partition_global_batch():
     cfg = {"size": 4, "seed": 0}
     whole = SyntheticData({**cfg, "process_count": 1}, batch_size=8)

@@ -36,6 +36,9 @@ def main() -> int:
         # dp=2 across the processes × tp=2 within each process's 2 devices
         from tests.twoproc_model import fingerprint_after_steps_tp
         fp = fingerprint_after_steps_tp(dp=2, tp=2)
+    elif mode == "pp":
+        from tests.twoproc_model import fingerprint_after_steps_pp
+        fp = fingerprint_after_steps_pp(dp=2, pp=2)
     else:
         from tests.twoproc_model import fingerprint_after_steps
         fp = fingerprint_after_steps(n_workers=4)

@@ -73,21 +73,36 @@ def fingerprint_after_steps(n_workers: int, n_steps: int = 2) -> dict:
     return _train_and_fingerprint(M(config), BSP_Exchanger(config), n_steps)
 
 
-def fingerprint_after_steps_tp(dp: int = 2, tp: int = 2,
-                               n_steps: int = 2) -> dict:
-    """The real-scale layout: dp ACROSS hosts × tp WITHIN a host.  Each
-    process contributes one tensor-parallel worker group; the tp psums ride
-    intra-host links, the dp gradient reduce crosses hosts."""
+def _lm_fingerprint(dp: int, n_steps: int, **parallel_kw) -> dict:
+    """One shared tiny-LM config for the model-parallel two-process modes —
+    only the mesh/parallelism kwargs differ between tp and pp."""
     import jax.numpy as jnp
 
     from theanompi_tpu.models.transformer_lm import TransformerLM
     from theanompi_tpu.parallel.exchanger import BSP_Exchanger
     from theanompi_tpu.parallel.mesh import worker_mesh
 
-    mesh = worker_mesh(dp, tp=tp)
-    cfg = {"mesh": mesh, "size": dp, "rank": 0, "tp": tp, "verbose": False,
+    mesh = worker_mesh(dp, tp=parallel_kw.get("tp", 1),
+                       pp=parallel_kw.get("pp", 1))
+    cfg = {"mesh": mesh, "size": dp, "rank": 0, "verbose": False,
            "batch_size": 8, "seq_len": 16, "vocab": 16, "d_model": 16,
-           "n_head": 2, "n_layer": 1, "synthetic_train": 64,
-           "synthetic_val": 32, "compute_dtype": jnp.float32, "seed": 5}
+           "n_head": 2, "synthetic_train": 64, "synthetic_val": 32,
+           "compute_dtype": jnp.float32, "seed": 5, "n_layer": 1,
+           **parallel_kw}
     return _train_and_fingerprint(TransformerLM(cfg), BSP_Exchanger(cfg),
                                   n_steps)
+
+
+def fingerprint_after_steps_tp(dp: int = 2, tp: int = 2,
+                               n_steps: int = 2) -> dict:
+    """The real-scale layout: dp ACROSS hosts × tp WITHIN a host — the tp
+    psums ride intra-host links, the dp gradient reduce crosses hosts."""
+    return _lm_fingerprint(dp, n_steps, tp=tp)
+
+
+def fingerprint_after_steps_pp(dp: int = 2, pp: int = 2,
+                               n_steps: int = 2) -> dict:
+    """dp across hosts × pipeline stages within a host: microbatch
+    activations ppermute intra-host, the gradient reduce crosses hosts."""
+    return _lm_fingerprint(dp, n_steps, pp=pp, pp_microbatches=4,
+                           n_layer=2)

@@ -211,6 +211,15 @@ class WGAN(GAN_ModelBase):
         new_params = {"G": new_params["G"],
                       "D": jax.tree.map(lambda p: jnp.clip(p, -c, c),
                                         new_params["D"])}
+        if isinstance(new_opt, dict) and "ema" in new_opt:
+            # the EMA wrapper blends PRE-clip params into the shadow (it
+            # runs before this hook) — project the shadow's critic into the
+            # clip box too, or validation/inference would score an
+            # infeasible (Lipschitz-violating) critic
+            new_opt = dict(new_opt, ema={
+                "G": new_opt["ema"]["G"],
+                "D": jax.tree.map(lambda p: jnp.clip(p, -c, c),
+                                  new_opt["ema"]["D"])})
         return new_params, new_opt
 
 
