@@ -86,6 +86,23 @@ def test_generate_moe_and_untrained(mesh8):
     assert ((0 <= out) & (out < CFG["vocab"])).all()
 
 
+def test_moe_kv_cache_matches_full_forward(mesh8):
+    """MoE blocks decode through the KV cache too (per-token routing; aux
+    discarded).  Inference routing is DROP-FREE, so the per-step and
+    full-buffer samplers agree in every regime — including the default
+    capacity factor and multi-row batches (where training-style capacity
+    would drop different tokens per sampler)."""
+    mesh = worker_mesh(4)
+    moe = MoETransformerLM({**CFG, "mesh": mesh, "size": 4, "rank": 0,
+                            "moe_experts": 4, "moe_every": 2})
+    _train(moe, 40)
+    prompt = np.array([[2, 3, 4], [8, 9, 10], [11, 12, 13],
+                       [1, 2, 3]], np.int32)
+    kv = moe.generate(prompt, max_new_tokens=8, kv_cache=True)
+    full = moe.generate(prompt, max_new_tokens=8, kv_cache=False)
+    assert np.mean(kv == full) >= 0.85, (kv, full)
+
+
 def test_generate_rejects_overflow(mesh8):
     mesh = worker_mesh(2)
     model = TransformerLM({**CFG, "mesh": mesh, "size": 2, "rank": 0})

@@ -60,8 +60,9 @@ def test_moe_uniform_router_matches_scaled_dense():
 
 
 def test_moe_capacity_drops_overflow_tokens():
-    """All tokens routed to expert 0 with capacity < N: rows past capacity
-    come out ZERO (they ride the block's residual instead)."""
+    """TRAINING: all tokens routed to expert 0 with capacity < N — rows
+    past capacity come out ZERO (they ride the block's residual instead).
+    INFERENCE is drop-free (capacity = N): every row gets its expert."""
     r = np.random.RandomState(1)
     d, E, n = 8, 2, 10
     moe = MoE(d, E, mlp_ratio=1, ep=1, capacity_factor=0.4,  # C = 2
@@ -71,11 +72,13 @@ def test_moe_capacity_drops_overflow_tokens():
     x = jnp.asarray(np.abs(r.randn(n, d)).astype(np.float32))  # positive
     wg[:, 0] = 1.0                                             # favor e0
     params = dict(params, wg=jnp.asarray(wg))
-    y, _ = moe.apply(params, x)
-    C = moe.capacity(n)
+    y, _ = moe.apply(params, x, train=True)
+    C = moe.capacity(n, train=True)
     assert C == 2
     np.testing.assert_array_equal(np.asarray(y[C:]), 0.0)
     assert np.abs(np.asarray(y[:C])).sum() > 0
+    y_inf, _ = moe.apply(params, x, train=False)
+    assert (np.abs(np.asarray(y_inf)).sum(axis=1) > 0).all()  # no zero rows
 
 
 def test_moe_ep4_matches_ep1(mesh8):

@@ -190,21 +190,14 @@ class MoEBlock(Block):
         y, aux = self.moe.apply(params["moe"], h, train=train)
         return x + y, aux
 
-    # Block's decode methods reach through self.fc1/fc2, which this class
-    # deletes — the capability flag routes generate() to the full-forward
-    # sampler, and the overrides keep any direct caller from hitting a raw
-    # AttributeError
-    supports_kv_decode = False
+    # the MoE FFN is per-token (routing included), so the KV-decode path
+    # works like the dense block's — the load-balance aux is a TRAINING
+    # statistic and is discarded at inference
+    supports_kv_decode = True
 
-    def apply_prefill(self, params, x):
-        raise NotImplementedError(
-            "MoE blocks have no KV-decode path yet (supports_kv_decode is "
-            "False); generate() falls back to the full-forward sampler")
-
-    def apply_decode(self, params, x1, cache, pos):
-        raise NotImplementedError(
-            "MoE blocks have no KV-decode path yet (supports_kv_decode is "
-            "False); generate() falls back to the full-forward sampler")
+    def _mlp(self, params, h):
+        y, _aux = self.moe.apply(params["moe"], h)
+        return y
 
 
 class TransformerLM(ModelBase):
@@ -422,10 +415,11 @@ class TransformerLM(ModelBase):
 
         One jit-compiled ``lax.scan`` over decode steps on a fixed
         ``[B, seq_len]`` token buffer (static shapes).  ``kv_cache=True``
-        (default, plain Block stacks): prefill the prompt once, then each
-        step projects only the new token and attends to the cached K/V —
-        O(T) per token instead of the full O(T²) forward.  The fallback
-        full-forward path remains for stacks without a decode method (MoE).
+        (default — dense AND MoE stacks; MoE routing is per-token and
+        drop-free at inference): prefill the prompt once, then each step
+        projects only the new token and attends to the cached K/V — O(T)
+        per token instead of the full O(T²) forward.  ``kv_cache=False``
+        keeps the full-forward sampler (pinned near-token-equal).
         Uses the canonical params (EASGD center / GoSGD consensus / BSP
         replica 0 / the EMA shadow) gathered to one device, so it works
         after training under any rule; model-parallel layouts (tp/pp/sp)

@@ -81,7 +81,15 @@ class MoE(L.Layer):
         return {"wg": P(), "w1": P(M, None, None), "b1": P(M, None),
                 "w2": P(M, None, None), "b2": P(M, None)}
 
-    def capacity(self, n_tokens: int) -> int:
+    def capacity(self, n_tokens: int, train: bool = True) -> int:
+        """Per-expert token slots.  Training uses the Switch capacity bound
+        (over-capacity tokens drop to the residual — the load-balance
+        pressure); inference is DROP-FREE (capacity = n): dropping at eval
+        only hurts, and it keeps the KV-decode sampler (which routes one
+        step's tokens at a time) exactly consistent with the full-forward
+        one (which routes the whole buffer)."""
+        if not train:
+            return max(1, n_tokens)
         return max(1, int(np.ceil(
             n_tokens / self.n_experts * self.capacity_factor)))
 
@@ -91,7 +99,7 @@ class MoE(L.Layer):
         d, E = self.dim, self.n_experts
         xf = x.reshape(-1, d)
         n = xf.shape[0]
-        C = self.capacity(n)
+        C = self.capacity(n, train)
 
         # -- routing (fp32, replicated over the model axis) ---------------
         logits = jnp.dot(xf.astype(jnp.float32),
