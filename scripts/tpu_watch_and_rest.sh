@@ -1,0 +1,14 @@
+#!/usr/bin/env bash
+# Detached round-3 watcher: probe the wedged axon TPU tunnel every 10 min;
+# if it answers, run the remaining perf-matrix rows ONCE and exit.
+#   nohup ./scripts/tpu_watch_and_rest.sh >/tmp/tpu_watch.log 2>&1 &
+cd "$(dirname "$0")/.."
+for i in $(seq 1 60); do
+  if timeout 90 python -c "import jax; jax.devices()" >/dev/null 2>&1; then
+    echo "$(date -u) tunnel answered — running perf_matrix_rest" >&2
+    ./scripts/perf_matrix_rest.sh perf_matrix_r3.jsonl 2>>perf_matrix_r3.log
+    exit 0
+  fi
+  sleep 600
+done
+echo "$(date -u) gave up after 60 probes" >&2
