@@ -1,14 +1,30 @@
 #!/usr/bin/env bash
 # Detached round-3 watcher: probe the wedged axon TPU tunnel every 10 min;
-# if it answers, run the remaining perf-matrix rows ONCE and exit.
+# if a REAL TPU answers, run the remaining perf-matrix rows ONCE and exit.
 #   nohup ./scripts/tpu_watch_and_rest.sh >/tmp/tpu_watch.log 2>&1 &
-cd "$(dirname "$0")/.."
+set -u -o pipefail
+cd "$(dirname "$0")/.." || exit 1
+
+LOCK=/tmp/tpu_watch_and_rest.pid
+if [ -f "$LOCK" ] && kill -0 "$(cat "$LOCK")" 2>/dev/null; then
+  echo "another watcher (pid $(cat "$LOCK")) is already running" >&2
+  exit 1
+fi
+echo $$ > "$LOCK"
+trap 'rm -f "$LOCK"' EXIT
+
 for i in $(seq 1 60); do
-  if timeout 90 python -c "import jax; jax.devices()" >/dev/null 2>&1; then
-    echo "$(date -u) tunnel answered — running perf_matrix_rest" >&2
+  # platform must be CHECKED in-process: a wedged tunnel can fall back to
+  # the CPU backend with only a warning, and CPU-speed rows would corrupt
+  # the MFU table perf_matrix_r3.jsonl feeds
+  if timeout 90 python -c \
+      "import jax; assert jax.devices()[0].platform == 'tpu'" \
+      >/dev/null 2>&1; then
+    echo "$(date -u) TPU answered — running perf_matrix_rest" >&2
     ./scripts/perf_matrix_rest.sh perf_matrix_r3.jsonl 2>>perf_matrix_r3.log
-    exit 0
+    exit $?
   fi
   sleep 600
 done
 echo "$(date -u) gave up after 60 probes" >&2
+exit 2
