@@ -39,10 +39,14 @@ if __name__ == "__main__":
     rule.wait()
 
     if data_dir:
-        prompt = np.frombuffer(
-            (prompt_text or "The ").encode(), dtype=np.uint8
-        ).astype(np.int32)[None]
-        out = rule.model.generate(prompt, max_new_tokens=64,
+        max_new = 64
+        raw = np.frombuffer((prompt_text or "The ").encode(),
+                            dtype=np.uint8).astype(np.int32)
+        # the position table caps prompt+continuation at seq_len — keep the
+        # prompt's TAIL rather than dying after training completed
+        raw = raw[-(128 - max_new):]
+        prompt = raw[None]
+        out = rule.model.generate(prompt, max_new_tokens=max_new,
                                   temperature=0.8, seed=0)
         print("PROMPT:", prompt_text)
         print("SAMPLE:", bytes(out[0].astype(np.uint8)).decode(

@@ -75,8 +75,8 @@ class TinyModel(ModelBase):
                                   n_train=int(self.config.get("n_train", 256)))
 
 
-class CrashOnceModel(TinyModel):
-    """Fault-injection model for supervisor/recovery tests: raises at
+class _CrashOnceTrainIter:
+    """Fault-injection mixin for supervisor/recovery tests: raises at
     ``crash_at`` once (a marker file records that the crash already
     happened, so the restarted run proceeds)."""
 
@@ -88,6 +88,18 @@ class CrashOnceModel(TinyModel):
                 f.write("crashed")
             raise RuntimeError("injected crash for supervisor test")
         super().train_iter(count, recorder)
+
+
+class CrashOnceModel(_CrashOnceTrainIter, TinyModel):
+    pass
+
+
+from theanompi_tpu.models.transformer_lm import TransformerLM  # noqa: E402
+
+
+class CrashOnceLM(_CrashOnceTrainIter, TransformerLM):
+    """The same fault injection on the transformer — pins that the
+    supervisor/resume recovery loop is model-agnostic."""
 
 
 class HangOnceModel(TinyModel):

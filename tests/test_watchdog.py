@@ -34,6 +34,30 @@ def test_supervisor_restarts_crashed_worker_and_resumes(tmp_path):
         assert int(f.read()) == 1
 
 
+def test_supervisor_recovers_the_transformer(tmp_path):
+    """Crash recovery is model-agnostic: the LM crashes mid-epoch-1 and the
+    supervisor restarts it with resume=true from epoch 0's checkpoint."""
+    from theanompi_tpu import launcher
+
+    marker = str(tmp_path / "crashed")
+    ckpt = str(tmp_path / "ckpt")
+    # synthetic_train=128 / (8 workers × batch 4) = 4 iters/epoch; crash_at=5
+    # fires in epoch 1, after epoch 0's checkpoint exists
+    rc = launcher.main([
+        "--supervise", "2", "--rule", "bsp",
+        "--modelfile", "tests.conftest", "--modelclass", "CrashOnceLM",
+        "platform=cpu", "epochs=2", "batch_size=4", "synthetic_train=128",
+        "synthetic_val=64", "seq_len=16", "vocab=32", "d_model=32",
+        "n_head=4", "n_layer=1", "compute_dtype=float32",
+        "n_workers=8", "verbose=false", "scale_lr=false",
+        f"ckpt_dir={ckpt}", f"crash_marker={marker}", "crash_at=5",
+    ])
+    assert rc == 0
+    assert os.path.exists(marker)
+    with open(os.path.join(ckpt, "LATEST")) as f:
+        assert int(f.read()) == 1
+
+
 def test_supervisor_recovers_from_hang_via_stall_action_exit(tmp_path):
     """The full hang-recovery loop: a worker that STALLS (not crashes) is
     killed by its own watchdog (stall_action=exit → rc 42) and the
