@@ -2,16 +2,41 @@
 # run <label> [ENV=V ...] — one bench.py row appended to $OUT as JSON,
 # stderr kept in ${OUT%.jsonl}.err.  LM_CFG is the transformer-family
 # benchmark shape.
+#
+# Rows are RESUMABLE (round-3 verdict, weak #7): a config that already has a
+# non-null result in $OUT is skipped, so re-running a matrix script after a
+# tunnel wedge measures only the missing rows.  bench.py carries the per-row
+# timeout itself (BENCH_TIMEOUT, wedge-proof wrapper) and emits structured
+# JSON on failure; a failed row is recorded as null so the next pass retries
+# it.  Dedup superseded nulls with scripts/merge_matrix.py.
+WEDGED=0
 run() {
   local label="$1"; shift
+  if [ "$WEDGED" = 1 ]; then
+    echo "== $label (tunnel wedged earlier this pass — skip)" >&2
+    return 0
+  fi
+  if [ -s "$OUT" ] && grep -qF "\"config\": \"$label\", \"result\": {\"metric\"" "$OUT" 2>/dev/null; then
+    echo "== $label (already measured — skip)" >&2
+    return 0
+  fi
   echo "== $label" >&2
   local line
-  line=$(env "$@" BENCH_MFU=1 BENCH_ITERS=20 timeout 1200 python bench.py 2>>"${OUT%.jsonl}.err" | tail -1) || line=""
-  if [ -n "$line" ]; then
-    echo "{\"config\": \"$label\", \"result\": $line}" >> "$OUT"
-  else
-    echo "{\"config\": \"$label\", \"result\": null}" >> "$OUT"
-  fi
+  # rows skip the per-row backend probe — the matrix driver (watcher)
+  # probes once per pass; the wrapper still hard-kills a wedged row at
+  # BENCH_TIMEOUT and classifies the wedge with a post-check probe
+  line=$(env BENCH_SKIP_PROBE="${BENCH_SKIP_PROBE:-1}" "$@" BENCH_MFU=1 BENCH_ITERS=20 python bench.py 2>>"${OUT%.jsonl}.err" | tail -1) || true
+  case "$line" in
+    '{"metric"'*) echo "{\"config\": \"$label\", \"result\": $line}" >> "$OUT" ;;
+    *) echo "== $label failed: ${line:-no output}" >&2
+       echo "{\"config\": \"$label\", \"result\": null}" >> "$OUT"
+       # a wedge mid-matrix would burn two probe timeouts per remaining row;
+       # once one row reports the wedge signature, stop the pass (the
+       # watcher re-runs the script when the tunnel answers again)
+       case "$line" in
+         *wedged*|*"probe hung"*) WEDGED=1 ;;
+       esac ;;
+  esac
 }
 
 LM_CFG='{"d_model":512,"n_head":8,"n_layer":8,"seq_len":512,"vocab":32768,"synthetic_train":512}'

@@ -1,0 +1,51 @@
+#!/usr/bin/env python
+"""Dedup/merge perf-matrix JSONL artifacts (round-3 verdict, weak #7).
+
+A matrix pass interrupted by a tunnel wedge leaves null rows that a later
+re-run supersedes; nothing previously merged those recovered rows back, so
+the half-empty table risked becoming "the number".  This tool rewrites one
+canonical file: for each config keep the LAST non-null result (or a single
+null if none succeeded), preserving first-seen config order.
+
+    python scripts/merge_matrix.py out.jsonl [more.jsonl ...]
+
+With several inputs, later files win ties and the FIRST file is rewritten.
+"""
+
+import json
+import sys
+
+
+def merge(paths: list[str]) -> None:
+    order: list[str] = []
+    best: dict[str, dict] = {}
+    for path in paths:
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    row = json.loads(line)
+                    cfg = row["config"]
+                except (ValueError, KeyError, TypeError):
+                    # a pass killed mid-append leaves a truncated line; drop
+                    # it rather than disabling the canonical merge forever
+                    print(f"merge_matrix: dropping malformed line in {path}:"
+                          f" {line[:80]}", file=sys.stderr)
+                    continue
+                if cfg not in best:
+                    order.append(cfg)
+                    best[cfg] = row
+                elif row.get("result") is not None or \
+                        best[cfg].get("result") is None:
+                    best[cfg] = row
+    with open(paths[0], "w") as f:
+        for cfg in order:
+            f.write(json.dumps(best[cfg]) + "\n")
+
+
+if __name__ == "__main__":
+    if len(sys.argv) < 2:
+        sys.exit(__doc__)
+    merge(sys.argv[1:])

@@ -1,0 +1,46 @@
+#!/usr/bin/env bash
+# Round-4 watcher: probe the axon TPU tunnel every 10 min; whenever a REAL
+# TPU answers, run the round-4 perf matrix (resumable — measured rows are
+# skipped), merge, and exit once every config has a number.  Survives
+# repeat wedges: a mid-matrix wedge leaves null rows that the next recovery
+# pass retries (round-3 verdict weak #2/#7: auto-resume + canonical merge).
+#   nohup ./scripts/tpu_watch_r4.sh >/tmp/tpu_watch_r4.log 2>&1 &
+set -u -o pipefail
+cd "$(dirname "$0")/.." || exit 1
+OUT="${1:-perf_matrix_r4.jsonl}"
+N_CONFIGS=$(grep -c '^run ' scripts/perf_matrix_r4.sh)
+
+LOCK=/tmp/tpu_watch_r4.pid
+if [ -f "$LOCK" ] && kill -0 "$(cat "$LOCK")" 2>/dev/null; then
+  echo "another watcher (pid $(cat "$LOCK")) is already running" >&2
+  exit 1
+fi
+echo $$ > "$LOCK"
+trap 'rm -f "$LOCK"' EXIT
+
+done_rows() {
+  [ -s "$OUT" ] || { echo 0; return; }
+  python scripts/merge_matrix.py "$OUT" 2>/dev/null || true
+  grep -cF '"result": {"metric"' "$OUT" || true
+}
+
+for i in $(seq 1 66); do
+  # platform must be CHECKED in-process: a wedged tunnel can fall back to
+  # the CPU backend with only a warning, and CPU-speed rows would corrupt
+  # the MFU table this matrix feeds
+  if timeout 90 python -c \
+      "import jax; assert jax.devices()[0].platform == 'tpu'" \
+      >/dev/null 2>&1; then
+    echo "$(date -u) TPU answered — running perf_matrix_r4 (pass $i)" >&2
+    ./scripts/perf_matrix_r4.sh "$OUT" 2>>perf_matrix_r4.log || true
+    n=$(done_rows)
+    echo "$(date -u) pass done: $n/$N_CONFIGS rows measured" >&2
+    if [ "$n" -ge "$N_CONFIGS" ]; then
+      echo "$(date -u) matrix complete" >&2
+      exit 0
+    fi
+  fi
+  sleep 600
+done
+echo "$(date -u) gave up after 66 probes; $(done_rows)/$N_CONFIGS rows" >&2
+exit 2
