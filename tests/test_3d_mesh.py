@@ -102,3 +102,10 @@ def test_3d_val_and_checkpoint(tmp_path, mesh8):
     m3b.data.shuffle_data(0)
     m3b.train_iter(3, None)
     assert np.isfinite(float(m3b.current_info["cost"]))
+
+
+def test_worker_mesh_warns_on_idle_remainder(mesh8):
+    """ADVICE r3: flooring n_workers must not silently idle chips."""
+    del mesh8
+    with pytest.warns(UserWarning, match="left idle"):
+        worker_mesh(None, tp=3, devices=jax.devices())   # 8 % 3 = 2 idle

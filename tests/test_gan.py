@@ -180,3 +180,11 @@ def test_gan_rejects_zero_opt_but_composes_with_ema():
     clip = float(m.clip)
     for leaf in jax.tree.leaves(st2["ema"]["D"]):
         assert float(np.abs(np.asarray(leaf)).max()) <= clip + 1e-7
+
+
+def test_wgan_rejects_ema_plus_zero_opt():
+    """ADVICE r3: zero_opt nests the EMA shadow as flat chunks the clip
+    projection can't reach — the combination must fail loudly, not score an
+    unclipped critic shadow silently."""
+    with pytest.raises(AssertionError, match="EMA shadow"):
+        _build("WGAN", ema_decay=0.99, zero_opt=True)

@@ -115,3 +115,18 @@ def test_missing_files_error(tmp_path):
     with pytest.raises(FileNotFoundError, match="token file"):
         TokenFileData({"size": 1, "data_dir": str(tmp_path / "empty"),
                        "seq_len": 8}, batch_size=4)
+
+
+def test_vocab_guard_fires_with_model_default_vocab(tmp_path):
+    """ADVICE r3: the out-of-range check must fire even when the user relies
+    on the model's class-default vocab (no 'vocab' in config) — the model
+    passes its RESOLVED vocab into TokenFileData."""
+    root = _write_corpus(tmp_path, vocab=64)
+    data = TokenFileData({"size": 1, "data_dir": root, "seq_len": 8},
+                         batch_size=4, vocab=32)   # corpus ids reach 63
+    with pytest.raises(AssertionError, match="vocab=32"):
+        data.next_train_batch(0)
+    # config['vocab'] still wins over the passed default when both exist
+    data2 = TokenFileData({"size": 1, "data_dir": root, "seq_len": 8,
+                           "vocab": 64}, batch_size=4, vocab=32)
+    data2.next_train_batch(0)   # no raise

@@ -42,9 +42,15 @@ class TokenFileData(DataBase):
     """``data_dir/train.bin`` + ``data_dir/val.bin`` next-token dataset."""
 
     def __init__(self, config: Optional[dict] = None, batch_size: int = 16,
-                 seq_len: int = 64):
+                 seq_len: int = 64, vocab: Optional[int] = None):
         super().__init__(config, batch_size)
         self.seq_len = int(self.config.get("seq_len", seq_len))
+        # the model passes its RESOLVED vocab so the out-of-range guard in
+        # _make_batch always fires — relying on config['vocab'] alone missed
+        # the class-default case, training silently wrong on an oversized
+        # corpus via clamped embedding gathers
+        v = self.config.get("vocab", vocab)
+        self._vocab = int(v) if v is not None else None
         data_dir = self.config["data_dir"]
         dtype = np.dtype(self.config.get("token_dtype", "uint16"))
         self._toks = {
@@ -66,13 +72,12 @@ class TokenFileData(DataBase):
         seq = np.asarray(
             toks[starts[:, None] + np.arange(self.seq_len + 1)],
             dtype=np.int32)
-        vocab = self.config.get("vocab")
-        if vocab is not None:
+        if self._vocab is not None:
             # jit-side embedding gathers CLAMP out-of-range ids — a corpus
             # tokenized with a larger vocabulary would train silently wrong
             mx = int(seq.max())
-            assert mx < int(vocab), (
-                f"token id {mx} >= vocab={vocab} — the corpus was tokenized "
-                f"with a larger vocabulary than the model's")
+            assert mx < self._vocab, (
+                f"token id {mx} >= vocab={self._vocab} — the corpus was "
+                f"tokenized with a larger vocabulary than the model's")
         return {"x": np.ascontiguousarray(seq[:, :-1]),
                 "y": np.ascontiguousarray(seq[:, 1:])}

@@ -193,6 +193,16 @@ class WGAN(GAN_ModelBase):
     clip = 0.01
 
     def build_model(self) -> None:
+        # zero_opt flattens the EMA shadow into per-worker chunks nested at
+        # opt['opt']['ema']; the clip projection below keys on a top-level
+        # 'ema' and would silently skip it — validation would then score an
+        # unclipped (Lipschitz-violating) critic shadow.  Config-only check:
+        # fail before the expensive network/dataset build.
+        assert not (self.config.get("ema_decay")
+                    and self.config.get("zero_opt")), (
+            "WGAN weight clipping cannot project the EMA shadow once "
+            "zero_opt has flattened it into optimizer chunks — drop one of "
+            "ema_decay/zero_opt")
         super().build_model()
         self.clip = float(self.config.get("clip", self.clip))
 

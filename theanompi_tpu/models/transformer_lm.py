@@ -277,7 +277,7 @@ class TransformerLM(ModelBase):
             # real corpus: nanoGPT-style flat token files, memory-mapped
             from .data.tokens import TokenFileData
             self.data = TokenFileData(self.config, self.batch_size,
-                                      self.seq_len)
+                                      self.seq_len, vocab=self.vocab)
         else:
             self.data = LMData(self.config, self.batch_size)
 
@@ -636,6 +636,11 @@ class MoETransformerLM(TransformerLM):
             hm, aux_sum = pl.pipeline_apply(stage_fn, params["blocks"], hm,
                                             with_aux=True)
             h = pl.unmicrobatch(hm)
+            # KNOWN DEVIATION from the dense layout: this is the mean of
+            # per-MICROBATCH load-balance losses, not the aux of the full
+            # batch's routing fractions — microbatch f_e/P_e are noisier, so
+            # the pp objective differs slightly from dense (the main loss is
+            # pinned equal; the aux parity claim is scoped to dense/tp/ep)
             aux = aux_sum / (self.pp_microbatches * self.n_layer)
         else:
             aux = jnp.zeros((), jnp.float32)

@@ -102,6 +102,15 @@ def worker_mesh(
             raise ValueError(
                 f"group size {group} needs at least that many devices but "
                 f"only {len(devices)} are visible")
+        rem = len(devices) - n_workers * group
+        if rem:
+            # flooring silently idles chips (8 devices, tp=3 → 6 used) and
+            # quietly skews per-chip throughput numbers — make it visible
+            import warnings
+            warnings.warn(
+                f"worker_mesh: {len(devices)} devices don't divide by "
+                f"group tp*pp*sp={group}; {rem} chip(s) left idle — pass "
+                f"n_workers explicitly to silence", stacklevel=2)
     need = n_workers * group
     if need > len(devices):
         raise ValueError(
