@@ -86,7 +86,7 @@ def test_asgd_pull_resets_workers_to_center():
             np.testing.assert_allclose(pl[w], cl, rtol=1e-6, atol=1e-7)
 
 
-@pytest.mark.parametrize("peers", ["perm", "shift"])
+@pytest.mark.parametrize("peers", ["perm", "shift", "iid"])
 def test_gosgd_alpha_sum_conserved(peers):
     """GoSGD's Σα invariant (mixing weights are redistributed, never created
     or destroyed) — in both peer-assignment modes."""
@@ -138,3 +138,56 @@ def test_gosgd_gossip_mixes_replicas():
 def test_unknown_rule_raises():
     with pytest.raises(ValueError, match="unknown exchanger"):
         get_exchanger("gossip")
+
+
+def test_gosgd_iid_maps_and_collision_rounds():
+    """'iid' routing structure: maps avoid self-sends, draws are uniform
+    over the other workers, collisions occur, and the round decomposition
+    is a set of partial permutations covering each sender exactly once."""
+    n = 8
+    maps = GOSGD_Exchanger._iid_maps(n, 16)
+    assert maps.shape == (16, n)
+    assert (maps != np.arange(n)).all(), "self-send in an iid map"
+    # with 16 maps of 8 iid draws, a collision (two senders -> one dest) is
+    # a statistical certainty; the whole point of the mode
+    assert any(len(np.unique(m)) < n for m in maps), "no collisions drawn"
+    for m in maps:
+        rounds = GOSGD_Exchanger._collision_rounds(m)
+        senders = [s for r in rounds for (s, _) in r]
+        assert sorted(senders) == list(range(n))       # each sender once
+        for r in rounds:
+            srcs = [s for (s, _) in r]
+            dsts = [d for (_, d) in r]
+            assert len(set(srcs)) == len(srcs)         # partial permutation
+            assert len(set(dsts)) == len(dsts)
+        # reconstruct the map from the rounds
+        rebuilt = dict(pair for r in rounds for pair in r)
+        assert all(rebuilt[i] == m[i] for i in range(n))
+
+
+def test_gosgd_iid_mode_conserves_weighted_params_and_mixes():
+    """Collision-mode routing end-to-end: the α-weighted params sum is
+    conserved under pure gossip (every sent message lands exactly once even
+    when two senders hit one receiver), and replicas contract."""
+    model, exch = _setup(GOSGD_Exchanger, exch_prob=1.0, gosgd_peers="iid")
+
+    def weighted_sum(state):
+        a = np.asarray(jax.device_get(state["extra"]["alpha"]))
+        leaves = jax.tree_util.tree_leaves(jax.device_get(state["params"]))
+        return sum((l * a.reshape((-1,) + (1,) * (l.ndim - 1))).sum(0).sum()
+                   for l in leaves)
+
+    def spread(m):
+        leaves = jax.tree_util.tree_leaves(jax.device_get(
+            m.step_state["params"]))
+        return sum(np.ptp(l, axis=0).mean() for l in leaves)
+
+    for i in range(3):          # diversify replicas (no exchange yet)
+        model.train_iter(i + 1, None)
+    before, spread0 = weighted_sum(model.step_state), spread(model)
+    assert spread0 > 0
+    for i in range(6):
+        exch.exchange(None, i + 1)
+    np.testing.assert_allclose(weighted_sum(model.step_state), before,
+                               rtol=1e-4)
+    assert spread(model) < 0.7 * spread0
