@@ -100,3 +100,65 @@ def test_ema_rejects_params_mode(mesh4):
     model = TinyModel(cfg)
     with pytest.raises(AssertionError, match="grads mode"):
         model.compile_iter_fns(BSP_Exchanger(cfg))
+
+
+# -- round 4: composition with tensor parallelism ---------------------------
+
+TP_LM = dict(verbose=False, batch_size=8, seq_len=16, vocab=32,
+             synthetic_train=64, synthetic_val=32, d_model=32, n_head=4,
+             n_layer=2)
+
+
+def _make_lm(tp, **kw):
+    import jax.numpy as jnp
+    from theanompi_tpu.models.transformer_lm import TransformerLM
+    mesh = worker_mesh(2, tp=tp)
+    cfg = {**TP_LM, "mesh": mesh, "size": 2, "rank": 0, "tp": tp,
+           "compute_dtype": jnp.float32, **kw}
+    m = TransformerLM(cfg)
+    m.compile_iter_fns(BSP_Exchanger(m.config))
+    m.data.shuffle_data(0)
+    return m
+
+
+def test_ema_under_tp_matches_dense_shadow(mesh8):
+    """The tp=2 shadow must equal the dense run's shadow (same model, same
+    data, identical math up to fp32 summation order) — round-3 verdict #6."""
+    decay = 0.9
+    dense = _make_lm(1, ema_decay=decay)
+    tp2 = _make_lm(2, ema_decay=decay)
+    for i in range(4):
+        dense.train_iter(i, None)
+        tp2.train_iter(i, None)
+    sd = dense._ema_host_params()
+    st = tp2._ema_host_params()
+    # dense vs tp differ by fp32 summation order (psum vs serial matmul
+    # reductions), compounding over 4 adam steps — not an exactness claim
+    jax.tree.map(lambda a, b: np.testing.assert_allclose(
+        np.asarray(a), np.asarray(b), rtol=5e-3, atol=2e-4), sd, st)
+    # validation reads the re-boxed sharded shadow without error
+    tp2.begin_val()
+    tp2.val_iter(0)
+    tp2.end_val()
+
+
+def test_ema_zero_tp_shadow_matches_plain_ema(mesh8):
+    """Triple composition ema×zero×tp: the chunk-sharded shadow, assembled
+    by the device-side gather, must be BIT-equal to the plain tp shadow
+    (zero is bit-equal math; EMA is elementwise on the same values)."""
+    decay = 0.9
+    plain = _make_lm(2, ema_decay=decay)
+    zero = _make_lm(2, ema_decay=decay, zero_opt=True)
+    for i in range(4):
+        plain.train_iter(i, None)
+        zero.train_iter(i, None)
+    sp_ = plain._ema_host_params()
+    sz = zero._ema_host_params()
+    jax.tree.map(lambda a, b: np.testing.assert_array_equal(
+        np.asarray(a), np.asarray(b)), sp_, sz)
+    # and the sharded layout really is chunks, not a full tree
+    st = zero.step_state["opt_state"]
+    assert "ema" not in st and "ema" in st["opt"]
+    zero.begin_val()
+    zero.val_iter(0)
+    zero.end_val()

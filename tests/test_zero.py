@@ -89,7 +89,9 @@ def test_zero1_checkpoint_roundtrip(tmp_path, mesh4):
     m2.train_iter(3, None)
 
 
-def test_zero1_rejects_async_rules_and_tp(mesh4, mesh8):
+def test_zero1_rejects_async_rules(mesh4):
+    """(tp composition is no longer rejected — round-4; see the tp tests
+    below.)"""
     model, cfg = _make_tiny(True, mesh4, optimizer="momentum",
                             sync_freq=2)
     with pytest.raises(AssertionError, match="BSP grads"):
@@ -100,11 +102,6 @@ def test_zero1_rejects_async_rules_and_tp(mesh4, mesh8):
         m, c = _make_tiny(True, mesh4, optimizer="momentum", **bad)
         with pytest.raises(AssertionError, match="grads"):
             m.compile_iter_fns(BSP_Exchanger(c))
-    with pytest.raises(AssertionError, match="later"):
-        TransformerLM({"mesh": worker_mesh(2, tp=4), "size": 2, "rank": 0,
-                       "tp": 4, "zero_opt": True, "verbose": False,
-                       "batch_size": 8, "seq_len": 16, "vocab": 32,
-                       "d_model": 32, "n_head": 4, "n_layer": 1})
 
 
 def test_zero1_transformer_with_compressed_wire(mesh8):
@@ -120,3 +117,85 @@ def test_zero1_transformer_with_compressed_wire(mesh8):
     costs = _train(model, BSP_Exchanger(cfg), 6)
     assert np.isfinite(costs).all()
     assert np.mean(costs[-3:]) < np.mean(costs[:3])
+
+
+# -- round 4: composition with tensor parallelism ---------------------------
+
+TP_LM = dict(verbose=False, batch_size=8, seq_len=16, vocab=32,
+             synthetic_train=64, synthetic_val=32, d_model=32, n_head=4,
+             n_layer=2, compute_dtype=jnp.float32)
+
+
+def _make_tp_lm(zero, dp=2, tp=2, **kw):
+    mesh = worker_mesh(dp, tp=tp)
+    cfg = {**TP_LM, "mesh": mesh, "size": dp, "rank": 0, "tp": tp,
+           "zero_opt": zero, **kw}
+    return TransformerLM(cfg)
+
+
+@pytest.mark.parametrize("optimizer", ["momentum", "adam"])
+def test_zero1_bit_equal_under_tp(mesh8, optimizer):
+    """dp=2 × tp=2: the ZeRO partition now chunks each device's LOCAL param
+    shard — still bit-equal to the replicated optimizer on the same layout
+    (round-3 verdict #6)."""
+    base = _make_tp_lm(False, optimizer=optimizer)
+    zero = _make_tp_lm(True, optimizer=optimizer)
+    c0 = _train(base, BSP_Exchanger(base.config), 5)
+    c1 = _train(zero, BSP_Exchanger(zero.config), 5)
+    np.testing.assert_array_equal(np.asarray(c0), np.asarray(c1))
+    p0 = jax.device_get(steps.tree_to_host(base.step_state["params"]))
+    p1 = jax.device_get(steps.tree_to_host(zero.step_state["params"]))
+    jax.tree.map(lambda a, b: np.testing.assert_array_equal(
+        np.asarray(a), np.asarray(b)), p0, p1)
+
+
+def test_zero1_state_sharded_over_workers_and_model(mesh8):
+    """The chunk state varies over BOTH axes: boxed [dp, tp·chunk] sharded
+    P(workers, model) — per-device optimizer memory is local_P/dp."""
+    from theanompi_tpu.parallel.mesh import MODEL_AXIS
+    model = _make_tp_lm(True, optimizer="adam")
+    model.compile_iter_fns(BSP_Exchanger(model.config))
+    m = model.step_state["opt_state"]["opt"]["m"]
+    local = steps.local_param_template(model.params, model.param_specs(),
+                                       model.mesh)
+    from theanompi_tpu.utils import helper_funcs
+    chunk = -(-helper_funcs.tree_size(local) // 2)
+    assert m.shape == (2, 2 * chunk), m.shape
+    assert m.sharding.spec == (WORKER_AXIS, (MODEL_AXIS,)) or \
+        m.sharding.spec == (WORKER_AXIS, MODEL_AXIS), m.sharding.spec
+    # each device's addressable block is exactly one chunk
+    assert m.addressable_shards[0].data.shape == (1, chunk)
+
+
+def test_zero1_bit_equal_under_pp(mesh8):
+    """Pipeline composition: zero chunks each stage's local stack shard;
+    bit-equal to the replicated optimizer on the same pp layout."""
+    def make(zero):
+        mesh = worker_mesh(2, pp=2)
+        cfg = {**TP_LM, "mesh": mesh, "size": 2, "rank": 0, "tp": 1,
+               "pp": 2, "zero_opt": zero, "optimizer": "adam"}
+        return TransformerLM(cfg)
+    base, zero = make(False), make(True)
+    c0 = _train(base, BSP_Exchanger(base.config), 4)
+    c1 = _train(zero, BSP_Exchanger(zero.config), 4)
+    np.testing.assert_array_equal(np.asarray(c0), np.asarray(c1))
+    p0 = jax.device_get(steps.tree_to_host(base.step_state["params"]))
+    p1 = jax.device_get(steps.tree_to_host(zero.step_state["params"]))
+    jax.tree.map(lambda a, b: np.testing.assert_array_equal(
+        np.asarray(a), np.asarray(b)), p0, p1)
+
+
+def test_zero1_bit_equal_under_3d_mesh(mesh8):
+    """dp=2 × pp=2 × tp=2: leaves sharded over ONE model axis but replicated
+    over the other must anchor per-axis (the all-or-nothing anchor failed
+    compile here)."""
+    def make(zero):
+        mesh = worker_mesh(2, tp=2, pp=2)
+        cfg = {**TP_LM, "mesh": mesh, "size": 2, "rank": 0, "tp": 2,
+               "pp": 2, "pp_microbatches": 2, "zero_opt": zero,
+               "optimizer": "adam"}
+        return TransformerLM(cfg)
+    base, zero = make(False), make(True)
+    c0 = _train(base, BSP_Exchanger(base.config), 3)
+    c1 = _train(zero, BSP_Exchanger(zero.config), 3)
+    np.testing.assert_array_equal(np.asarray(c0), np.asarray(c1))

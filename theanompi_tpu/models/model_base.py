@@ -95,30 +95,41 @@ class ModelBase:
             else get_optimizer(self.optimizer, weight_decay=self.weight_decay)
         if self.config.get("ema_decay"):
             # EMA shadow params (utils/opt.py ema_wrap); validation and
-            # generate() read the shadow.  INSIDE the ZeRO wrapper below:
-            # under zero_opt the shadow then tracks each worker's parameter
-            # CHUNK — EMA memory shards with the optimizer state, and the
-            # full shadow is assembled only at read time.
-            assert self.param_specs() is None, (
-                "ema_decay with tensor/pipeline param specs is a later "
-                "round (the shadow changes the optimizer-state layout)")
+            # generate() read the shadow.  Composes with tensor/pipeline
+            # param specs (the shadow is laid out exactly like the params —
+            # steps.state_partition_specs) and sits INSIDE the ZeRO wrapper
+            # below: under zero_opt the shadow then tracks each worker's
+            # parameter CHUNK — EMA memory shards with the optimizer state,
+            # and the full shadow is assembled only at read time.
             from ..utils.opt import ema_wrap
             self.opt = ema_wrap(self.opt, float(self.config["ema_decay"]))
         if self.config.get("zero_opt", False):
             # ZeRO-1 (parallel/zero.py): optimizer state sharded over the
-            # workers axis — per-chip optimizer memory /N, bit-equal updates
-            assert self.param_specs() is None, (
-                "zero_opt shards the flat optimizer state over 'workers'; "
-                "composing it with tensor/pipeline param specs is a later "
-                "round")
+            # workers axis — per-chip optimizer memory /N, bit-equal updates.
+            # Under tensor/pipeline specs the per-device params are already
+            # the LOCAL shard: chunk the local flat layout and hand init the
+            # model-group shard count so the host template is global-shaped
+            # (one chunk per model-group rank, P(workers, <model axes>)).
             assert not getattr(self, "gates_opt_state_by_path", False), (
                 "zero_opt flattens the optimizer state into per-worker "
                 "chunks, losing the param paths — models that gate "
                 "optimizer-state subtrees by path (the GANs' n_critic>1 "
                 "cadence) cannot compose with it")
             from ..parallel.zero import zero1
+            pspecs = self.param_specs()
+            if pspecs is None:
+                template, shards, maxes = self.params, 1, ()
+            else:
+                template = steps.local_param_template(self.params, pspecs,
+                                                      self.mesh)
+                maxes = tuple(a for a in self.mesh.axis_names
+                              if a != WORKER_AXIS)
+                shards = 1
+                for a in maxes:
+                    shards *= self.mesh.shape[a]
             self.opt = zero1(self.opt, self.mesh.shape[WORKER_AXIS],
-                             self.params)
+                             template, model_shards=shards,
+                             pspecs=pspecs, model_axes=maxes)
 
         self.step_state: Optional[Dict[str, Any]] = None
         self._state_specs = None
@@ -330,9 +341,13 @@ class ModelBase:
             # BSP: validate the EMA shadow when enabled, else the replicas
             if self.config.get("ema_decay"):
                 # _ema_host_params handles the sharded layout and the
-                # unseeded t==0 edge uniformly
+                # unseeded t==0 edge uniformly; re-box with the model's
+                # param specs so tensor/pipeline shards land where the
+                # val step expects them
                 self._val_params_boxed = steps.replicate_tree(
-                    self._ema_host_params(), n, self.mesh)
+                    self._ema_host_params(), n, self.mesh,
+                    None if self._state_specs is None
+                    else self._state_specs["params"])
             else:
                 self._val_params_boxed = self.step_state["params"]
             self._val_bn_boxed = self.step_state["bn_state"]
@@ -433,12 +448,56 @@ class ModelBase:
             return steps.unbox(jax.device_get(
                 steps.tree_to_host(self.step_state["params"])))
         if "ema" in st:
+            # plain EMA (incl. tensor/pipeline specs): the boxed shadow is
+            # laid out like the params — device_get assembles the global tree
             return steps.unbox(jax.device_get(
                 steps.tree_to_host(st["ema"])))
-        chunks = np.asarray(jax.device_get(
-            steps.tree_to_host(st["opt"]["ema"])))       # [N, chunk]
-        return jax.device_get(helper_funcs.unflatten_like(
-            self.params, jnp.asarray(chunks.reshape(-1))))
+        # zero_opt layout: assemble on DEVICE with the exact gather the
+        # update itself uses (all_gather over workers within each
+        # model-group rank) — a host reshape of the boxed chunks would
+        # misorder the flat layout under tensor/pipeline sharding.
+        # tree_to_host, not device_get: model-sharded leaves span
+        # non-addressable devices on multi-host
+        return jax.device_get(steps.tree_to_host(
+            self._zero_shadow_fn()(self.step_state)))
+
+    def _zero_shadow_fn(self):
+        if getattr(self, "_zero_shadow_jit", None) is None:
+            from jax.sharding import PartitionSpec as P
+            pspecs = self.param_specs()
+            out_specs = pspecs if pspecs is not None else \
+                jax.tree.map(lambda _: P(), self.params)
+            state_spec = self._state_specs or {
+                k: P(WORKER_AXIS)
+                for k in ("params", "opt_state", "bn_state", "extra")}
+
+            maxes = tuple(a for a in self.mesh.axis_names
+                          if a != WORKER_AXIS)
+
+            def body(state):
+                params = steps.unbox(state["params"])
+                shadow = steps.unbox(state["opt_state"])["opt"]["ema"]
+                full = jax.lax.all_gather(shadow, WORKER_AXIS, tiled=True)
+                tree = helper_funcs.unflatten_like(params, full)
+                # the gather makes leaves worker-invariant (and replicated
+                # leaves model-invariant) SEMANTICALLY, but the vma tracking
+                # can't prove it — anchor each leaf bit-exactly over the
+                # axes its out_spec claims replication on
+                if pspecs is None:
+                    return jax.tree.map(
+                        lambda v: steps.anchor_invariant(
+                            v, (WORKER_AXIS,) + maxes), tree)
+                return jax.tree.map(
+                    lambda s, v: steps.anchor_invariant(
+                        v, (WORKER_AXIS,) + tuple(
+                            a for a in maxes
+                            if not steps.spec_mentions(s, (a,)))),
+                    pspecs, tree, is_leaf=steps._is_spec)
+
+            self._zero_shadow_jit = jax.jit(jax.shard_map(
+                body, mesh=self.mesh, in_specs=(state_spec,),
+                out_specs=out_specs))
+        return self._zero_shadow_jit
 
     def next_exchange_key(self):
         self._exch_key, sub = jax.random.split(self._exch_key)

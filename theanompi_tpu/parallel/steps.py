@@ -105,16 +105,54 @@ def state_partition_specs(model, exchanger, axis: str = WORKER_AXIS):
                 for k in ("params", "opt_state", "bn_state", "extra")}
 
     from ..utils.opt import opt_state_specs
+    if not model.config.get("zero_opt", False):
+        ospecs = opt_state_specs(model.optimizer, pspecs)
+        if model.config.get("ema_decay"):
+            # ema_wrap nests the base layout and adds a param-shaped shadow
+            ospecs = {"inner": ospecs, "ema": pspecs, "t": P()}
+    else:
+        # zero1 replaces the layout with flat chunk vectors: every rank-1
+        # leaf is [model_shards·chunk], one chunk per model-group rank —
+        # sharded over ALL non-worker mesh axes so each device unboxes its
+        # own [chunk]; scalars (adam/ema step counts) stay replicated.
+        # eval_shape on the wrapped init derives the exact layout for any
+        # inner optimizer/wrapper combination without running it.
+        maxes = tuple(a for a in model.mesh.axis_names if a != axis)
+        shapes = jax.eval_shape(model.opt.init, model.params)
+        ospecs = jax.tree.map(
+            lambda l: P(maxes) if l.ndim else P(), shapes)
     bn = jax.tree.map(lambda x: P(), model.bn_state)
     return {"params": boxed_specs(pspecs, axis),
-            "opt_state": boxed_specs(opt_state_specs(model.optimizer,
-                                                     pspecs), axis),
+            "opt_state": boxed_specs(ospecs, axis),
             "bn_state": boxed_specs(bn, axis),
             "extra": boxed_specs(exchanger.extra_specs(pspecs), axis)}
 
 
 def _is_spec(x) -> bool:
     return x is None or isinstance(x, P)
+
+
+def spec_mentions(s, axes) -> bool:
+    """True when PartitionSpec ``s`` shards over any of ``axes`` (entries
+    may be axis names or tuples of axis names)."""
+    for e in (s or ()):
+        for a in (e if isinstance(e, (tuple, list)) else (e,)):
+            if a in axes:
+                return True
+    return False
+
+
+def anchor_invariant(value, axes):
+    """Re-establish the statically-known invariance of ``value`` over mesh
+    ``axes`` when it is SEMANTICALLY replicated there but the vma tracking
+    lost the proof (e.g. after a flatten that joined sharded and replicated
+    leaves).  ``psum(where(rank==0, v, 0))`` is bit-exact for any axis size
+    (v + zeros) and marks the output invariant; all_gather+[0] does not."""
+    if not axes:
+        return value
+    from jax import lax
+    r0 = sum(lax.axis_index(a) for a in axes) == 0
+    return lax.psum(jnp.where(r0, value, jnp.zeros_like(value)), axes)
 
 
 def local_param_template(params, pspecs, mesh: Mesh):

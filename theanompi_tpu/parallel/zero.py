@@ -27,6 +27,7 @@ update math on disjoint chunks; no reduction-order change) and is pinned in
 
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 from jax import lax
 
@@ -36,21 +37,34 @@ from .mesh import WORKER_AXIS
 
 
 def zero1(opt: OptPair, n_workers: int, params_template,
-          axis: str = WORKER_AXIS) -> OptPair:
+          axis: str = WORKER_AXIS, model_shards: int = 1,
+          pspecs=None, model_axes: tuple = ()) -> OptPair:
     """Wrap ``opt`` so its state lives sharded over ``axis``.
 
     ``params_template`` fixes the flat layout (chunk size = ceil(P/N)); the
     wrapped pair plugs into the standard step machinery unchanged — the
     boxed ``[n_workers, ...]`` state axis is the ZeRO partition.
+
+    Model parallelism (round-4): under tensor/pipeline param specs the
+    per-device params are already the LOCAL shard, so ``params_template``
+    must be the local template (``steps.local_param_template``) and
+    ``update`` composes unchanged — flatten local, slice my worker chunk,
+    all-gather over workers rebuilds the local flat.  Only ``init`` differs:
+    the HOST state template must be global-shaped, ``model_shards`` × the
+    chunk (one chunk per model-group rank), laid out so the boxed spec
+    ``P(workers, <model axes>)`` hands each device exactly its chunk
+    (``steps.state_partition_specs``).
     """
     n_total = helper_funcs.tree_size(params_template)
     chunk = -(-n_total // n_workers)            # ceil
     padded = chunk * n_workers
 
     def init(params):
-        # per-worker view: state for ONE chunk (boxed to [n_workers, chunk]
-        # by the step machinery, i.e. each chip holds exactly its shard)
-        return {"opt": opt.init(jnp.zeros((chunk,), jnp.float32))}
+        # per-worker view: state for ONE chunk per model-group rank (boxed
+        # to [n_workers, model_shards·chunk] by the step machinery and
+        # sharded so each chip holds exactly its [chunk] shard)
+        return {"opt": opt.init(
+            jnp.zeros((model_shards * chunk,), jnp.float32))}
 
     def update(grads, st, params, lr):
         flat_g = helper_funcs.flatten_tree(grads, pad_to_multiple_of=padded)
@@ -61,6 +75,24 @@ def zero1(opt: OptPair, n_workers: int, params_template,
         my_p_new, opt_state = opt.update(my_g, st["opt"], my_p, lr)
         full = lax.all_gather(my_p_new, axis, tiled=True)       # [padded]
         new_params = helper_funcs.unflatten_like(params, full)
+        if model_axes and pspecs is not None:
+            # the flat concat JOINS every leaf's varying-mesh-axes set, so
+            # leaves replicated over a model axis (LN scales, biases)
+            # come back statically unprovable as invariant even though
+            # their values are (grads of replicated leaves are psum'd over
+            # model in the tp backward).  Re-anchor each leaf bit-exactly
+            # (steps.anchor_invariant) over exactly the model axes its spec
+            # does NOT shard — per axis, so a 3-D mesh leaf sharded over
+            # 'pipe' but replicated over 'model' anchors on 'model' only.
+            from .steps import _is_spec, anchor_invariant, spec_mentions
+
+            def anchor(s, v):
+                axes = tuple(a for a in model_axes
+                             if not spec_mentions(s, (a,)))
+                return anchor_invariant(v, axes)
+
+            new_params = jax.tree.map(anchor, pspecs, new_params,
+                                      is_leaf=_is_spec)
         return new_params, {"opt": opt_state}
 
     return OptPair(init, update)
