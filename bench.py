@@ -300,7 +300,12 @@ def main() -> int:
     import importlib
 
     rule = os.environ.get("BENCH_RULE", "bsp")
-    mesh = worker_mesh()
+    # model-parallel bench rows (tp/pp/sp in BENCH_CFG) shape the mesh
+    cfg_env = json.loads(os.environ.get("BENCH_CFG", "{}"))
+    mesh = worker_mesh(cfg_env.get("n_workers"),
+                       tp=int(cfg_env.get("tp", 1)),
+                       pp=int(cfg_env.get("pp", 1)),
+                       sp=int(cfg_env.get("sp", 1)))
     n_chips = mesh.shape[WORKER_AXIS]
     if not _force_cpu() and jax.devices()[0].platform != "tpu":
         # a wedged tunnel can fall back to the CPU backend with only a
@@ -368,11 +373,12 @@ def main() -> int:
             n_images = int(dev_batch["y"].shape[0])
         elif spc > 1:
             batches = [model.data.next_train_batch(j) for j in range(spc)]
-            dev_batch = steps.put_batch_stack(mesh, batches)
+            dev_batch = steps.put_batch_stack(mesh, batches,
+                                              model.batch_spec())
             n_images = int(batches[0]["y"].shape[0]) * spc
         else:
             batch = model.data.next_train_batch(0)
-            dev_batch = steps.put_batch(mesh, batch)
+            dev_batch = steps.put_batch(mesh, batch, model.batch_spec())
             n_images = int(batch["y"].shape[0])
         lr = jnp.float32(model.current_lr)
         rng = jax.random.key(0)

@@ -195,3 +195,12 @@ def test_imagenet_synthetic_host_slices(tmp_path):
     again = ImageNet_data({**cfg, "process_count": 2, "process_index": 0},
                           batch_size=4, crop=8).next_train_batch(0)
     np.testing.assert_array_equal(a["x"], again["x"])   # deterministic
+
+
+def test_two_process_spc_matches_single_step():
+    """round-4 (verdict #4): steps_per_call=2 on the REAL 2-process
+    jax.distributed path — per-host batch stacks stitched by
+    put_batch_stack — must match the spc=1 single-process oracle
+    bit-for-bit (same data order, same per-step RNG folding)."""
+    from tests.twoproc_model import fingerprint_after_steps
+    _run_twoproc_and_compare("spc", fingerprint_after_steps(n_workers=4))

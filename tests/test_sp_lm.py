@@ -132,3 +132,23 @@ def test_sp_with_async_rule_smoke(mesh8):
     model.begin_val()
     model.val_iter(0, None)
     model.end_val()
+
+
+def test_sp_composes_with_steps_per_call(mesh8):
+    """round-4 (verdict #4): the multi-step dispatch stacks sequence-
+    parallel batches P(None, workers, seq) and must trace the same params
+    as single-step dispatch on the same sp layout."""
+    one = _make(dp=2, sp=4)
+    c1 = _train_steps(one, BSP_Exchanger(one.config), 4)
+    spc = _make(dp=2, sp=4, steps_per_call=2)
+    spc.compile_iter_fns(BSP_Exchanger(spc.config))
+    spc.data.shuffle_data(0)
+    for count in (1, 3):              # each call covers steps {c-1, c}
+        spc.train_iter(count, None)
+    from theanompi_tpu.parallel import steps
+    p1 = steps.unbox(jax.device_get(steps.tree_to_host(
+        one.step_state["params"])))
+    p2 = steps.unbox(jax.device_get(steps.tree_to_host(
+        spc.step_state["params"])))
+    jax.tree.map(lambda a, b: np.testing.assert_allclose(
+        np.asarray(a), np.asarray(b), rtol=1e-6, atol=1e-7), p1, p2)

@@ -39,6 +39,11 @@ def main() -> int:
     elif mode == "pp":
         from tests.twoproc_model import fingerprint_after_steps_pp
         fp = fingerprint_after_steps_pp(dp=2, pp=2)
+    elif mode == "spc":
+        # multi-step dispatch on the multi-host path: each host stacks its
+        # k local batches, put_batch_stack stitches [k, global, ...]
+        from tests.twoproc_model import fingerprint_after_steps
+        fp = fingerprint_after_steps(n_workers=4, steps_per_call=2)
     else:
         from tests.twoproc_model import fingerprint_after_steps
         fp = fingerprint_after_steps(n_workers=4)

@@ -8,9 +8,12 @@ single-process mode).
 from __future__ import annotations
 
 
-def _train_and_fingerprint(m, exchanger, n_steps: int) -> dict:
+def _train_and_fingerprint(m, exchanger, n_steps: int,
+                           steps_per_call: int = 1) -> dict:
     """Shared tail: compile, train ``n_steps``, gather multi-host, and
-    fingerprint the params (per-leaf sums + first elements)."""
+    fingerprint the params (per-leaf sums + first elements).  With
+    ``steps_per_call=k`` each call's count names its LAST step, so the
+    counts stride by k over the same step indices."""
     import jax
     import numpy as np
 
@@ -18,7 +21,7 @@ def _train_and_fingerprint(m, exchanger, n_steps: int) -> dict:
 
     m.compile_iter_fns(exchanger)
     m.data.shuffle_data(0)
-    for i in range(1, n_steps + 1):
+    for i in range(steps_per_call, n_steps + 1, steps_per_call):
         m.train_iter(i, None)
     host = steps.tree_to_host(m.step_state["params"])
     leaves = jax.tree_util.tree_leaves(jax.device_get(host))
@@ -26,7 +29,8 @@ def _train_and_fingerprint(m, exchanger, n_steps: int) -> dict:
             "first": [float(np.asarray(l).reshape(-1)[0]) for l in leaves]}
 
 
-def fingerprint_after_steps(n_workers: int, n_steps: int = 2) -> dict:
+def fingerprint_after_steps(n_workers: int, n_steps: int = 2,
+                            steps_per_call: int = 1) -> dict:
     """Run ``n_steps`` BSP iterations on a tiny MLP over ``n_workers`` and
     return a params fingerprint (per-leaf sums + first elements) computed
     from the gathered global state."""
@@ -69,8 +73,10 @@ def fingerprint_after_steps(n_workers: int, n_steps: int = 2) -> dict:
             self.data = Data(self.config, self.batch_size)
 
     mesh = worker_mesh(n_workers)
-    config = {"mesh": mesh, "size": n_workers, "rank": 0, "verbose": False}
-    return _train_and_fingerprint(M(config), BSP_Exchanger(config), n_steps)
+    config = {"mesh": mesh, "size": n_workers, "rank": 0, "verbose": False,
+              "steps_per_call": steps_per_call}
+    return _train_and_fingerprint(M(config), BSP_Exchanger(config), n_steps,
+                                  steps_per_call)
 
 
 def _lm_fingerprint(dp: int, n_steps: int, **parallel_kw) -> dict:

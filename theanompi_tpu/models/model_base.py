@@ -255,8 +255,9 @@ class ModelBase:
                 "(BSP grads mode); post-step collectives have a cadence "
                 "the in-call scan would skip")
             # fail before cluster/device setup, not at the first step
-            assert jax.process_count() == 1, \
-                "steps_per_call > 1 is single-process for now"
+            assert jax.process_count() == 1 or self.batch_spec() is None, (
+                "steps_per_call > 1 with custom batch specs (sequence "
+                "parallelism) is single-process for now")
             if self.data is not None:
                 assert spc <= self.data.n_batch_train, (
                     f"steps_per_call={spc} exceeds n_batch_train="
@@ -288,7 +289,8 @@ class ModelBase:
             dev_batch = batch if steps.is_device_batch(batch) \
                 else steps.put_batch(self.mesh, batch, self.batch_spec())
         else:
-            dev_batch = steps.put_batch_stack(self.mesh, batches)
+            dev_batch = steps.put_batch_stack(self.mesh, batches,
+                                              self.batch_spec())
         self.step_state, cost, err = self.train_fn(
             self.step_state, dev_batch, jnp.float32(self.current_lr),
             self._step_rng, jnp.int32(count))

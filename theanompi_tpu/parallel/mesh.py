@@ -160,14 +160,17 @@ def shard_batch(mesh: Mesh, batch, axis_name: str = WORKER_AXIS):
     return jax.tree.map(lambda x: jax.device_put(x, sh), batch)
 
 
-def make_per_host_array(mesh: Mesh, local_batch, axis_name: str = WORKER_AXIS):
+def make_per_host_array(mesh: Mesh, local_batch, axis_name: str = WORKER_AXIS,
+                        sharding: NamedSharding = None):
     """Assemble a global array from per-host local shards (multi-host path).
 
     Reference equivalent: there is none needed — each MPI rank simply owned
     its slice.  Under single-controller JAX the per-host loader output is
     stitched into one global ``jax.Array`` without copying across hosts.
+    ``sharding`` overrides the default worker row split (``put_batch_stack``
+    stitches ``[k, global_rows, ...]`` stacks with a leading scan dim).
     """
-    sh = batch_sharding(mesh, axis_name)
+    sh = sharding if sharding is not None else batch_sharding(mesh, axis_name)
     return jax.tree.map(
         lambda x: jax.make_array_from_process_local_data(sh, np.asarray(x)), local_batch
     )

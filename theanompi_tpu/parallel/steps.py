@@ -308,13 +308,10 @@ def build_train_step(mesh: Mesh, model, exchanger, n_steps: int = 1) -> Callable
 
     state_spec = state_partition_specs(model, exchanger, axis)
     bs = model.batch_spec()
-    if bs is not None:
-        assert n_steps == 1, \
-            "custom batch specs (sequence parallelism) compose with " \
-            "steps_per_call in a later round"
-        batch_spec = bs
-    else:
-        batch_spec = P(axis) if n_steps == 1 else P(None, axis)
+    base = tuple(bs) if bs is not None else (axis,)
+    # n_steps > 1 prefixes the scan dim (round-4: composes with custom
+    # batch specs — a sequence-parallel stack is P(None, workers, seq))
+    batch_spec = P(*base) if n_steps == 1 else P(None, *base)
     sm = jax.shard_map(
         per_worker, mesh=mesh,
         in_specs=(state_spec, batch_spec, P(), P(), P()),
@@ -362,15 +359,25 @@ def is_device_batch(batch) -> bool:
     return bool(leaves) and isinstance(leaves[0], jax.Array)
 
 
-def put_batch_stack(mesh: Mesh, batches):
+def put_batch_stack(mesh: Mesh, batches, spec=None):
     """Stack k per-step batches into ``[k, ...]`` leaves for a
-    ``steps_per_call`` multi-step dispatch, sharded ``P(None, workers)``
-    (scan slices the leading axis; each slice splits across workers).
-    Single-process only — the multi-host per-host stitch composes with
-    single-step dispatch."""
-    assert jax.process_count() == 1, \
-        "steps_per_call > 1 is single-process for now"
-    sh = NamedSharding(mesh, P(None, WORKER_AXIS))
+    ``steps_per_call`` multi-step dispatch, sharded ``P(None, *base)``
+    (scan slices the leading axis; each slice splits per ``spec`` —
+    default ``P(workers)`` row split, sequence-parallel models also cut
+    the time dim).
+
+    Multi-host (round-4): each host stacks its k LOCAL batches and the
+    global ``[k, global_rows, ...]`` array is stitched from per-process
+    shards without cross-host copies (same contract as ``put_batch``)."""
+    base = tuple(spec) if spec is not None else (WORKER_AXIS,)
+    sh = NamedSharding(mesh, P(None, *base))
+    if jax.process_count() > 1:
+        assert spec is None, \
+            "custom batch specs are single-process for now"
+        from .mesh import make_per_host_array
+        local = jax.tree.map(
+            lambda *xs: np.stack([np.asarray(x) for x in xs]), *batches)
+        return make_per_host_array(mesh, local, sharding=sh)
     if all(is_device_batch(b) for b in batches):
         return jax.tree.map(
             lambda *xs: jax.device_put(jnp.stack(xs), sh), *batches)
