@@ -1,0 +1,76 @@
+"""Subprocess body for the cross-process async-center test (not a test
+file).  Each process is an INDEPENDENT JAX runtime (no jax.distributed —
+that is the point: the only coupling is the center socket, exactly like the
+reference's worker nodes talking to the server rank over MPI).
+
+argv: proc_id center_addr rule throttle_s run_seconds
+Prints one JSON line with the island stats.
+"""
+
+import json
+import os
+import sys
+
+
+def main() -> int:
+    proc_id = int(sys.argv[1])
+    addr = sys.argv[2]
+    rule = sys.argv[3]
+    throttle = float(sys.argv[4])
+    seconds = float(sys.argv[5])
+
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    import numpy as np
+
+    import jax.numpy as jnp
+    from theanompi_tpu.models import layers as L
+    from theanompi_tpu.models.data import DataBase
+    from theanompi_tpu.models.model_base import ModelBase
+    from theanompi_tpu.parallel.async_easgd import AsyncEASGDTrainer
+
+    class Data(DataBase):
+        def __init__(self, config=None, batch_size=8):
+            super().__init__(config, batch_size)
+            r = np.random.RandomState(7)
+            w = r.randn(12)
+            rr = np.random.RandomState(11)
+            x = rr.randn(128, 12).astype(np.float32)
+            self.x_train, self.y_train = x, (x @ w > 0).astype(np.int32)
+            self.x_val, self.y_val = x, self.y_train
+            self._finalize()
+
+    class M(ModelBase):
+        batch_size = 8
+        n_subb = 1
+        learning_rate = 0.05
+        momentum = 0.9
+        weight_decay = 0.0
+        seed = 3                       # SHARED across processes: same init
+
+        def build_model(self):
+            self.seq = L.Sequential([
+                L.FC(12, 16, w_init="he", compute_dtype=jnp.float32,
+                     name="fc1"),
+                L.FC(16, 2, w_init=("normal", 0.01), activation=None,
+                     compute_dtype=jnp.float32, name="out"),
+            ])
+            self.data = Data(self.config, self.batch_size)
+
+    tr = AsyncEASGDTrainer(M, {
+        "async_islands": 1, "alpha": 0.5, "sync_freq": 2,
+        "center_addr": addr, "island_base": proc_id, "verbose": False,
+    }, rule=rule)
+    # throttle keys are LOCAL island indices (this process runs 1 island)
+    tr.run_for(seconds, throttle={0: throttle} if throttle else None)
+    st = tr.stats()
+    print("ST " + json.dumps({"proc": proc_id, **st}), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

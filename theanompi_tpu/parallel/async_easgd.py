@@ -33,6 +33,23 @@ the point of — asynchronous EASGD.
 Config surface (run via :class:`AsyncEASGDTrainer` or the ``EASGD`` rule
 with ``easgd_mode='async'``): ``async_islands`` (number of islands),
 ``alpha``, ``sync_freq``.
+
+Round-4 extensions:
+
+* **ASGD islands** (``ASGD`` rule, ``asgd_mode='async'`` — or
+  ``rule='asgd'`` here): downpour semantics — the island accumulates
+  ``sync_freq`` local steps from an anchor, ships the delta, and resets to
+  the fresh center returned by one atomic ``push_pull`` (the reference's
+  accumulated-gradient round-trip, SURVEY.md §2.2 — asynchrony is ASGD's
+  defining property there).
+* **Cross-process centers** (``parallel.center_server``): ``center_serve``
+  exposes this process's center over TCP; ``center_addr='host:port'``
+  joins a remote one — islands in launcher-supervised subprocesses or on
+  other hosts exchange with ONE center, the reference's server-rank
+  topology.  ``island_base`` offsets island ids (and data streams) so
+  processes don't collide.
+* Throughput: ``scripts/async_vs_sync_easgd.py`` records island-mode vs
+  sync-cadence aggregate samples/sec on the same devices.
 """
 
 from __future__ import annotations
@@ -54,40 +71,87 @@ class ElasticCenter:
     Thread-safe: islands call :meth:`pull` / :meth:`push_delta` at their own
     cadence; the lock serializes center updates exactly like the reference
     server serving one worker at a time.
+
+    The store is CANONICALLY a flat leaf list (plus the treedef captured
+    from the first tree-shaped caller), so in-process islands (pytree
+    interface) and remote clients (leaf-list wire protocol,
+    ``parallel.center_server``) can share one center in any join order.
     """
 
     def __init__(self, params=None, alpha: float = 0.5):
         self.alpha = float(alpha)
-        self._center = None if params is None else \
-            jax.tree.map(lambda x: np.array(x, np.float32), params)
+        self._leaves: Optional[List[np.ndarray]] = None
+        self._treedef = None
         self._lock = threading.Lock()
         self.n_updates = 0            # exchanges absorbed (all islands)
         self.updates_by_island: Dict[int, int] = {}
+        if params is not None:
+            self.ensure_init(params)
+
+    # -- pytree interface (in-process islands) -----------------------------
 
     def ensure_init(self, params) -> None:
         """Lazy init from the first island to arrive — all islands share the
         model seed, so their initial params (and hence the center) agree;
         avoids building a throwaway probe model just to read its params."""
+        leaves, treedef = jax.tree.flatten(params)
         with self._lock:
-            if self._center is None:
-                self._center = jax.tree.map(
-                    lambda x: np.array(x, np.float32), params)
+            if self._leaves is None:
+                self._leaves = [np.array(x, np.float32) for x in leaves]
+            if self._treedef is None:     # a remote client may have seeded
+                self._treedef = treedef   # the leaves before any local tree
 
     def pull(self):
         with self._lock:
-            assert self._center is not None, "center not initialized yet"
-            return jax.tree.map(np.array, self._center)
+            assert self._leaves is not None, "center not initialized yet"
+            assert self._treedef is not None, \
+                "pull() needs a tree-shaped ensure_init first"
+            return jax.tree.unflatten(self._treedef,
+                                      [np.array(x) for x in self._leaves])
 
     def push_delta(self, delta_mean, island: int) -> None:
         """center += α·mean_i delta_i for one island's workers."""
+        self.push_delta_leaves(jax.tree.leaves(delta_mean), island)
+
+    def push_pull(self, delta_mean, island: int):
+        """ASGD downpour round-trip (≙ the reference server absorbing a
+        worker's accumulated gradients and replying with fresh params):
+        center += mean_i delta_i, return the new center — one atomic op."""
+        leaves = self.push_pull_leaves(jax.tree.leaves(delta_mean), island)
+        assert self._treedef is not None
+        return jax.tree.unflatten(self._treedef, leaves)
+
+    # -- leaf-list interface (the socket server's wire format) --------------
+
+    def ensure_init_leaves(self, leaves: List[np.ndarray]) -> None:
+        with self._lock:
+            if self._leaves is None:
+                self._leaves = [np.array(x, np.float32) for x in leaves]
+
+    def pull_leaves(self) -> List[np.ndarray]:
+        with self._lock:
+            assert self._leaves is not None, "center not initialized yet"
+            return [np.array(x) for x in self._leaves]
+
+    def push_delta_leaves(self, deltas: List[np.ndarray],
+                          island: int) -> None:
         a = self.alpha
         with self._lock:
-            self._center = jax.tree.map(
-                lambda c, d: c + a * np.asarray(d, np.float32),
-                self._center, delta_mean)
+            self._leaves = [c + a * np.asarray(d, np.float32)
+                            for c, d in zip(self._leaves, deltas)]
             self.n_updates += 1
             self.updates_by_island[island] = \
                 self.updates_by_island.get(island, 0) + 1
+
+    def push_pull_leaves(self, deltas: List[np.ndarray],
+                         island: int) -> List[np.ndarray]:
+        with self._lock:
+            self._leaves = [c + np.asarray(d, np.float32)
+                            for c, d in zip(self._leaves, deltas)]
+            self.n_updates += 1
+            self.updates_by_island[island] = \
+                self.updates_by_island.get(island, 0) + 1
+            return [np.array(x) for x in self._leaves]
 
 
 class IslandRunner(threading.Thread):
@@ -102,7 +166,7 @@ class IslandRunner(threading.Thread):
     def __init__(self, island_id: int, model_factory: Callable, config: dict,
                  center: ElasticCenter, sync_freq: int,
                  stop_event: threading.Event,
-                 throttle_s: float = 0.0):
+                 throttle_s: float = 0.0, rule: str = "easgd"):
         super().__init__(daemon=True)
         self.island_id = island_id
         self.config = config
@@ -110,6 +174,7 @@ class IslandRunner(threading.Thread):
         self.sync_freq = int(sync_freq)
         self.stop_event = stop_event
         self.throttle_s = float(throttle_s)   # test hook: deliberate straggler
+        self.rule = rule                      # 'easgd' elastic | 'asgd' downpour
         self.steps_done = 0
         self.exchanges_done = 0
         self.error: Optional[BaseException] = None
@@ -146,6 +211,25 @@ class IslandRunner(threading.Thread):
 
         elastic_fn = jax.jit(elastic)
 
+        # ASGD downpour (reference asgd_worker, SURVEY.md §3.2): the island
+        # accumulates sync_freq local steps from an anchor (the center as of
+        # its last exchange), ships the accumulated delta, and resets to the
+        # fresh center the server returns — one atomic push_pull round-trip.
+        def worker_mean(params_boxed):
+            return jax.tree.map(lambda x: jnp.mean(x, axis=0), params_boxed)
+
+        def rebox(center):
+            return jax.tree.map(
+                lambda c: np.broadcast_to(np.asarray(c, np.float32)[None],
+                                          (n,) + np.shape(c)), center)
+
+        mean_fn = jax.jit(worker_mean)
+        # ASGD anchor is captured at START (== the init center), not lazily
+        # at the first exchange: a concurrent island's push landing before
+        # this island's first exchange would otherwise be subtracted away
+        # and erased from the center
+        anchor = self.center.pull() if self.rule == "asgd" else None
+
         count = 0
         while not self.stop_event.is_set():
             count += 1
@@ -154,12 +238,21 @@ class IslandRunner(threading.Thread):
             if self.throttle_s:
                 time.sleep(self.throttle_s)
             if count % self.sync_freq == 0:
-                center = self.center.pull()
-                new_params, delta_mean = elastic_fn(
-                    model.step_state["params"], center)
-                model.step_state["params"] = new_params
-                self.center.push_delta(jax.device_get(delta_mean),
-                                       self.island_id)
+                if self.rule == "asgd":
+                    mean_p = jax.device_get(mean_fn(
+                        model.step_state["params"]))
+                    delta = jax.tree.map(np.subtract, mean_p, anchor)
+                    anchor = self.center.push_pull(delta, self.island_id)
+                    model.step_state["params"] = jax.tree.map(
+                        lambda x, like: jax.device_put(x, like.sharding),
+                        rebox(anchor), model.step_state["params"])
+                else:
+                    center = self.center.pull()
+                    new_params, delta_mean = elastic_fn(
+                        model.step_state["params"], center)
+                    model.step_state["params"] = new_params
+                    self.center.push_delta(jax.device_get(delta_mean),
+                                           self.island_id)
                 self.exchanges_done += 1
 
 
@@ -171,9 +264,11 @@ class AsyncEASGDTrainer:
     center instead of a server rank.
     """
 
-    def __init__(self, model_factory: Callable, config: Optional[dict] = None):
+    def __init__(self, model_factory: Callable, config: Optional[dict] = None,
+                 rule: str = "easgd"):
         from .mesh import worker_mesh
         self.config = dict(config or {})
+        self.rule = str(self.config.get("async_rule", rule))
         self.n_islands = int(self.config.get("async_islands", 2))
         self.alpha = float(self.config.get("alpha", 0.5))
         self.sync_freq = int(self.config.get("sync_freq", 4))
@@ -192,10 +287,30 @@ class AsyncEASGDTrainer:
         self.stop_event = threading.Event()
         self.islands: List[IslandRunner] = []
 
-        # Center initializes lazily from the first island's params
-        # (ElasticCenter.ensure_init): all islands share the model seed, so
-        # their initial params — and hence the center — agree at t=0.
-        self.center = ElasticCenter(alpha=self.alpha)
+        # Center topology (round-4, verdict #5 — cross-process asynchrony):
+        #   default: in-memory center, islands are threads in THIS process.
+        #   center_serve=true: ALSO serve that center over TCP so islands in
+        #     OTHER processes (launcher-supervised, other hosts) join it.
+        #   center_addr='host:port': no local center — this process's
+        #     islands exchange with the remote server (≙ a reference worker
+        #     node talking to the server rank over MPI).
+        self._server = None
+        addr = self.config.get("center_addr")
+        if addr:
+            from .center_server import RemoteCenter
+            self.center = RemoteCenter(str(addr), alpha=self.alpha)
+        else:
+            # Center initializes lazily from the first island's params
+            # (ensure_init): all islands share the model seed, so their
+            # initial params — and hence the center — agree at t=0.
+            self.center = ElasticCenter(alpha=self.alpha)
+            if self.config.get("center_serve"):
+                from .center_server import CenterServer
+                self._server = CenterServer(center=self.center)
+                host, port = self._server.start(
+                    str(self.config.get("center_host", "127.0.0.1")),
+                    int(self.config.get("center_port", 0)))
+                self.center_address = f"{host}:{port}"
 
     def _island_config(self, i: int) -> dict:
         from jax.sharding import Mesh
@@ -204,17 +319,24 @@ class AsyncEASGDTrainer:
         cfg["mesh"] = Mesh(devs, (WORKER_AXIS,))
         cfg["size"] = len(devs)
         cfg["rank"] = 0
-        # distinct data stream per island; identical param init (model seeds
-        # params from 'seed' via the factory — keep that shared)
-        cfg["data_seed"] = int(cfg.get("seed", 0)) + i
+        # distinct data stream per island — ACROSS processes too
+        # (island_base offsets ids when several processes share one remote
+        # center); identical param init (model seeds params from 'seed' via
+        # the factory — keep that shared)
+        cfg["data_seed"] = int(cfg.get("seed", 0)) + self._island_base + i
         return cfg
+
+    @property
+    def _island_base(self) -> int:
+        return int(self.config.get("island_base", 0))
 
     def start(self, throttle: Optional[Dict[int, float]] = None) -> None:
         throttle = throttle or {}
         for i in range(self.n_islands):
-            r = IslandRunner(i, self.model_factory, self._island_config(i),
+            r = IslandRunner(self._island_base + i, self.model_factory,
+                             self._island_config(i),
                              self.center, self.sync_freq, self.stop_event,
-                             throttle_s=throttle.get(i, 0.0))
+                             throttle_s=throttle.get(i, 0.0), rule=self.rule)
             self.islands.append(r)
             r.start()
 
@@ -222,6 +344,15 @@ class AsyncEASGDTrainer:
         self.stop_event.set()
         for r in self.islands:
             r.join(timeout=timeout)
+        if hasattr(self.center, "close"):   # RemoteCenter: snapshot the
+            try:                            # stats, then drop the socket
+                self._center_updates_final = self.center.n_updates
+            except Exception:
+                pass
+            self.center.close()
+        if self._server is not None and not self.config.get(
+                "center_keep_serving"):
+            self._server.stop()
         for r in self.islands:
             if r.error is not None:
                 raise r.error
@@ -243,10 +374,13 @@ class AsyncEASGDTrainer:
     # per-iteration curves — the islands run headless threads).
 
     def stats(self) -> dict:
+        cu = getattr(self, "_center_updates_final", None)
+        if cu is None:
+            cu = self.center.n_updates
         return {"islands": [{"island": r.island_id, "steps": r.steps_done,
                              "exchanges": r.exchanges_done}
                             for r in self.islands],
-                "center_updates": self.center.n_updates}
+                "center_updates": cu}
 
     @property
     def epoch_records(self):

@@ -1,0 +1,221 @@
+"""Cross-process elastic center — the reference's EASGD/ASGD *server* over
+a socket.
+
+The reference ran a dedicated MPI server RANK holding center parameters;
+workers on other nodes exchanged with it over ``MPI.Send/Recv`` at their own
+pace (SURVEY.md §3.2).  ``async_easgd.ElasticCenter`` restores the algebra
+for islands inside ONE process; this module takes it across processes — the
+launcher's supervised subprocesses, or genuinely different hosts — with:
+
+* :class:`CenterServer` — a TCP server wrapping an :class:`ElasticCenter`,
+  one thread per client connection, the center lock serializing updates
+  exactly like the reference server serving one worker at a time.
+* :class:`RemoteCenter` — a client with the SAME duck-typed surface as
+  ``ElasticCenter`` (``ensure_init`` / ``pull`` / ``push_delta`` /
+  ``push_pull``), so :class:`~.async_easgd.IslandRunner` works unchanged
+  whether its center is in-memory or remote.
+
+Wire format (no pickle — arrays only): each message is
+``[4-byte header len][JSON header][4-byte body len][npz body]`` where the
+npz holds the pytree's leaves keyed by flatten order (``leaf0``, ``leaf1``,
+…).  Both ends run the same model config, so the treedef is shared
+knowledge; the server never needs it (its algebra is leafwise).
+
+Ops: ``init`` (idempotent center seed), ``pull`` → center leaves,
+``push`` (EASGD: center += α·delta_mean), ``push_pull`` (ASGD downpour:
+center += delta_mean, returns the fresh center atomically — the reference's
+accumulated-gradient round-trip), ``stats``.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import socket
+import socketserver
+import struct
+import threading
+from typing import Dict, List, Optional, Tuple
+
+import jax
+import numpy as np
+
+from .async_easgd import ElasticCenter
+
+
+# -- framing ----------------------------------------------------------------
+
+def _pack_leaves(leaves: List[np.ndarray]) -> bytes:
+    buf = io.BytesIO()
+    np.savez(buf, **{f"leaf{i}": np.asarray(x, np.float32)
+                     for i, x in enumerate(leaves)})
+    return buf.getvalue()
+
+
+def _unpack_leaves(body: bytes) -> List[np.ndarray]:
+    if not body:
+        return []
+    with np.load(io.BytesIO(body), allow_pickle=False) as z:
+        return [z[f"leaf{i}"] for i in range(len(z.files))]
+
+
+def _send_msg(sock: socket.socket, header: dict, body: bytes = b"") -> None:
+    h = json.dumps(header).encode()
+    sock.sendall(struct.pack("!I", len(h)) + h
+                 + struct.pack("!I", len(body)) + body)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    chunks = []
+    while n:
+        c = sock.recv(min(n, 1 << 20))
+        if not c:
+            raise ConnectionError("center connection closed mid-message")
+        chunks.append(c)
+        n -= len(c)
+    return b"".join(chunks)
+
+
+def _recv_msg(sock: socket.socket) -> Tuple[dict, bytes]:
+    (hlen,) = struct.unpack("!I", _recv_exact(sock, 4))
+    header = json.loads(_recv_exact(sock, hlen))
+    (blen,) = struct.unpack("!I", _recv_exact(sock, 4))
+    return header, _recv_exact(sock, blen) if blen else b""
+
+
+# -- server -----------------------------------------------------------------
+
+class CenterServer:
+    """Serve an :class:`ElasticCenter` over TCP (≙ the reference's server
+    rank).  ``start()`` binds and returns ``(host, port)``; serving happens
+    on daemon threads, one per connection."""
+
+    def __init__(self, alpha: float = 0.5,
+                 center: Optional[ElasticCenter] = None):
+        # pass an existing center to ALSO serve in-process islands' store
+        # (AsyncEASGDTrainer center_serve mode) — leaf-list wire ops and
+        # pytree local ops share the canonical flat store
+        self.center = center if center is not None \
+            else ElasticCenter(alpha=alpha)
+        self._srv: Optional[socketserver.ThreadingTCPServer] = None
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self, host: str = "127.0.0.1", port: int = 0) -> Tuple[str, int]:
+        center = self.center
+
+        class Handler(socketserver.BaseRequestHandler):
+            def handle(self):          # one connection: a request loop
+                try:
+                    while True:
+                        header, body = _recv_msg(self.request)
+                        op = header.get("op")
+                        if op == "init":
+                            center.ensure_init_leaves(_unpack_leaves(body))
+                            _send_msg(self.request, {"ok": True})
+                        elif op == "pull":
+                            _send_msg(self.request, {"ok": True},
+                                      _pack_leaves(center.pull_leaves()))
+                        elif op == "push":
+                            center.push_delta_leaves(_unpack_leaves(body),
+                                                     int(header["island"]))
+                            _send_msg(self.request, {"ok": True})
+                        elif op == "push_pull":
+                            leaves = center.push_pull_leaves(
+                                _unpack_leaves(body), int(header["island"]))
+                            _send_msg(self.request, {"ok": True},
+                                      _pack_leaves(leaves))
+                        elif op == "stats":
+                            _send_msg(self.request, {
+                                "ok": True,
+                                "n_updates": center.n_updates,
+                                "by_island": center.updates_by_island})
+                        else:
+                            _send_msg(self.request,
+                                      {"ok": False,
+                                       "error": f"unknown op {op!r}"})
+                except (ConnectionError, OSError):
+                    return             # client went away — fine
+
+        socketserver.ThreadingTCPServer.allow_reuse_address = True
+        self._srv = socketserver.ThreadingTCPServer((host, port), Handler)
+        self._srv.daemon_threads = True
+        self._thread = threading.Thread(target=self._srv.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+        return self._srv.server_address[:2]
+
+    def stop(self) -> None:
+        if self._srv is not None:
+            self._srv.shutdown()
+            self._srv.server_close()
+            self._srv = None
+
+
+# -- client -----------------------------------------------------------------
+
+class RemoteCenter:
+    """``ElasticCenter``-shaped client: every call is one request/response
+    round-trip on a persistent connection (a lock serializes this process's
+    callers; the SERVER's lock serializes across processes)."""
+
+    def __init__(self, addr: str, alpha: float = 0.5,
+                 connect_timeout: float = 30.0):
+        host, port = addr.rsplit(":", 1)
+        self.alpha = float(alpha)      # kept for IslandRunner's elastic math
+        self._treedef = None
+        self._lock = threading.Lock()
+        self._sock = socket.create_connection((host, int(port)),
+                                              timeout=connect_timeout)
+        self._sock.settimeout(None)
+
+    def _roundtrip(self, header: dict, body: bytes = b"") -> Tuple[dict, bytes]:
+        with self._lock:
+            _send_msg(self._sock, header, body)
+            resp, rbody = _recv_msg(self._sock)
+        if not resp.get("ok"):
+            raise RuntimeError(f"center server error: {resp.get('error')}")
+        return resp, rbody
+
+    def _leaves(self, tree) -> Tuple[List[np.ndarray], object]:
+        leaves, treedef = jax.tree.flatten(tree)
+        return [np.asarray(x, np.float32) for x in leaves], treedef
+
+    def ensure_init(self, params) -> None:
+        leaves, self._treedef = self._leaves(params)
+        self._roundtrip({"op": "init"}, _pack_leaves(leaves))
+
+    def pull(self):
+        _, body = self._roundtrip({"op": "pull"})
+        leaves = _unpack_leaves(body)
+        assert self._treedef is not None, "pull before ensure_init"
+        return jax.tree.unflatten(self._treedef, leaves)
+
+    def push_delta(self, delta_mean, island: int) -> None:
+        leaves, _ = self._leaves(delta_mean)
+        self._roundtrip({"op": "push", "island": island},
+                        _pack_leaves(leaves))
+
+    def push_pull(self, delta_mean, island: int):
+        leaves, _ = self._leaves(delta_mean)
+        _, body = self._roundtrip({"op": "push_pull", "island": island},
+                                  _pack_leaves(leaves))
+        assert self._treedef is not None, "push_pull before ensure_init"
+        return jax.tree.unflatten(self._treedef, _unpack_leaves(body))
+
+    def stats(self) -> dict:
+        resp, _ = self._roundtrip({"op": "stats"})
+        return resp
+
+    @property
+    def n_updates(self) -> int:
+        return int(self.stats()["n_updates"])
+
+    @property
+    def updates_by_island(self) -> Dict[int, int]:
+        return {int(k): v for k, v in self.stats()["by_island"].items()}
+
+    def close(self) -> None:
+        try:
+            self._sock.close()
+        except OSError:
+            pass

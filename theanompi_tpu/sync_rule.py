@@ -59,33 +59,52 @@ class BSP(SyncRule):
     rule = "bsp"
 
 
+def _run_async_islands(rule_obj, rule_name: str):
+    """Shared async-island tail for EASGD/ASGD (``parallel.async_easgd``).
+    ``center_serve=true`` additionally serves the center over TCP;
+    ``center_addr='host:port'`` joins a remote center instead — the
+    cross-process topology of the reference's server rank."""
+    import importlib
+
+    from .parallel.async_easgd import AsyncEASGDTrainer
+
+    mod = importlib.import_module(rule_obj.modelfile)
+    cls = getattr(mod, rule_obj.modelclass)
+    cfg = dict(rule_obj.config)
+    cfg.pop("mesh", None)
+    rule_obj.trainer = AsyncEASGDTrainer(cls, cfg, rule=rule_name)
+    rule_obj.trainer.run_for(float(cfg.get("run_seconds", 60.0)))
+    return rule_obj.trainer
+
+
 class EASGD(SyncRule):
     """``easgd_mode='sync'`` (default): in-mesh synchronous-cadence elastic
     averaging.  ``easgd_mode='async'``: genuinely asynchronous worker islands
     around a host-side center (``parallel.async_easgd``) — ``async_islands``
     and ``sync_freq`` control the topology/cadence, ``run_seconds`` the
-    wall-clock budget."""
+    wall-clock budget; ``center_serve``/``center_addr`` take the center
+    across processes (``parallel.center_server``)."""
 
     rule = "easgd"
 
     def wait(self):
         if self.config.get("easgd_mode", "sync") != "async":
             return super().wait()
-        import importlib
-
-        from .parallel.async_easgd import AsyncEASGDTrainer
-
-        mod = importlib.import_module(self.modelfile)
-        cls = getattr(mod, self.modelclass)
-        cfg = dict(self.config)
-        cfg.pop("mesh", None)
-        self.trainer = AsyncEASGDTrainer(cls, cfg)
-        self.trainer.run_for(float(cfg.get("run_seconds", 60.0)))
-        return self.trainer
+        return _run_async_islands(self, "easgd")
 
 
 class ASGD(SyncRule):
+    """``asgd_mode='async'``: downpour worker islands — each island
+    accumulates ``sync_freq`` local steps, ships the delta to the (possibly
+    remote) center, and resets to the returned fresh center; asynchrony is
+    ASGD's defining property in the reference (SURVEY.md §2.2)."""
+
     rule = "asgd"
+
+    def wait(self):
+        if self.config.get("asgd_mode", "sync") != "async":
+            return super().wait()
+        return _run_async_islands(self, "asgd")
 
 
 class GOSGD(SyncRule):
