@@ -338,6 +338,10 @@ def main() -> int:
         config["steps_per_call"] = int(os.environ["BENCH_SPC"])
     if os.environ.get("BENCH_BN_DTYPE"):
         config["bn_norm_dtype"] = os.environ["BENCH_BN_DTYPE"]
+    if os.environ.get("BENCH_WIRE_U8") == "1":
+        # u8-wire staging: host ships uint8 crops, device casts+subtracts
+        # (4× smaller host→device transfers — the real-data lever)
+        config["aug_wire_u8"] = True
     real_data = os.environ.get("BENCH_REAL_DATA") == "1"
     if real_data:
         # verdict #3: drive the TPU from DISK — real batch files through the

@@ -41,6 +41,9 @@ run resnet50-b32-gosgd       BENCH_MODEL=resnet50 BENCH_RULE=gosgd
 
 # -- real-data path (verdict #3): .hkl shards -> native loader -> device --
 run alexnet-b128-realdata    BENCH_MODEL=alexnet BENCH_REAL_DATA=1
+# u8-wire A/B: ship uint8 crops, cast+mean-subtract on device (4x smaller
+# host->device transfers; the tunnel-attached chip should feel this most)
+run alexnet-b128-realdata-u8w BENCH_MODEL=alexnet BENCH_REAL_DATA=1 BENCH_WIRE_U8=1
 
 # -- transformer family (beyond-parity; value = sequences/sec/chip) --
 run transformer_lm-b16       BENCH_MODEL=transformer_lm BENCH_BATCH=16 BENCH_CFG="$LM_CFG"

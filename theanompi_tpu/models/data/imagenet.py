@@ -276,6 +276,30 @@ class ImageNet_data:
             oy = np.full(1, (h - c) // 2, np.int32)
             ox = np.full(1, (w - c) // 2, np.int32)
             flip = np.zeros(1, np.uint8)
+        if self.config.get("aug_wire_u8", False):
+            # u8-wire mode (round-4 perf lever): host does ONLY crop+mirror
+            # on uint8 (a gather); mean-subtract+cast happen ON DEVICE,
+            # fused into the first conv by XLA — the host→device transfer
+            # shrinks 4×.  Mean semantics (ModelBase.stage_input): always
+            # the mean image's CENTER-crop window — bit-equal to the fused
+            # f32 pass for scalar means and for aug_per_image mode; a
+            # DOCUMENTED deviation for shared-window draws with a full mean
+            # image, where the f32 pass subtracts the window-exact mean
+            # (shipping the per-batch window would need a replicated batch
+            # leaf; the center window is the aug_per_image approximation).
+            m = oy.shape[0]
+            if m == 1:                     # shared window: one vector slice
+                win = x[:, oy[0]:oy[0] + c, ox[0]:ox[0] + c, :]
+                if flip[0]:
+                    win = win[:, :, ::-1, :]
+                out = np.ascontiguousarray(win)
+            else:
+                out = np.empty((n, c, c, x.shape[3]), np.uint8)
+                for i in range(n):
+                    win = x[i, oy[i]:oy[i] + c, ox[i]:ox[i] + c, :]
+                    out[i] = win[:, ::-1, :] if flip[i] else win
+            return {"x": out,
+                    "y": np.ascontiguousarray(y, dtype=np.int32)}
         mean, mean_scalar = None, 0.0
         m_img = self.img_mean
         if isinstance(m_img, np.ndarray) and m_img.size > 1:

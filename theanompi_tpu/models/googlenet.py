@@ -176,7 +176,8 @@ class GoogLeNet(ModelBase):
         return logits, state
 
     def loss_and_metrics(self, params, bn_state, batch, rng, train):
-        logits, t4a, t4d, rng = self._trunk(params, batch["x"], train, rng)
+        logits, t4a, t4d, rng = self._trunk(
+            params, self.stage_input(batch["x"]), train, rng)
         ls = self._label_smoothing(train)
         cost = L.softmax_cross_entropy(logits, batch["y"], ls)
         if train:

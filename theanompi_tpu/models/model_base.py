@@ -168,10 +168,39 @@ class ModelBase:
         return float(self.config.get("label_smoothing", 0.0)) if train \
             else 0.0
 
+    def _u8_input_mean(self):
+        """Device constant for the u8-wire input path: the mean image's
+        center-crop window (or the scalar mean).  Cached per model.
+        NOTE: for shared-window crops with a full mean image this deviates
+        from the f32 pass's window-exact mean (see data/imagenet.py)."""
+        m = getattr(self, "__u8_mean", None)
+        if m is None:
+            d = getattr(self, "data", None)
+            mi = getattr(d, "img_mean", np.float32(122.0))
+            if isinstance(mi, np.ndarray) and mi.ndim == 3:
+                c = int(getattr(d, "crop", mi.shape[0]))
+                cy, cx = (mi.shape[0] - c) // 2, (mi.shape[1] - c) // 2
+                m = jnp.asarray(mi[cy:cy + c, cx:cx + c, :], jnp.float32)
+            else:
+                m = jnp.float32(mi)
+            setattr(self, "__u8_mean", m)
+        return m
+
+    def stage_input(self, x):
+        """Shared input staging for EVERY loss/metrics path (models with
+        custom heads call this too): u8-wire batches (data/imagenet.py
+        aug_wire_u8) are cast and mean-subtracted on device — the same
+        float32 arithmetic as the host fused pass, fused into the first
+        conv by XLA.  Float inputs pass through untouched."""
+        if x.dtype == jnp.uint8:
+            return x.astype(jnp.float32) - self._u8_input_mean()
+        return x
+
     def loss_and_metrics(self, params, bn_state, batch, rng, train):
         """Default head: softmax cross-entropy + top-1 error."""
-        logits, new_bn = self.apply_model(params, batch["x"], train=train,
-                                          rng=rng, state=bn_state)
+        logits, new_bn = self.apply_model(params, self.stage_input(batch["x"]),
+                                          train=train, rng=rng,
+                                          state=bn_state)
         cost = L.softmax_cross_entropy(logits, batch["y"],
                                        self._label_smoothing(train))
         err = L.errors(logits, batch["y"])
@@ -202,8 +231,8 @@ class ModelBase:
         return new_params, new_opt
 
     def val_metrics(self, params, bn_state, batch):
-        logits, _ = self.apply_model(params, batch["x"], train=False,
-                                     rng=None, state=bn_state)
+        logits, _ = self.apply_model(params, self.stage_input(batch["x"]),
+                                     train=False, rng=None, state=bn_state)
         cost = L.softmax_cross_entropy(logits, batch["y"])
         return cost, (L.errors(logits, batch["y"]),
                       L.errors_top_x(logits, batch["y"], 5))

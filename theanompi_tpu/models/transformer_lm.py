@@ -80,7 +80,17 @@ class Block(L.Layer):
         self.name = name
         self.tp = tp
         self.ln1 = L.LayerNorm(dim, name="ln1")
-        if tp > 1:
+        if tp > 1 and sp > 1:
+            # 3-D data×seq×model: local heads (tp) over local token blocks
+            # (sp) — ring attention on the head shard, row-parallel out psum
+            assert attn_impl == "reference", (
+                f"attn_impl={attn_impl!r} does not apply under sp>1 "
+                "(sequence-sharded attention is the ring kernel)")
+            from ..parallel.sp import TPRingMultiHeadAttention
+            self.attn = TPRingMultiHeadAttention(dim, n_head, tp,
+                                                 compute_dtype=cd,
+                                                 name="attn")
+        elif tp > 1:
             self.attn = tplib.TPMultiHeadAttention(dim, n_head, tp,
                                                    compute_dtype=cd,
                                                    attn_impl=attn_impl,
@@ -227,8 +237,8 @@ class TransformerLM(ModelBase):
                 setattr(self, k, int(self.config[k]))
         if self.sp > 1:
             from ..parallel.mesh import SEQ_AXIS
-            assert self.tp == 1 and self.pp == 1, \
-                "one of tp/pp/sp per mesh for now"
+            assert self.pp == 1, \
+                "sp composes with tp (3-D workers×model×seq) but not pp yet"
             assert self.mesh.shape.get(SEQ_AXIS) == self.sp, (
                 f"sp={self.sp} needs a mesh with a '{SEQ_AXIS}' axis of "
                 f"that size (worker_mesh(n, sp={self.sp})); got "
@@ -379,12 +389,16 @@ class TransformerLM(ModelBase):
         ls = self._label_smoothing(train)
         if self.tp > 1:
             from ..parallel import tp as tplib
-            return tplib.tp_softmax_cross_entropy(
-                flat, y, label_smoothing=ls), \
-                (tplib.tp_errors(flat, y), bn_state)
-        cost = L.softmax_cross_entropy(flat, y, ls)
-        err = L.errors(flat, y)
+            cost = tplib.tp_softmax_cross_entropy(flat, y,
+                                                  label_smoothing=ls)
+            err = tplib.tp_errors(flat, y)
+        else:
+            cost = L.softmax_cross_entropy(flat, y, ls)
+            err = L.errors(flat, y)
         if self.sp > 1:
+            # per-token means are over the LOCAL token block; average the
+            # equal-sized blocks over 'seq' (composes with the tp
+            # vocab-parallel CE above: the two reductions are orthogonal)
             from ..parallel.sp import sp_mean
             cost, err = sp_mean(cost), sp_mean(err)
         return cost, (err, bn_state)
@@ -397,10 +411,12 @@ class TransformerLM(ModelBase):
         y = batch["y"].reshape(-1)
         if self.tp > 1:
             from ..parallel import tp as tplib
-            return tplib.tp_softmax_cross_entropy(flat, y), \
-                (tplib.tp_errors(flat, y), tplib.tp_errors_top_x(flat, y, 5))
-        cost = L.softmax_cross_entropy(flat, y)
-        err, err5 = L.errors(flat, y), L.errors_top_x(flat, y, 5)
+            cost = tplib.tp_softmax_cross_entropy(flat, y)
+            err = tplib.tp_errors(flat, y)
+            err5 = tplib.tp_errors_top_x(flat, y, 5)
+        else:
+            cost = L.softmax_cross_entropy(flat, y)
+            err, err5 = L.errors(flat, y), L.errors_top_x(flat, y, 5)
         if self.sp > 1:
             from ..parallel.sp import sp_mean
             cost, err, err5 = sp_mean(cost), sp_mean(err), sp_mean(err5)

@@ -25,6 +25,7 @@ from jax import lax
 
 from ..models import layers as L
 from .mesh import SEQ_AXIS
+from .tp import TPMultiHeadAttention
 
 
 class RingMultiHeadAttention(L.MultiHeadAttention):
@@ -62,3 +63,27 @@ def sp_mean(x, axis: str = SEQ_AXIS):
     token counts per shard, so the plain mean of means is the global mean);
     marks the result invariant for the step's out-spec typing."""
     return lax.pmean(x, axis)
+
+
+class TPRingMultiHeadAttention(TPMultiHeadAttention):
+    """Head-sharded AND sequence-sharded attention (round-4: 3-D
+    data×seq×model composition).
+
+    ``x`` is ``[B, T/sp, D]`` (this chip's token block) and the weight
+    shards hold ``n_head/tp`` complete heads (``parallel/tp.py`` layout):
+    Q/K/V projections are local in BOTH senses (own tokens, own heads) —
+    the whole TP apply body is inherited — and only the attention itself
+    differs: the exact causal ring over the ``'seq'`` axis on the local
+    heads (the two shardings are orthogonal).  Same init and math as the
+    dense layer.
+    """
+
+    def __init__(self, dim, n_head, tp: int, causal: bool = True,
+                 seq_axis: str = SEQ_AXIS, **kwargs):
+        super().__init__(dim, n_head, tp, causal=causal, **kwargs)
+        self.seq_axis = seq_axis
+
+    def _attend(self, q, k, v):
+        from ..ops.ring_attention import ring_attention
+        return ring_attention(q, k, v, axis=self.seq_axis,
+                              causal=self.causal)
