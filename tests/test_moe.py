@@ -162,3 +162,105 @@ def test_moe_checkpoint_roundtrip(tmp_path, mesh8):
     after = jax.device_get(steps.tree_to_host(model2.step_state["params"]))
     jax.tree.map(lambda a, b: np.testing.assert_array_equal(
         np.asarray(a), np.asarray(b)), before, after)
+
+
+# -- round 4: sequence-sharded MoE (all-to-all dispatch) ---------------------
+
+def _make_sp(dp, sp, tp=1, **kw):
+    mesh = worker_mesh(dp, tp=tp, sp=sp)
+    cfg = {**CFG, "mesh": mesh, "size": dp, "rank": 0, "tp": tp, "sp": sp,
+           **kw}
+    return MoETransformerLM(cfg)
+
+
+def test_moe_sp_a2a_layer_exact_vs_dense(mesh8):
+    """The all-to-all dispatch itself is EXACT: identical inputs route
+    identically, travel to their seq-sharded expert and back, and
+    reproduce the dense layer's output and aux to float noise."""
+    from jax.sharding import Mesh, NamedSharding
+    from jax.sharding import PartitionSpec as P
+    S, B, T, D, E = 4, 16, 16, 32, 4
+    mesh = Mesh(np.array(jax.devices()[:8]).reshape(2, S),
+                ("workers", "seq"))
+    r = np.random.RandomState(0)
+    x = jnp.asarray(r.randn(B, T, D).astype(np.float32))
+    from theanompi_tpu.parallel.moe import MoE
+    dense = MoE(D, E, ep=1, capacity_factor=100.0,
+                compute_dtype=jnp.float32)
+    params = dense.init(jax.random.key(1))
+    y_d, _ = dense.apply(params, x, train=True)
+    sp = MoE(D, E, ep=1, seq_shards=S, seq_axis="seq",
+             capacity_factor=100.0, compute_dtype=jnp.float32)
+    pspec = sp.specs()
+
+    def body(p, xb):
+        y, _aux = sp.apply(p, xb, train=True)
+        return y
+
+    f = jax.jit(jax.shard_map(
+        body, mesh=mesh, in_specs=(pspec, P("workers", "seq", None)),
+        out_specs=P("workers", "seq", None)))
+    pp = {k: jax.device_put(params[k], NamedSharding(mesh, pspec[k]))
+          for k in params}
+    y_s = f(pp, jax.device_put(
+        x, NamedSharding(mesh, P("workers", "seq", None))))
+    np.testing.assert_allclose(np.asarray(y_d), np.asarray(y_s),
+                               rtol=1e-6, atol=1e-7)
+
+
+def test_moe_sp_model_close_to_dense_dropfree(mesh8):
+    """Model-level: ring-vs-dense attention reorders fp32 sums by ~1e-6,
+    and the ARGMAX router amplifies borderline flips into different expert
+    assignments — so tight parity is ill-posed at the model level (the
+    layer is exact above).  The loss curves must still agree loosely."""
+    dense = _make(dp=2, tp=1, capacity_factor=100.0)
+    sp = _make_sp(dp=2, sp=4, capacity_factor=100.0)
+    jax.tree.map(lambda a, b: np.testing.assert_array_equal(
+        np.asarray(a), np.asarray(b)), dense.params, sp.params)
+    c_dense = _train_steps(dense, 4)
+    c_sp = _train_steps(sp, 4)
+    np.testing.assert_allclose(c_sp, c_dense, rtol=2e-2)
+    # expert tables really shard over 'seq'
+    from theanompi_tpu.parallel.mesh import SEQ_AXIS, WORKER_AXIS
+    w1 = sp.step_state["params"]["block1"]["moe"]["w1"]
+    assert w1.sharding.spec == (WORKER_AXIS, SEQ_AXIS), w1.sharding.spec
+
+
+def test_moe_sp_trains_with_default_capacity(mesh8):
+    """Default capacity (tokens drop per source shard): trains finite and
+    the loss decreases; Σ capacity budget matches the replicated path."""
+    m = _make_sp(dp=2, sp=4)
+    costs = _train_steps(m, 6)
+    assert np.isfinite(costs).all()
+    assert np.mean(costs[-3:]) < np.mean(costs[:3])
+    m.begin_val()
+    m.val_iter(0)
+    m.end_val()
+
+
+def test_moe_sp_tp_3d_smoke(mesh8):
+    """sp×tp MoE: experts on 'model', tokens on 'seq' — one full train+val
+    step on the 3-D mesh."""
+    m = _make_sp(dp=2, sp=2, tp=2, moe_every=1)
+    costs = _train_steps(m, 2)
+    assert np.isfinite(costs).all()
+    m.begin_val()
+    m.val_iter(0)
+    m.end_val()
+
+
+def test_moe_sp_uses_global_positions(mesh8):
+    """Regression (round-4 review): MoE's _forward must offset position ids
+    by the seq rank, like the base model.  With an amplified position table
+    the local-positions bug would blow the costs apart; with global
+    positions the sp model tracks the dense one."""
+    dense = _make(dp=2, tp=1, capacity_factor=100.0)
+    sp = _make_sp(dp=2, sp=4, capacity_factor=100.0)
+    # make position embeddings LOUD and position-distinctive
+    amp = np.outer(np.arange(CFG["seq_len"], dtype=np.float32) - 8.0,
+                   np.ones(CFG["d_model"], np.float32))
+    for m in (dense, sp):
+        m.params = dict(m.params, pos={"w": jnp.asarray(amp)})
+    c_d = _train_steps(dense, 1)[0]
+    c_s = _train_steps(sp, 1)[0]
+    assert abs(c_s - c_d) < 0.1 * abs(c_d), (c_d, c_s)

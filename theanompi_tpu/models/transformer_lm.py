@@ -168,14 +168,19 @@ class MoEBlock(Block):
     up to the model's loss head."""
 
     def __init__(self, dim, n_head, n_experts, mlp_ratio=4, cd=jnp.bfloat16,
-                 tp=1, capacity_factor=1.25, attn_impl="reference",
+                 tp=1, sp=1, capacity_factor=1.25, attn_impl="reference",
                  name="moe_block"):
         # attention (and its specs) come from Block; tp doubles as the
-        # expert-parallel degree — both shard over the same 'model' axis
+        # expert-parallel degree — both shard over the same 'model' axis.
+        # sp>1 (round-4): tokens are sequence-sharded — with tp==1 the
+        # experts shard over 'seq' instead (all-to-all dispatch,
+        # parallel/moe.py); with tp>1 they stay on 'model' and only the
+        # aux statistic averages over 'seq'.
         super().__init__(dim, n_head, mlp_ratio=mlp_ratio, cd=cd, tp=tp,
-                         attn_impl=attn_impl, name=name)
+                         sp=sp, attn_impl=attn_impl, name=name)
         from ..parallel.moe import MoE
         self.moe = MoE(dim, n_experts, mlp_ratio=mlp_ratio, ep=tp,
+                       seq_shards=sp,
                        capacity_factor=capacity_factor, compute_dtype=cd,
                        name="moe")
         del self.fc1, self.fc2
@@ -187,10 +192,24 @@ class MoEBlock(Block):
 
     def specs(self):
         s = super().specs()
-        if s is None:
+        ms = self.moe.specs()
+        if s is None and ms is None:
             return None
-        del s["fc1"], s["fc2"]
-        s["moe"] = self.moe.specs()
+        if s is None:
+            # dense attention under sp-sharded experts: attention/LN leaves
+            # replicate, only the expert tables shard (over 'seq') — derive
+            # the replicated skeleton from the real param structure
+            from jax.sharding import PartitionSpec as P
+
+            def skel(layer):
+                return jax.tree.map(lambda _: P(), jax.eval_shape(
+                    layer.init, jax.random.key(0)))
+
+            s = {"ln1": skel(self.ln1), "ln2": skel(self.ln2),
+                 "attn": skel(self.attn)}
+        else:
+            del s["fc1"], s["fc2"]
+        s["moe"] = ms
         return s
 
     def apply(self, params, x, *, train=False, rng=None, state=None):
@@ -294,7 +313,22 @@ class TransformerLM(ModelBase):
     def param_specs(self):
         from jax.sharding import PartitionSpec as P
         if self.pp == 1 and self.tp == 1:
-            return None
+            blk = {b.name: b.specs() for b in self.blocks}
+            if all(v is None for v in blk.values()):
+                return None
+            # sp-sharded MoE experts in an otherwise replicated model
+            # (round-4 all-to-all dispatch): dense/attention leaves get a
+            # replicated skeleton, expert tables their 'seq' specs
+            def skel(b):
+                struct = jax.eval_shape(b.init, jax.random.key(0))
+                return jax.tree.map(lambda _: P(), struct)
+
+            top = {"embed": {"w": P()}, "pos": {"w": P()},
+                   "ln_f": {"scale": P(), "bias": P()},
+                   "head": {"w": P(), "b": P()}}
+            return {**top,
+                    **{b.name: (blk[b.name] if blk[b.name] is not None
+                                else skel(b)) for b in self.blocks}}
         if self.tp > 1:
             from ..parallel.mesh import MODEL_AXIS as M
             top = {"embed": {"w": P(M, None)},     # vocab-sharded table
@@ -343,13 +377,19 @@ class TransformerLM(ModelBase):
             return P(WORKER_AXIS, SEQ_AXIS)    # [B rows, T tokens] both cut
         return None
 
-    def apply_model(self, params, x, *, train, rng, state):
-        t = x.shape[1]
+    def _pos_ids(self, t):
+        """Position ids for a [B, t] token block: global positions — under
+        sp the block is this chip's SLICE of the sequence, offset by the
+        seq rank (shared by every forward path, incl. the MoE subclass)."""
         pos_idx = jnp.arange(t)
         if self.sp > 1:
-            # x holds this chip's token BLOCK — positions are global
             from ..parallel.mesh import SEQ_AXIS
             pos_idx = pos_idx + jax.lax.axis_index(SEQ_AXIS) * t
+        return pos_idx
+
+    def apply_model(self, params, x, *, train, rng, state):
+        t = x.shape[1]
+        pos_idx = self._pos_ids(t)
         h = self.embed.apply(params["embed"], x) + \
             self.pos.apply(params["pos"], pos_idx)[None]
         if self.pp > 1:
@@ -595,9 +635,6 @@ class MoETransformerLM(TransformerLM):
 
     def build_model(self) -> None:
         super().build_model()
-        assert self.sp == 1, (
-            "sequence parallelism does not compose with the MoE stack yet "
-            "(expert routing needs the full token set or an all-to-all)")
         cd = self.config.get("compute_dtype", jnp.bfloat16)
         for k in ("moe_experts", "moe_every"):
             if k in self.config:
@@ -613,20 +650,25 @@ class MoETransformerLM(TransformerLM):
             assert self.moe_experts % self.tp == 0, (
                 f"moe_experts={self.moe_experts} not divisible by "
                 f"tp/ep={self.tp}")
+        if self.sp > 1 and self.tp == 1:
+            assert self.moe_experts % self.sp == 0, (
+                f"moe_experts={self.moe_experts} not divisible by "
+                f"sp={self.sp} (experts shard over 'seq')")
         attn_impl = str(self.config.get("attn_impl", "reference"))
         self.blocks = [
             MoEBlock(self.d_model, self.n_head, self.moe_experts, cd=cd,
-                     tp=self.tp, capacity_factor=self.capacity_factor,
+                     tp=self.tp, sp=self.sp,
+                     capacity_factor=self.capacity_factor,
                      attn_impl=attn_impl, name=f"block{i}")
             if (i + 1) % self.moe_every == 0 else
-            Block(self.d_model, self.n_head, cd=cd, tp=self.tp,
+            Block(self.d_model, self.n_head, cd=cd, tp=self.tp, sp=self.sp,
                   attn_impl=attn_impl, name=f"block{i}")
             for i in range(self.n_layer)]
 
     def _forward(self, params, x, *, train):
         t = x.shape[1]
         h = self.embed.apply(params["embed"], x) + \
-            self.pos.apply(params["pos"], jnp.arange(t))[None]
+            self.pos.apply(params["pos"], self._pos_ids(t))[None]
         if self.pp > 1:
             # homogeneous all-MoE stack over 'pipe': each stage's aux rides
             # the pipeline (bubble ticks masked), normalized to the dense
@@ -692,4 +734,9 @@ class MoETransformerLM(TransformerLM):
         else:
             cost = L.softmax_cross_entropy(flat, y, ls)
             err = L.errors(flat, y)
+        if self.sp > 1:
+            # per-token CE/err are over the local token block; the aux is
+            # already seq-invariant (pmean'd inside the MoE layer)
+            from ..parallel.sp import sp_mean
+            cost, err = sp_mean(cost), sp_mean(err)
         return cost + self.moe_aux * aux, (err, bn_state)

@@ -23,6 +23,23 @@ TPU-first mapping (the Mesh-TensorFlow / Switch einsum formulation):
 ``ep == 1`` (no ``'model'`` axis) runs the identical math without the slice
 and psum — pinned equal to a dense MLP when all experts share weights
 (``tests/test_moe.py``).
+
+Round-4, sequence-sharded tokens (``seq_shards > 1``):
+
+* with ``ep == 1`` the experts shard over the **'seq'** axis instead and
+  tokens travel by ALL-TO-ALL: each shard routes its local block, gathers
+  per-expert slots ``[E, C, d]``, one ``lax.all_to_all`` ships each expert
+  group to its owner (which batches S sources' slots through its experts),
+  and a second all-to-all returns them for the local combine — the classic
+  distributed-Switch dispatch, static shapes throughout.  Capacity is per
+  SOURCE shard (S·C total per expert); drop-free capacities reproduce the
+  dense math exactly (layer-pinned).
+* with ``ep > 1`` (sp×tp) the experts stay on 'model' — activations are
+  replicated over that axis, so the existing slice+psum path runs on the
+  local token block unchanged.
+* the load-balance statistic averages the per-shard token means BEFORE the
+  ``Σ f_e·P_e`` product (``pmean`` over 'seq') — the EXACT global aux, not
+  the noisier mean-of-products.
 """
 
 from __future__ import annotations
@@ -49,11 +66,21 @@ class MoE(L.Layer):
     def __init__(self, dim, n_experts, mlp_ratio=4, ep: int = 1,
                  capacity_factor: float = 1.25, w_init=("normal", 0.02),
                  compute_dtype=jnp.bfloat16, axis: str = MODEL_AXIS,
+                 seq_shards: int = 1, seq_axis: str = None,
                  name: str = "moe"):
         assert n_experts % ep == 0, \
             f"n_experts={n_experts} not divisible by ep={ep}"
+        if seq_shards > 1 and ep == 1:
+            # experts shard over the SEQUENCE axis: the all-to-all dispatch
+            assert n_experts % seq_shards == 0, (
+                f"n_experts={n_experts} not divisible by sp={seq_shards}")
         self.dim, self.n_experts, self.hidden = dim, n_experts, mlp_ratio * dim
         self.ep = ep
+        self.seq_shards = int(seq_shards)
+        if seq_axis is None:
+            from .mesh import SEQ_AXIS
+            seq_axis = SEQ_AXIS
+        self.seq_axis = seq_axis
         self.capacity_factor = float(capacity_factor)
         self.w_init = w_init
         self.compute_dtype = compute_dtype
@@ -73,11 +100,15 @@ class MoE(L.Layer):
 
     def specs(self):
         """Per-leaf PartitionSpecs: router replicated, experts sharded on
-        their leading (expert) dim.  None when ep == 1."""
-        if self.ep == 1:
-            return None
+        their leading (expert) dim — over ``'model'`` (ep) or over
+        ``'seq'`` (the sp all-to-all mode).  None when unsharded."""
         from jax.sharding import PartitionSpec as P
-        M = self.axis
+        if self.ep > 1:
+            M = self.axis
+        elif self.seq_shards > 1:
+            M = self.seq_axis
+        else:
+            return None
         return {"wg": P(), "w1": P(M, None, None), "b1": P(M, None),
                 "w2": P(M, None, None), "b2": P(M, None)}
 
@@ -112,6 +143,12 @@ class MoE(L.Layer):
         # Switch aux loss: E · Σ_e f_e · P_e  (1.0 at uniform routing)
         f_e = jnp.mean(assign, axis=0)
         p_e = jnp.mean(probs, axis=0)
+        if self.seq_shards > 1:
+            # EXACT global routing fractions: average the per-shard token
+            # means BEFORE the product (mean-of-products would be a noisier
+            # estimator and deviate from the dense objective)
+            f_e = lax.pmean(f_e, self.seq_axis)
+            p_e = lax.pmean(p_e, self.seq_axis)
         aux = E * jnp.sum(f_e * p_e)
 
         # -- capacity + dispatch one-hot [N, E, C] -------------------------
@@ -119,6 +156,11 @@ class MoE(L.Layer):
         keep = (pos < C).astype(jnp.float32) * assign
         disp = keep[:, :, None] * jax.nn.one_hot(
             pos.astype(jnp.int32), C, dtype=jnp.float32)
+
+        if self.ep == 1 and self.seq_shards > 1:
+            y, aux = self._apply_seq_a2a(params, xf, disp, keep, gate, aux,
+                                         C, cd)
+            return y.reshape(shape).astype(x.dtype), aux
 
         # -- expert-parallel slice: my E/ep experts ------------------------
         e_loc = E // self.ep
@@ -147,3 +189,39 @@ class MoE(L.Layer):
             y = lax.psum(y, self.axis)
             aux = lax.pmean(aux, self.axis)   # equal values; mark invariant
         return y.reshape(shape).astype(x.dtype), aux
+
+    def _apply_seq_a2a(self, params, xf, disp, keep, gate, aux, C, cd):
+        """Sequence-sharded expert parallelism: experts live on the 'seq'
+        shards, so each chip's locally-routed tokens travel to their
+        expert's chip with ONE ``lax.all_to_all`` (and return with one) —
+        the classic distributed-Switch dispatch, static shapes throughout.
+
+        Capacity accounting is per SOURCE shard (each of the S shards
+        reserves C slots per expert from its own token block), so an expert
+        processes up to S·C slots — the same total budget as the replicated
+        path, with drops distributed per shard.  Drop-free capacities are
+        exactly the dense math (tested).
+        """
+        S, E = self.seq_shards, self.n_experts
+        e_loc = E // S
+        d = self.dim
+        # my tokens, gathered into per-expert slots: [E, C, d] → grouped by
+        # owner shard [S, e_loc, C, d]; the a2a ships group s to shard s and
+        # returns every shard's slots for MY experts (dim 0 = source shard)
+        xe = jnp.einsum("nec,nd->ecd", disp.astype(cd), xf.astype(cd))
+        xe = xe.reshape(S, e_loc, C, d)
+        xe = lax.all_to_all(xe, self.seq_axis, split_axis=0, concat_axis=0)
+        # batched local-expert MLP over all sources' slots
+        w1, b1 = params["w1"], params["b1"]        # local [e_loc, ...]
+        w2, b2 = params["w2"], params["b2"]
+        h = jax.nn.relu(
+            jnp.einsum("secd,edf->secf", xe, w1.astype(cd))
+            + b1[None, :, None, :].astype(cd))
+        ye = jnp.einsum("secf,efd->secd", h, w2.astype(cd)) \
+            + b2[None, :, None, :].astype(cd)
+        # return every source's slots, re-assemble my [E, C, d], combine
+        ye = lax.all_to_all(ye, self.seq_axis, split_axis=0, concat_axis=0)
+        ye = ye.reshape(E, C, d)
+        comb = (disp * (keep * gate[:, None])[:, :, None]).astype(cd)
+        y = jnp.einsum("ecd,nec->nd", ye, comb)
+        return y, aux       # aux already global+invariant (pmean'd f/P)
