@@ -13,9 +13,11 @@ same 3-call rule API driving the model-parallel meshes (`parallel/tp.py`,
 * ``sp=k``   — sequence parallelism over a 'seq' axis (ring attention;
                batch leaves placed [workers, seq])
 * ``tp`` + ``pp`` together — a 3-D dp×pipe×model mesh
+* ``tp`` + ``sp`` together — a 3-D dp×seq×model mesh (head-sharded ring
+               attention: long context AND wide model at once)
 
-Pick a mode with MODE=dp|tp|pp|sp|tp_pp (default tp).  ``devices`` counts
-DATA-PARALLEL groups: devices=2 with tp=2, pp=2 uses 8 chips.
+Pick a mode with MODE=dp|tp|pp|sp|tp_pp|tp_sp (default tp).  ``devices``
+counts DATA-PARALLEL groups: devices=2 with tp=2, pp=2 uses 8 chips.
 """
 
 import os
@@ -30,6 +32,7 @@ MODES = {
     "pp":    dict(devices=2, pp=4, pp_microbatches=8),
     "sp":    dict(devices=2, sp=4),
     "tp_pp": dict(devices=2, tp=2, pp=2, pp_microbatches=8),
+    "tp_sp": dict(devices=2, tp=2, sp=2),
 }
 
 from theanompi_tpu import BSP  # noqa: E402
