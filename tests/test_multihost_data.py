@@ -204,3 +204,24 @@ def test_two_process_spc_matches_single_step():
     bit-for-bit (same data order, same per-step RNG folding)."""
     from tests.twoproc_model import fingerprint_after_steps
     _run_twoproc_and_compare("spc", fingerprint_after_steps(n_workers=4))
+
+
+def test_two_process_sp_transformer_step():
+    """Multi-host × sequence parallelism (round-4): dp across the
+    processes, both seq shards within each process — ring-attention
+    ppermutes stay intra-host, the gradient reduce crosses hosts; the
+    per-host batch (full sequences for this host's rows) is stitched by
+    put_batch with the [workers, seq] sharding.  Must match a
+    single-process oracle."""
+    from tests.twoproc_model import fingerprint_after_steps_sp
+    _run_twoproc_and_compare("sp", fingerprint_after_steps_sp(dp=2, sp=2))
+
+
+def test_two_process_sp_spc_matches_single_step():
+    """The full composition — multi-host × sequence-parallel ×
+    steps_per_call: per-host [k, rows, seq] stacks stitched
+    P(None, workers, seq) must match the spc=1-equivalent single-process
+    oracle (same data order, same per-step RNG folding)."""
+    from tests.twoproc_model import fingerprint_after_steps_sp
+    _run_twoproc_and_compare("sp_spc",
+                             fingerprint_after_steps_sp(dp=2, sp=2))

@@ -39,6 +39,12 @@ def main() -> int:
     elif mode == "pp":
         from tests.twoproc_model import fingerprint_after_steps_pp
         fp = fingerprint_after_steps_pp(dp=2, pp=2)
+    elif mode == "sp":
+        from tests.twoproc_model import fingerprint_after_steps_sp
+        fp = fingerprint_after_steps_sp(dp=2, sp=2)
+    elif mode == "sp_spc":
+        from tests.twoproc_model import fingerprint_after_steps_sp_spc
+        fp = fingerprint_after_steps_sp_spc(dp=2, sp=2)
     elif mode == "spc":
         # multi-step dispatch on the multi-host path: each host stacks its
         # k local batches, put_batch_stack stitches [k, global, ...]

@@ -89,14 +89,16 @@ def _lm_fingerprint(dp: int, n_steps: int, **parallel_kw) -> dict:
     from theanompi_tpu.parallel.mesh import worker_mesh
 
     mesh = worker_mesh(dp, tp=parallel_kw.get("tp", 1),
-                       pp=parallel_kw.get("pp", 1))
+                       pp=parallel_kw.get("pp", 1),
+                       sp=parallel_kw.get("sp", 1))
     cfg = {"mesh": mesh, "size": dp, "rank": 0, "verbose": False,
            "batch_size": 8, "seq_len": 16, "vocab": 16, "d_model": 16,
            "n_head": 2, "synthetic_train": 64, "synthetic_val": 32,
            "compute_dtype": jnp.float32, "seed": 5, "n_layer": 1,
            **parallel_kw}
     return _train_and_fingerprint(TransformerLM(cfg), BSP_Exchanger(cfg),
-                                  n_steps)
+                                  n_steps,
+                                  parallel_kw.get("steps_per_call", 1))
 
 
 def fingerprint_after_steps_tp(dp: int = 2, tp: int = 2,
@@ -112,3 +114,19 @@ def fingerprint_after_steps_pp(dp: int = 2, pp: int = 2,
     activations ppermute intra-host, the gradient reduce crosses hosts."""
     return _lm_fingerprint(dp, n_steps, pp=pp, pp_microbatches=4,
                            n_layer=2)
+
+
+def fingerprint_after_steps_sp(dp: int = 2, sp: int = 2,
+                               n_steps: int = 2) -> dict:
+    """dp across hosts x sequence shards within a host (round-4): each host
+    feeds its worker rows' FULL sequences; put_batch stitches them with the
+    [workers, seq] sharding — the ring-attention ppermutes stay intra-host,
+    the gradient reduce crosses hosts."""
+    return _lm_fingerprint(dp, n_steps, sp=sp)
+
+
+def fingerprint_after_steps_sp_spc(dp: int = 2, sp: int = 2,
+                                   n_steps: int = 2) -> dict:
+    """Multi-host x sp x steps_per_call — the full composition: per-host
+    [k, local-rows, full-seq] stacks stitched P(None, workers, seq)."""
+    return _lm_fingerprint(dp, n_steps, sp=sp, steps_per_call=2)

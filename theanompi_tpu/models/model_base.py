@@ -283,10 +283,6 @@ class ModelBase:
                 "steps_per_call > 1 requires a fused exchange "
                 "(BSP grads mode); post-step collectives have a cadence "
                 "the in-call scan would skip")
-            # fail before cluster/device setup, not at the first step
-            assert jax.process_count() == 1 or self.batch_spec() is None, (
-                "steps_per_call > 1 with custom batch specs (sequence "
-                "parallelism) is single-process for now")
             if self.data is not None:
                 assert spc <= self.data.n_batch_train, (
                     f"steps_per_call={spc} exceeds n_batch_train="

@@ -372,8 +372,6 @@ def put_batch_stack(mesh: Mesh, batches, spec=None):
     base = tuple(spec) if spec is not None else (WORKER_AXIS,)
     sh = NamedSharding(mesh, P(None, *base))
     if jax.process_count() > 1:
-        assert spec is None, \
-            "custom batch specs are single-process for now"
         from .mesh import make_per_host_array
         local = jax.tree.map(
             lambda *xs: np.stack([np.asarray(x) for x in xs]), *batches)
@@ -399,9 +397,13 @@ def put_batch(mesh: Mesh, batch, spec=None):
     """
     if jax.process_count() > 1:
         from .mesh import make_per_host_array
-        assert spec is None, \
-            "custom batch specs are single-process for now"
-        return make_per_host_array(mesh, batch)
+        sharding = None if spec is None else NamedSharding(mesh, spec)
+        # custom specs (sequence parallelism) stitch fine as long as each
+        # host's devices cover COMPLETE trailing-axis groups (dp across
+        # hosts, sp within a host — the natural pod layout); per-host local
+        # data is then this host's worker rows × the full extra dims, which
+        # make_array_from_process_local_data validates
+        return make_per_host_array(mesh, batch, sharding=sharding)
     sh = NamedSharding(mesh, spec) if spec is not None else \
         batch_sharding(mesh)
     return jax.tree.map(lambda x: jax.device_put(x, sh), batch)
