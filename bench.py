@@ -404,8 +404,15 @@ def main() -> int:
         else:
             train_fn = model.train_fn
 
+        load_wait = [0.0]
+
         def step(i):
-            b = model.data.next_train_batch(i) if real_data else dev_batch
+            if real_data:
+                t0 = time.time()
+                b = model.data.next_train_batch(i)
+                load_wait[0] += time.time() - t0   # consumer BLOCKED on the
+            else:                                  # producer = overlap gap
+                b = dev_batch
             model.step_state, cost, err = train_fn(
                 model.step_state, b, lr, rng, jnp.int32(i))
             exchanger.exchange(None, i)  # rule cadence (no-op for BSP grads)
@@ -419,15 +426,17 @@ def main() -> int:
         for i in range(warmup):
             step(i)
         drain()
+        load_wait[0] = 0.0            # only the timed window counts
         t0 = time.time()
         for i in range(iters):
             step(warmup + i)
         drain()
-        return model, spc, n_images, time.time() - t0, compiled
+        return (model, spc, n_images, time.time() - t0, compiled,
+                load_wait[0])
 
     retry = False
     try:
-        model, spc, n_images, dt, compiled = measure(config)
+        model, spc, n_images, dt, compiled, load_wait = measure(config)
     except Exception as e:
         if int(config.get("steps_per_call", 1)) <= 1:
             raise
@@ -439,7 +448,7 @@ def main() -> int:
         # would otherwise keep its device buffers rooted while the fallback
         # allocates a second full model
         config["steps_per_call"] = 1
-        model, spc, n_images, dt, compiled = measure(config)
+        model, spc, n_images, dt, compiled, load_wait = measure(config)
 
     ips = n_images * iters / dt
     ips_chip = ips / n_chips
@@ -479,6 +488,11 @@ def main() -> int:
     }
     if mfu is not None:
         out["mfu"] = mfu
+    if real_data:
+        # overlap evidence (SURVEY §2.8 "input pipeline at AlexNet
+        # speeds"): the share of the timed window the consumer spent
+        # BLOCKED waiting for the loader; ~0 = the producer kept up
+        out["load_wait_share"] = round(load_wait / dt, 4)
     print(json.dumps(out))
     return 0
 
