@@ -1,0 +1,108 @@
+#!/usr/bin/env python
+"""Host-side input-pipeline throughput — no accelerator required.
+
+The reference's flagship was its parallel loader feeding real ``.hkl``
+batches at AlexNet rates (SURVEY.md §2.8/§7: at 14k img/s that is ~1.1 GB/s
+of augmented float32).  This measures exactly that capability in isolation:
+disk → ``.hkl`` read → fused native crop/mirror/mean/cast →
+(optionally) the PrefetchLoader producer — images/sec and GB/s out of the
+host pipeline, the ceiling it can feed a chip at.
+
+    python scripts/loader_bench.py [--batches 32] [--batch-size 128]
+                                   [--u8-wire] [--prefetch]
+
+Writes one JSON line; nothing here touches a TPU, so it runs (and proves
+the SURVEY §7 "input pipeline at AlexNet speeds" hard part) even while the
+tunnel is down.
+"""
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# nothing here needs an accelerator — and a wedged TPU tunnel would hang the
+# first backend touch on import, so pin the CPU backend up front
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--batches", type=int, default=32)
+    p.add_argument("--batch-size", type=int, default=128)
+    p.add_argument("--epochs", type=int, default=3,
+                   help="timed passes over the shard set")
+    p.add_argument("--u8-wire", action="store_true",
+                   help="measure the aug_wire_u8 path (crop+mirror only)")
+    p.add_argument("--prefetch", action="store_true",
+                   help="pull through the PrefetchLoader producer thread")
+    p.add_argument("--workers", type=int, default=1,
+                   help="PrefetchLoader materializer pool size (implies "
+                        "--prefetch when > 1)")
+    p.add_argument("--data-dir", default=None)
+    args = p.parse_args(argv)
+
+    d = args.data_dir or f"/tmp/bench_imagenet_{args.batch_size}x{args.batches}"
+    if not os.path.isdir(os.path.join(d, "train_hkl")) or \
+            not os.path.exists(os.path.join(d, "img_mean.npy")):
+        print(f"generating {args.batches}x{args.batch_size} dataset at {d}",
+              file=sys.stderr)
+        subprocess.run(
+            [sys.executable,
+             os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "make_batch_dataset.py"),
+             "--synthetic", str(args.batches),
+             "--batch-size", str(args.batch_size), "--out", d],
+            check=True, stdout=sys.stderr)
+
+    from theanompi_tpu.models.data.imagenet import ImageNet_data
+
+    cfg = {"size": 1, "data_dir": d}
+    if args.u8_wire:
+        cfg["aug_wire_u8"] = True
+    data = ImageNet_data(cfg, batch_size=args.batch_size)
+    if args.prefetch or args.workers > 1:
+        from theanompi_tpu.models.data.prefetch import PrefetchLoader
+        data = PrefetchLoader(data, n_workers=args.workers)
+
+    # warm the page cache + any lazy native-library build
+    data.shuffle_data(0)
+    b = data.next_train_batch(0)
+    bytes_per_img = b["x"][0].nbytes
+    n_imgs = 0
+    t0 = time.time()
+    for ep in range(args.epochs):
+        data.shuffle_data(ep)
+        for i in range(data.n_batch_train):
+            if ep == 0 and i == 0:
+                continue              # consumed by the warmup pull above
+            batch = data.next_train_batch(i)
+            n_imgs += batch["x"].shape[0]
+    dt = time.time() - t0
+    ips = n_imgs / dt
+    out = {
+        "metric": "host_loader_images_per_sec"
+                  + (" (u8-wire)" if args.u8_wire else " (fused f32)")
+                  + (f" via PrefetchLoader x{args.workers}"
+                     if (args.prefetch or args.workers > 1) else ""),
+        "value": round(ips, 1),
+        "unit": "images/sec",
+        "gb_per_sec_out": round(ips * bytes_per_img / 1e9, 3),
+        "images": n_imgs,
+        "seconds": round(dt, 2),
+        "note": "host pipeline only (disk->.hkl->augment); the rate it can "
+                "feed a chip at — AlexNet v5e needs ~14k img/s "
+                "(BASELINE.md)",
+    }
+    print(json.dumps(out))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -198,19 +198,39 @@ class ImageNet_data:
         start = lo + self.proc_id * per
         return range(start, start + per)
 
-    def next_train_batch(self, count: int) -> Dict[str, np.ndarray]:
+    def plan_train_batch(self, count: int) -> Dict:
+        """Advance the cursor AND the augmentation RNG, returning a pure
+        PLAN (round-4 parallel producer): :meth:`materialize` turns a plan
+        into the batch statelessly, so a thread pool can materialize
+        several plans concurrently while the draws stay sequential — the
+        batch stream is bit-identical to the serial path."""
         if self.synthetic:    # _synth_x/_synth_y are already host-local
-            return self._augment(self._synth_x, self._synth_y, train=True)
+            n = self._synth_x.shape[0]
+            return {"files": None,
+                    "draws": self._draw(n, RAW, RAW, train=True)}
         i = self._train_ptr % self.n_batch_train
         self._train_ptr += 1
-        idx = [self._perm[j] for j in self._local_files(i * self.size)]
+        idx = [int(self._perm[j]) for j in self._local_files(i * self.size)]
+        n = len(idx) * self.batch_size
+        return {"files": idx, "draws": self._draw(n, RAW, RAW, train=True)}
+
+    def materialize(self, plan: Dict) -> Dict[str, np.ndarray]:
+        """Stateless plan → batch (thread-safe: reads only immutable
+        fields; all RNG happened at plan time)."""
+        if plan["files"] is None:
+            return self._transform(self._synth_x, self._synth_y,
+                                   plan["draws"])
+        idx = plan["files"]
         xs = np.concatenate([_load_batch_file(self.train_files[j])
                              for j in idx])
         ys = np.concatenate([self.train_labels[j * self.batch_size:
                                                (j + 1) * self.batch_size]
                              for j in idx])
-        return self._augment(self._to_nhwc(xs), ys.astype(np.int32),
-                             train=True)
+        return self._transform(self._to_nhwc(xs), ys.astype(np.int32),
+                               plan["draws"])
+
+    def next_train_batch(self, count: int) -> Dict[str, np.ndarray]:
+        return self.materialize(self.plan_train_batch(count))
 
     def next_val_batch(self, count: int) -> Dict[str, np.ndarray]:
         if self.synthetic:
@@ -254,17 +274,8 @@ class ImageNet_data:
             return np.ascontiguousarray(m.transpose(1, 2, 0))
         return m
 
-    def _augment(self, x: np.ndarray, y: np.ndarray,
-                 train: bool) -> Dict[str, np.ndarray]:
-        """Reference augmentation: random 256→crop window + horizontal
-        mirror at train time (one draw per batch, as the reference's
-        per-batch ``param_rand``); center crop at val; mean subtraction.
-        ``aug_per_image=True`` in config upgrades to independent per-image
-        draws.  The fused crop/mirror/mean/cast pass runs in the native C++
-        library when available (``theanompi_tpu.native``), NumPy otherwise.
-        """
-        from ... import native
-        n, h, w = x.shape[0], x.shape[1], x.shape[2]
+    def _draw(self, n: int, h: int, w: int, train: bool):
+        """The augmentation RNG draws — SEQUENTIAL state (plan time)."""
         c = self.crop
         if train:
             per_img = bool(self.config.get("aug_per_image", False))
@@ -276,6 +287,27 @@ class ImageNet_data:
             oy = np.full(1, (h - c) // 2, np.int32)
             ox = np.full(1, (w - c) // 2, np.int32)
             flip = np.zeros(1, np.uint8)
+        return oy, ox, flip
+
+    def _augment(self, x: np.ndarray, y: np.ndarray,
+                 train: bool) -> Dict[str, np.ndarray]:
+        """Reference augmentation: random 256→crop window + horizontal
+        mirror at train time (one draw per batch, as the reference's
+        per-batch ``param_rand``); center crop at val; mean subtraction.
+        ``aug_per_image=True`` in config upgrades to independent per-image
+        draws.  The fused crop/mirror/mean/cast pass runs in the native C++
+        library when available (``theanompi_tpu.native``), NumPy otherwise.
+        """
+        return self._transform(
+            x, y, self._draw(x.shape[0], x.shape[1], x.shape[2], train))
+
+    def _transform(self, x: np.ndarray, y: np.ndarray,
+                   draws) -> Dict[str, np.ndarray]:
+        """Stateless tail of the augmentation (thread-safe given draws)."""
+        from ... import native
+        n, h, w = x.shape[0], x.shape[1], x.shape[2]
+        c = self.crop
+        oy, ox, flip = draws
         if self.config.get("aug_wire_u8", False):
             # u8-wire mode (round-4 perf lever): host does ONLY crop+mirror
             # on uint8 (a gather); mean-subtract+cast happen ON DEVICE,

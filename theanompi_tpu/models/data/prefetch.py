@@ -27,11 +27,22 @@ from typing import Optional
 
 
 class PrefetchLoader:
-    """Double-buffered background loader over any DataBase-shaped object."""
+    """Double-buffered background loader over any DataBase-shaped object.
 
-    def __init__(self, data, depth: int = 2, device_put_fn=None):
+    ``n_workers > 1`` (round-4, SURVEY §7 "input pipeline at AlexNet
+    speeds"): when the wrapped data object exposes the ``plan_train_batch``
+    / ``materialize`` split (``ImageNet_data``), the producer draws plans
+    SEQUENTIALLY (cursor + augmentation RNG stay exact) and a thread pool
+    materializes several in flight — disk reads and the native augment
+    release the GIL, so file-based pipelines scale near-linearly.  The
+    bounded queue holds ordered futures: the batch STREAM is bit-identical
+    to the serial path, whatever the pool size."""
+
+    def __init__(self, data, depth: int = 2, device_put_fn=None,
+                 n_workers: int = 1):
         self._data = data
         self.depth = depth
+        self.n_workers = max(1, int(n_workers))
         self._device_put_fn = device_put_fn  # optional: stage host→device too
         self._q: Optional[queue.Queue] = None
         self._thread: Optional[threading.Thread] = None
@@ -110,6 +121,8 @@ class PrefetchLoader:
         if isinstance(item, BaseException):
             raise item
         batch, self._consumed_cursor = item
+        if hasattr(batch, "result"):     # pooled producer: an ordered future
+            batch = batch.result()       # (re-raises materialize errors)
         return batch
 
     def next_val_batch(self, count: int):
@@ -124,6 +137,10 @@ class PrefetchLoader:
         # swaps self._q/_stop, and a slow old producer must neither feed the
         # new queue nor be revived by the new (cleared) event
         try:
+            if self.n_workers > 1 and hasattr(self._data,
+                                              "plan_train_batch"):
+                self._producer_pooled(n_batches, q, stop)
+                return
             for i in range(n_batches):
                 if stop.is_set():
                     return
@@ -135,6 +152,26 @@ class PrefetchLoader:
                 q.put((batch, cursor))
         except BaseException as e:    # surface loader errors in the consumer
             q.put(e)
+
+    def _producer_pooled(self, n_batches: int, q: queue.Queue,
+                         stop: threading.Event) -> None:
+        """Sequential plans, pooled materialization: at most ``depth``
+        queued + ``n_workers`` executing batches in flight; the queue keeps
+        plan order, so the stream equals the serial producer's exactly."""
+        from concurrent.futures import ThreadPoolExecutor
+        with ThreadPoolExecutor(self.n_workers) as pool:
+            for i in range(n_batches):
+                if stop.is_set():
+                    return
+                plan = self._data.plan_train_batch(i + 1)
+                cursor = self._data.get_cursor() \
+                    if hasattr(self._data, "get_cursor") else {}
+                fut = pool.submit(
+                    lambda p: self._maybe_put(self._data.materialize(p)),
+                    plan)
+                if stop.is_set():
+                    return
+                q.put((fut, cursor))   # bounded: blocks when depth reached
 
     def _maybe_put(self, batch):
         return self._device_put_fn(batch) if self._device_put_fn else batch

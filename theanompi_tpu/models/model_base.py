@@ -84,7 +84,11 @@ class ModelBase:
             put = None if int(self.steps_per_call) > 1 \
                 else (lambda b: steps.put_batch(self.mesh, b,
                                                 self.batch_spec()))
-            self.data = PrefetchLoader(self.data, device_put_fn=put)
+            # para_load_workers > 1: pooled materialization for file-based
+            # data (plans stay sequential — bit-identical stream)
+            self.data = PrefetchLoader(
+                self.data, device_put_fn=put,
+                n_workers=int(self.config.get("para_load_workers", 4)))
 
         key = jax.random.key(self.seed)
         self.params = self.init_params(key)
