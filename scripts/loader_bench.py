@@ -71,17 +71,18 @@ def main(argv=None) -> int:
         from theanompi_tpu.models.data.prefetch import PrefetchLoader
         data = PrefetchLoader(data, n_workers=args.workers)
 
-    # warm the page cache + any lazy native-library build
+    # warm the page cache + any lazy native-library build; epoch 0 then
+    # CONTINUES from batch 1 (no re-shuffle — that would restart the
+    # producer and regenerate the warmup batch inside the timed window)
     data.shuffle_data(0)
     b = data.next_train_batch(0)
     bytes_per_img = b["x"][0].nbytes
     n_imgs = 0
     t0 = time.time()
     for ep in range(args.epochs):
-        data.shuffle_data(ep)
-        for i in range(data.n_batch_train):
-            if ep == 0 and i == 0:
-                continue              # consumed by the warmup pull above
+        if ep > 0:
+            data.shuffle_data(ep)
+        for i in range(1 if ep == 0 else 0, data.n_batch_train):
             batch = data.next_train_batch(i)
             n_imgs += batch["x"].shape[0]
     dt = time.time() - t0

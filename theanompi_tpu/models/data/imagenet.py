@@ -212,7 +212,17 @@ class ImageNet_data:
         self._train_ptr += 1
         idx = [int(self._perm[j]) for j in self._local_files(i * self.size)]
         n = len(idx) * self.batch_size
-        return {"files": idx, "draws": self._draw(n, RAW, RAW, train=True)}
+        h, w = self._stored_hw()
+        return {"files": idx, "draws": self._draw(n, h, w, train=True)}
+
+    def _stored_hw(self):
+        """Stored image dims, read ONCE from the first batch file (plan-time
+        draws must match what materialize will load; the .npy fallback
+        accepts non-256 sizes)."""
+        if getattr(self, "_hw", None) is None:
+            x0 = self._to_nhwc(_load_batch_file(self.train_files[0]))
+            self._hw = (int(x0.shape[1]), int(x0.shape[2]))
+        return self._hw
 
     def materialize(self, plan: Dict) -> Dict[str, np.ndarray]:
         """Stateless plan → batch (thread-safe: reads only immutable
@@ -308,6 +318,9 @@ class ImageNet_data:
         n, h, w = x.shape[0], x.shape[1], x.shape[2]
         c = self.crop
         oy, ox, flip = draws
+        assert int(oy.max()) + c <= h and int(ox.max()) + c <= w, (
+            f"crop window ({int(oy.max())},{int(ox.max())})+{c} exceeds the "
+            f"loaded batch's {h}x{w} — heterogeneous batch-file sizes?")
         if self.config.get("aug_wire_u8", False):
             # u8-wire mode (round-4 perf lever): host does ONLY crop+mirror
             # on uint8 (a gather); mean-subtract+cast happen ON DEVICE,

@@ -107,7 +107,13 @@ class PrefetchLoader:
         n = self._data.n_batch_train
         # batches left in the current epoch (ptr%n == 0 → a fresh epoch)
         remaining = n - int(self._consumed_cursor.get("train_ptr", 0)) % n
-        self._q = queue.Queue(maxsize=self.depth)
+        # pooled producer: the queue must hold one future per in-flight
+        # materialization or q.put blocks the submit loop at depth+1 and
+        # caps the effective pool (review finding)
+        pooled = self.n_workers > 1 and hasattr(self._data,
+                                                "plan_train_batch")
+        self._q = queue.Queue(
+            maxsize=self.depth + (self.n_workers if pooled else 0))
         self._stop = threading.Event()
         self._thread = threading.Thread(
             target=self._producer, args=(remaining, self._q, self._stop),
@@ -120,9 +126,12 @@ class PrefetchLoader:
         item = self._q.get()
         if isinstance(item, BaseException):
             raise item
-        batch, self._consumed_cursor = item
+        batch, cursor = item
         if hasattr(batch, "result"):     # pooled producer: an ordered future
             batch = batch.result()       # (re-raises materialize errors)
+        # commit the cursor only AFTER the batch is in hand — a failed
+        # materialize must not mark its batch consumed
+        self._consumed_cursor = cursor
         return batch
 
     def next_val_batch(self, count: int):
@@ -159,19 +168,26 @@ class PrefetchLoader:
         queued + ``n_workers`` executing batches in flight; the queue keeps
         plan order, so the stream equals the serial producer's exactly."""
         from concurrent.futures import ThreadPoolExecutor
+        failed = []                    # any materialize error aborts the
+
+        def on_done(f):                # epoch, matching the serial producer
+            if not f.cancelled() and f.exception() is not None:
+                failed.append(f)
+
         with ThreadPoolExecutor(self.n_workers) as pool:
             for i in range(n_batches):
-                if stop.is_set():
-                    return
+                if stop.is_set() or failed:
+                    return             # consumer hits the error at .result()
                 plan = self._data.plan_train_batch(i + 1)
                 cursor = self._data.get_cursor() \
                     if hasattr(self._data, "get_cursor") else {}
                 fut = pool.submit(
                     lambda p: self._maybe_put(self._data.materialize(p)),
                     plan)
+                fut.add_done_callback(on_done)
                 if stop.is_set():
                     return
-                q.put((fut, cursor))   # bounded: blocks when depth reached
+                q.put((fut, cursor))   # bounded: blocks at depth+n_workers
 
     def _maybe_put(self, batch):
         return self._device_put_fn(batch) if self._device_put_fn else batch
