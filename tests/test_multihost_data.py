@@ -225,3 +225,13 @@ def test_two_process_sp_spc_matches_single_step():
     from tests.twoproc_model import fingerprint_after_steps_sp
     _run_twoproc_and_compare("sp_spc",
                              fingerprint_after_steps_sp(dp=2, sp=2))
+
+
+def test_two_process_compressed_wire_matches_oracle():
+    """Multi-host × error-feedback compressed exchange (round-4): the
+    onebit strategy's Pallas-packed sign allgather crosses real process
+    boundaries and must match the single-process oracle (EF state keeps
+    the two runs bit-comparable at matching tolerances)."""
+    from tests.twoproc_model import fingerprint_after_steps_onebit
+    _run_twoproc_and_compare("onebit",
+                             fingerprint_after_steps_onebit(n_workers=4))

@@ -42,6 +42,9 @@ def main() -> int:
     elif mode == "sp":
         from tests.twoproc_model import fingerprint_after_steps_sp
         fp = fingerprint_after_steps_sp(dp=2, sp=2)
+    elif mode == "onebit":
+        from tests.twoproc_model import fingerprint_after_steps_onebit
+        fp = fingerprint_after_steps_onebit(n_workers=4)
     elif mode == "sp_spc":
         from tests.twoproc_model import fingerprint_after_steps_sp_spc
         fp = fingerprint_after_steps_sp_spc(dp=2, sp=2)
