@@ -264,3 +264,75 @@ def test_moe_sp_uses_global_positions(mesh8):
     c_d = _train_steps(dense, 1)[0]
     c_s = _train_steps(sp, 1)[0]
     assert abs(c_s - c_d) < 0.1 * abs(c_d), (c_d, c_s)
+
+
+# -- round 4: top-k (GShard-style) routing -----------------------------------
+
+def test_moe_top2_identical_experts_equals_dense():
+    """With every expert's weights identical and drop-free capacity, the
+    normalized top-2 gates sum to 1, so y = MLP(x) EXACTLY — whatever the
+    router does."""
+    r = np.random.RandomState(1)
+    d, E = 16, 4
+    moe = MoE(d, E, mlp_ratio=2, ep=1, top_k=2, capacity_factor=100.0,
+              compute_dtype=jnp.float32)
+    params = moe.init(jax.random.key(0))
+    # copy expert 0 into every expert; router weights stay random
+    for k in ("w1", "b1", "w2", "b2"):
+        params[k] = jnp.broadcast_to(params[k][:1], params[k].shape)
+    x = jnp.asarray(r.randn(24, d).astype(np.float32))
+    y, aux = moe.apply(params, x, train=True)
+    w1, b1 = params["w1"][0], params["b1"][0]
+    w2, b2 = params["w2"][0], params["b2"][0]
+    dense = jnp.dot(jax.nn.relu(jnp.dot(x, w1) + b1), w2) + b2
+    np.testing.assert_allclose(np.asarray(y), np.asarray(dense),
+                               rtol=1e-5, atol=1e-6)
+    assert np.isfinite(float(aux))
+
+
+def test_moe_top2_priority_capacity_drops_secondaries_first():
+    """REAL rank contention (GShard priority ordering): group A routes
+    (e0 primary, e1 secondary), group B the mirror.  With C=4 and 3+3
+    tokens, every primary survives and each expert keeps exactly ONE
+    secondary (the earliest), so precisely tokens 0 and 3 get their full
+    top-2 output — with identical experts the per-token output SCALE
+    reveals exactly which routes were kept.  Inverting rank priority or
+    mis-accumulating the slot base changes the scales and fails."""
+    d, E, n_g = 4, 2, 3
+    moe = MoE(d, E, mlp_ratio=1, ep=1, top_k=2, capacity_factor=1.0,
+              compute_dtype=jnp.float32)
+    params = moe.init(jax.random.key(2))
+    for k in ("w1", "b1", "w2", "b2"):     # identical experts: y = s·MLP(x)
+        params[k] = jnp.broadcast_to(params[k][:1], params[k].shape)
+    wg = np.zeros((d, E), np.float32)
+    wg[0, 0] = 1.0
+    wg[1, 1] = 1.0
+    params = dict(params, wg=jnp.asarray(wg))
+    a = np.array([2.0, 1.0, 0.0, 0.0], np.float32)   # prefers e0 then e1
+    b = np.array([1.0, 2.0, 0.0, 0.0], np.float32)   # prefers e1 then e0
+    x = jnp.asarray(np.stack([a, a, a, b, b, b]))    # rows 0-2 = A, 3-5 = B
+    # capacity(6, train) = ceil(6*2/2 * 1.0) = 6 — too roomy; force C=4 via
+    # eval-free capacity_factor choice: use cf = 4/6 exactly
+    moe.capacity_factor = 4.0 / 6.0
+    assert moe.capacity(6, True) == 4
+    y, _ = moe.apply(params, x, train=True)
+    w1, b1_, w2, b2_ = (params["w1"][0], params["b1"][0],
+                        params["w2"][0], params["b2"][0])
+    mlp = np.asarray(jnp.dot(jax.nn.relu(jnp.dot(x, w1) + b1_), w2) + b2_)
+    scale = np.asarray(y)[:, 0] / mlp[:, 0]          # per-token kept gates
+    # normalized top-2 gates of softmax([2,1]): g_hi ≈ 0.731, g_lo ≈ 0.269
+    g_hi = float(np.exp(2) / (np.exp(2) + np.exp(1)))
+    # rows 0 and 3: both routes kept (scale 1); the other four lose ONLY
+    # their secondary (scale = primary gate) — primaries never drop
+    np.testing.assert_allclose(scale[[0, 3]], 1.0, rtol=1e-5)
+    np.testing.assert_allclose(scale[[1, 2, 4, 5]], g_hi, rtol=1e-5)
+
+
+def test_moe_top2_lm_trains_and_composes_with_ep(mesh8):
+    """moe_topk=2 through the model config: trains finite/decreasing dense
+    AND with experts sharded over 'model' (ep=tp=2)."""
+    for tp in (1, 2):
+        m = _make(dp=2, tp=tp, moe_topk=2)
+        costs = _train_steps(m, 5)
+        assert np.isfinite(costs).all()
+        assert np.mean(costs[-2:]) < np.mean(costs[:2])

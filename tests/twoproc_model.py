@@ -138,17 +138,4 @@ def fingerprint_after_steps_onebit(n_workers: int = 4,
     strategy's packed sign allgather and per-worker error-feedback state
     run across REAL process boundaries; must match a single-process
     oracle."""
-    import jax.numpy as jnp
-
-    from theanompi_tpu.models.transformer_lm import TransformerLM
-    from theanompi_tpu.parallel.exchanger import BSP_Exchanger
-    from theanompi_tpu.parallel.mesh import worker_mesh
-
-    mesh = worker_mesh(n_workers)
-    cfg = {"mesh": mesh, "size": n_workers, "rank": 0, "verbose": False,
-           "batch_size": 8, "seq_len": 16, "vocab": 16, "d_model": 16,
-           "n_head": 2, "synthetic_train": 64, "synthetic_val": 32,
-           "compute_dtype": jnp.float32, "seed": 5, "n_layer": 1,
-           "exch_strategy": "onebit"}
-    return _train_and_fingerprint(TransformerLM(cfg), BSP_Exchanger(cfg),
-                                  n_steps)
+    return _lm_fingerprint(n_workers, n_steps, exch_strategy="onebit")

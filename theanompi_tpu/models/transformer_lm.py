@@ -168,8 +168,8 @@ class MoEBlock(Block):
     up to the model's loss head."""
 
     def __init__(self, dim, n_head, n_experts, mlp_ratio=4, cd=jnp.bfloat16,
-                 tp=1, sp=1, capacity_factor=1.25, attn_impl="reference",
-                 name="moe_block"):
+                 tp=1, sp=1, capacity_factor=1.25, top_k=1,
+                 attn_impl="reference", name="moe_block"):
         # attention (and its specs) come from Block; tp doubles as the
         # expert-parallel degree — both shard over the same 'model' axis.
         # sp>1 (round-4): tokens are sequence-sharded — with tp==1 the
@@ -180,7 +180,7 @@ class MoEBlock(Block):
                          sp=sp, attn_impl=attn_impl, name=name)
         from ..parallel.moe import MoE
         self.moe = MoE(dim, n_experts, mlp_ratio=mlp_ratio, ep=tp,
-                       seq_shards=sp,
+                       seq_shards=sp, top_k=top_k,
                        capacity_factor=capacity_factor, compute_dtype=cd,
                        name="moe")
         del self.fc1, self.fc2
@@ -630,13 +630,14 @@ class MoETransformerLM(TransformerLM):
 
     moe_experts = 4
     moe_every = 2          # every k-th block is MoE (1 = all blocks)
+    moe_topk = 1           # experts per token (2 = GShard-style top-2)
     moe_aux = 0.01
     capacity_factor = 1.25
 
     def build_model(self) -> None:
         super().build_model()
         cd = self.config.get("compute_dtype", jnp.bfloat16)
-        for k in ("moe_experts", "moe_every"):
+        for k in ("moe_experts", "moe_every", "moe_topk"):
             if k in self.config:
                 setattr(self, k, int(self.config[k]))
         assert self.pp == 1 or self.moe_every == 1, (
@@ -659,6 +660,7 @@ class MoETransformerLM(TransformerLM):
             MoEBlock(self.d_model, self.n_head, self.moe_experts, cd=cd,
                      tp=self.tp, sp=self.sp,
                      capacity_factor=self.capacity_factor,
+                     top_k=self.moe_topk,
                      attn_impl=attn_impl, name=f"block{i}")
             if (i + 1) % self.moe_every == 0 else
             Block(self.d_model, self.n_head, cd=cd, tp=self.tp, sp=self.sp,

@@ -54,8 +54,11 @@ from .mesh import MODEL_AXIS
 
 
 class MoE(L.Layer):
-    """Top-1 (Switch) mixture of 2-layer MLP experts, optionally expert
-    -parallel over ``'model'``.
+    """Top-k mixture of 2-layer MLP experts, optionally expert-parallel
+    over ``'model'``.  ``top_k=1`` (default) is the Switch formulation;
+    ``top_k=2`` the GShard one — the k selected gates renormalize to sum
+    1, and choice ranks claim capacity slots in priority order (every
+    token's primary route before any secondary).
 
     ``apply`` returns ``(y, aux)`` — the combined output and the scalar load
     -balance loss — so callers must unpack (the transformer block does).
@@ -67,9 +70,11 @@ class MoE(L.Layer):
                  capacity_factor: float = 1.25, w_init=("normal", 0.02),
                  compute_dtype=jnp.bfloat16, axis: str = MODEL_AXIS,
                  seq_shards: int = 1, seq_axis: str = None,
-                 name: str = "moe"):
+                 top_k: int = 1, name: str = "moe"):
         assert n_experts % ep == 0, \
             f"n_experts={n_experts} not divisible by ep={ep}"
+        assert 1 <= int(top_k) <= n_experts, (top_k, n_experts)
+        self.top_k = int(top_k)
         if seq_shards > 1 and ep == 1:
             # experts shard over the SEQUENCE axis: the all-to-all dispatch
             assert n_experts % seq_shards == 0, (
@@ -121,8 +126,11 @@ class MoE(L.Layer):
         one (which routes the whole buffer)."""
         if not train:
             return max(1, n_tokens)
+        # top_k routes k·n assignments over E experts — capacity scales
+        # with k (GShard), else secondaries would drop even at perfect
+        # balance
         return max(1, int(np.ceil(
-            n_tokens / self.n_experts * self.capacity_factor)))
+            n_tokens * self.top_k / self.n_experts * self.capacity_factor)))
 
     def apply(self, params, x, *, train=False, rng=None, state=None):
         cd = self.compute_dtype
@@ -136,12 +144,17 @@ class MoE(L.Layer):
         logits = jnp.dot(xf.astype(jnp.float32),
                          params["wg"].astype(jnp.float32))       # [N, E]
         probs = jax.nn.softmax(logits, axis=-1)
-        eidx = jnp.argmax(probs, axis=-1)                        # [N]
-        gate = jnp.max(probs, axis=-1)                           # [N]
-        assign = jax.nn.one_hot(eidx, E, dtype=jnp.float32)      # [N, E]
+        K = self.top_k
+        topv, topi = lax.top_k(probs, K)                         # [N, K]
+        if K > 1:
+            # GShard-style: the k selected gates renormalize to sum 1
+            topv = topv / jnp.sum(topv, axis=-1, keepdims=True)
+        assigns = [jax.nn.one_hot(topi[:, j], E, dtype=jnp.float32)
+                   for j in range(K)]                            # k × [N, E]
 
-        # Switch aux loss: E · Σ_e f_e · P_e  (1.0 at uniform routing)
-        f_e = jnp.mean(assign, axis=0)
+        # Switch aux loss on the PRIMARY assignment: E · Σ_e f_e · P_e
+        # (1.0 at uniform routing)
+        f_e = jnp.mean(assigns[0], axis=0)
         p_e = jnp.mean(probs, axis=0)
         if self.seq_shards > 1:
             # EXACT global routing fractions: average the per-shard token
@@ -152,13 +165,23 @@ class MoE(L.Layer):
         aux = E * jnp.sum(f_e * p_e)
 
         # -- capacity + dispatch one-hot [N, E, C] -------------------------
-        pos = jnp.cumsum(assign, axis=0) - 1.0                   # [N, E]
-        keep = (pos < C).astype(jnp.float32) * assign
-        disp = keep[:, :, None] * jax.nn.one_hot(
-            pos.astype(jnp.int32), C, dtype=jnp.float32)
+        # choice ranks claim slots in PRIORITY order (every token's primary
+        # route before any secondary — GShard's ordering): rank j's
+        # positions continue from the slots ranks < j actually kept
+        disp = jnp.zeros((n, E, C), jnp.float32)
+        comb_gate = jnp.zeros((n, E), jnp.float32)
+        base = jnp.zeros((E,), jnp.float32)
+        for j in range(K):
+            a = assigns[j]
+            pos = jnp.cumsum(a, axis=0) - 1.0 + base[None, :]    # [N, E]
+            kept = (pos < C).astype(jnp.float32) * a
+            disp = disp + kept[:, :, None] * jax.nn.one_hot(
+                pos.astype(jnp.int32), C, dtype=jnp.float32)
+            comb_gate = comb_gate + kept * topv[:, j:j + 1]
+            base = base + jnp.sum(kept, axis=0)
 
         if self.ep == 1 and self.seq_shards > 1:
-            y, aux = self._apply_seq_a2a(params, xf, disp, keep, gate, aux,
+            y, aux = self._apply_seq_a2a(params, xf, disp, comb_gate, aux,
                                          C, cd)
             return y.reshape(shape).astype(x.dtype), aux
 
@@ -168,13 +191,9 @@ class MoE(L.Layer):
             rank = lax.axis_index(self.axis)
             disp = lax.dynamic_slice_in_dim(disp, rank * e_loc, e_loc, axis=1)
             comb_gate = lax.dynamic_slice_in_dim(
-                keep * gate[:, None], rank * e_loc, e_loc, axis=1)
-            w1, b1 = params["w1"], params["b1"]    # local [E/ep, ...] shards
-            w2, b2 = params["w2"], params["b2"]
-        else:
-            comb_gate = keep * gate[:, None]
-            w1, b1, w2, b2 = (params["w1"], params["b1"],
-                              params["w2"], params["b2"])
+                comb_gate, rank * e_loc, e_loc, axis=1)
+        w1, b1 = params["w1"], params["b1"]    # local [E/ep, ...] shards
+        w2, b2 = params["w2"], params["b2"]
 
         # -- gather → batched expert MLP → combine (all MXU einsums) -------
         xe = jnp.einsum("nec,nd->ecd", disp.astype(cd), xf.astype(cd))
@@ -190,7 +209,7 @@ class MoE(L.Layer):
             aux = lax.pmean(aux, self.axis)   # equal values; mark invariant
         return y.reshape(shape).astype(x.dtype), aux
 
-    def _apply_seq_a2a(self, params, xf, disp, keep, gate, aux, C, cd):
+    def _apply_seq_a2a(self, params, xf, disp, comb_gate, aux, C, cd):
         """Sequence-sharded expert parallelism: experts live on the 'seq'
         shards, so each chip's locally-routed tokens travel to their
         expert's chip with ONE ``lax.all_to_all`` (and return with one) —
@@ -222,6 +241,6 @@ class MoE(L.Layer):
         # return every source's slots, re-assemble my [E, C, d], combine
         ye = lax.all_to_all(ye, self.seq_axis, split_axis=0, concat_axis=0)
         ye = ye.reshape(E, C, d)
-        comb = (disp * (keep * gate[:, None])[:, :, None]).astype(cd)
+        comb = (disp * comb_gate[:, :, None]).astype(cd)
         y = jnp.einsum("ecd,nec->nd", ye, comb)
         return y, aux       # aux already global+invariant (pmean'd f/P)
