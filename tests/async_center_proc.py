@@ -66,7 +66,19 @@ def main() -> int:
         "center_addr": addr, "island_base": proc_id, "verbose": False,
     }, rule=rule)
     # throttle keys are LOCAL island indices (this process runs 1 island)
-    tr.run_for(seconds, throttle={0: throttle} if throttle else None)
+    if seconds < 0:
+        # GOAL-based run (contention-robust: fixed wall budgets flake when
+        # a loaded 1-core CI box stretches the first compile): train until
+        # 2 exchanges land, capped at 360 s
+        import time
+        tr.start(throttle={0: throttle} if throttle else None)
+        deadline = time.time() + 360
+        while (tr.islands[0].exchanges_done < 2
+               and time.time() < deadline):
+            time.sleep(0.2)
+        tr.stop_and_join(timeout=120)
+    else:
+        tr.run_for(seconds, throttle={0: throttle} if throttle else None)
     st = tr.stats()
     print("ST " + json.dumps({"proc": proc_id, **st}), flush=True)
     return 0

@@ -101,13 +101,16 @@ def test_two_process_async_center(rule):
             subprocess.Popen(
                 [sys.executable, helper, str(i), f"{host}:{port}", rule,
                  "8.0" if i == 1 else "0.0",    # proc 1 = straggler
-                 "6.0"],
+                 # proc 0 runs GOAL-based (until 2 exchanges) so CI-box
+                 # contention can't flake the budget; the straggler keeps a
+                 # fixed short window
+                 "6.0" if i == 1 else "-1"],
                 stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
                 env=env)
             for i in range(2)]
         outs = []
         for p in procs:
-            out, err = p.communicate(timeout=300)
+            out, err = p.communicate(timeout=600)
             assert p.returncode == 0, f"proc failed:\n{err[-3000:]}"
             line = [ln for ln in out.splitlines() if ln.startswith("ST ")][0]
             outs.append(json.loads(line[3:]))

@@ -181,3 +181,30 @@ def test_sp_composes_with_tp_3d_mesh(mesh8):
     m3.begin_val()
     m3.val_iter(0)
     m3.end_val()
+
+
+def test_sp_composes_with_pp(mesh8):
+    """round-4: dp=2 × pp=2 × sp=2 — pipeline stages of ring-attention
+    blocks over sequence-sharded microbatches — matches the dense model."""
+    from theanompi_tpu.parallel.mesh import PIPE_AXIS
+    dense = TransformerLM({**LM_CFG, "mesh": worker_mesh(2), "size": 2,
+                           "rank": 0})
+    m3 = TransformerLM({**LM_CFG, "mesh": worker_mesh(2, pp=2, sp=2),
+                        "size": 2, "rank": 0, "pp": 2, "sp": 2,
+                        "pp_microbatches": 2})
+    assert dict(m3.mesh.shape) == {WORKER_AXIS: 2, PIPE_AXIS: 2,
+                                   SEQ_AXIS: 2}
+    c_dense = _train_steps(dense, BSP_Exchanger(dense.config), 4)
+    c_3d = _train_steps(m3, BSP_Exchanger(m3.config), 4)
+    np.testing.assert_allclose(c_3d, c_dense, rtol=3e-4, atol=3e-5)
+    m3.begin_val()
+    m3.val_iter(0)
+    m3.end_val()
+
+
+def test_moe_rejects_sp_pp(mesh8):
+    from theanompi_tpu.models.transformer_lm import MoETransformerLM
+    with pytest.raises(AssertionError, match="sp×pp"):
+        MoETransformerLM({**LM_CFG, "mesh": worker_mesh(2, pp=2, sp=2),
+                          "size": 2, "rank": 0, "pp": 2, "sp": 2,
+                          "pp_microbatches": 2, "moe_every": 1})

@@ -256,8 +256,6 @@ class TransformerLM(ModelBase):
                 setattr(self, k, int(self.config[k]))
         if self.sp > 1:
             from ..parallel.mesh import SEQ_AXIS
-            assert self.pp == 1, \
-                "sp composes with tp (3-D workers×model×seq) but not pp yet"
             assert self.mesh.shape.get(SEQ_AXIS) == self.sp, (
                 f"sp={self.sp} needs a mesh with a '{SEQ_AXIS}' axis of "
                 f"that size (worker_mesh(n, sp={self.sp})); got "
@@ -636,6 +634,10 @@ class MoETransformerLM(TransformerLM):
 
     def build_model(self) -> None:
         super().build_model()
+        assert not (self.sp > 1 and self.pp > 1), (
+            "MoE does not compose with sp×pp yet (the seq-sharded expert "
+            "specs don't thread through the pipeline's stacked-leaf "
+            "layout); dense TransformerLM does run sp×pp")
         cd = self.config.get("compute_dtype", jnp.bfloat16)
         for k in ("moe_experts", "moe_every", "moe_topk"):
             if k in self.config:

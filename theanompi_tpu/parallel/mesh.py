@@ -87,10 +87,6 @@ def worker_mesh(
     if devices is None:
         devices = jax.devices()
     tp, pp, sp = int(tp), int(pp), int(sp)
-    if sp > 1 and pp > 1:
-        raise NotImplementedError(
-            "sp does not compose with pp on one mesh yet (sp×tp does: "
-            "3-D workers×model×seq)")
     group = tp * pp * sp
     axes, shape = [axis_name], [0]
     for g, a in ((pp, PIPE_AXIS), (tp, MODEL_AXIS), (sp, SEQ_AXIS)):
