@@ -74,6 +74,7 @@ def main() -> int:
         tr.start(throttle={0: throttle} if throttle else None)
         deadline = time.time() + 360
         while (tr.islands[0].exchanges_done < 2
+               and tr.islands[0].error is None      # fail fast on a crash
                and time.time() < deadline):
             time.sleep(0.2)
         tr.stop_and_join(timeout=120)

@@ -109,3 +109,21 @@ def test_worker_mesh_warns_on_idle_remainder(mesh8):
     del mesh8
     with pytest.warns(UserWarning, match="left idle"):
         worker_mesh(None, tp=3, devices=jax.devices())   # 8 % 3 = 2 idle
+
+
+def test_4axis_tp_pp_sp_matches_dense(mesh8):
+    """round-4: ALL model-parallel axes at once — pipeline stages of
+    head-sharded ring-attention blocks over sequence-sharded microbatches
+    (workers×pipe×model×seq = 1×2×2×2) — matches the dense model."""
+    CFG = {**LM_CFG, "n_layer": 2}
+    dense = TransformerLM({**CFG, "mesh": worker_mesh(1), "size": 1,
+                           "rank": 0})
+    m4 = TransformerLM({**CFG, "mesh": worker_mesh(1, tp=2, pp=2, sp=2),
+                        "size": 1, "rank": 0, "tp": 2, "pp": 2, "sp": 2,
+                        "pp_microbatches": 2})
+    c_d = _train_steps(dense, 4)
+    c_4 = _train_steps(m4, 4)
+    np.testing.assert_allclose(c_4, c_d, rtol=5e-4, atol=5e-5)
+    m4.begin_val()
+    m4.val_iter(0)
+    m4.end_val()

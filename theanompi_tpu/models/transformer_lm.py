@@ -633,11 +633,13 @@ class MoETransformerLM(TransformerLM):
     capacity_factor = 1.25
 
     def build_model(self) -> None:
-        super().build_model()
-        assert not (self.sp > 1 and self.pp > 1), (
+        # config-only check: fail before the expensive dense build
+        assert not (int(self.config.get("sp", 1)) > 1
+                    and int(self.config.get("pp", 1)) > 1), (
             "MoE does not compose with sp×pp yet (the seq-sharded expert "
             "specs don't thread through the pipeline's stacked-leaf "
             "layout); dense TransformerLM does run sp×pp")
+        super().build_model()
         cd = self.config.get("compute_dtype", jnp.bfloat16)
         for k in ("moe_experts", "moe_every", "moe_topk"):
             if k in self.config:
