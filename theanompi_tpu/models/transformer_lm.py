@@ -633,12 +633,6 @@ class MoETransformerLM(TransformerLM):
     capacity_factor = 1.25
 
     def build_model(self) -> None:
-        # config-only check: fail before the expensive dense build
-        assert not (int(self.config.get("sp", 1)) > 1
-                    and int(self.config.get("pp", 1)) > 1), (
-            "MoE does not compose with sp×pp yet (the seq-sharded expert "
-            "specs don't thread through the pipeline's stacked-leaf "
-            "layout); dense TransformerLM does run sp×pp")
         super().build_model()
         cd = self.config.get("compute_dtype", jnp.bfloat16)
         for k in ("moe_experts", "moe_every", "moe_topk"):
@@ -706,6 +700,14 @@ class MoETransformerLM(TransformerLM):
             # the pp objective differs slightly from dense (the main loss is
             # pinned equal; the aux parity claim is scoped to dense/tp/ep)
             aux = aux_sum / (self.pp_microbatches * self.n_layer)
+            if self.sp > 1:
+                # each microbatch aux is seq-invariant (pmean'd in the MoE
+                # layer) but the scan carry was seeded from a seq-VARYING
+                # zero for its axis typing — re-anchor bit-exactly so the
+                # loss out-spec sees the invariance
+                from ..parallel.mesh import SEQ_AXIS
+                from ..parallel.steps import anchor_invariant
+                aux = anchor_invariant(aux, (SEQ_AXIS,))
         else:
             aux = jnp.zeros((), jnp.float32)
             n_moe = 0
