@@ -82,7 +82,10 @@ def worker_mesh(
     ``(workers, pipe, model)`` mesh — 'pipe' outer (one activation shift per
     stage per microbatch), 'model' inner (per-layer psums, the most frequent
     collective, ride adjacent chips).  ``sp > 1`` adds a ``'seq'`` axis
-    (sequence blocks, ``parallel/sp.py``) and is exclusive with tp/pp.
+    (sequence blocks, ``parallel/sp.py``); EVERY tp/pp/sp combination
+    composes (round-4), up to the full ``(workers, pipe, model, seq)``
+    stack — 'seq' innermost so ring-attention ppermutes (once per block
+    per ring tick, the hottest shifts) ride adjacent chips.
     """
     if devices is None:
         devices = jax.devices()
