@@ -89,45 +89,67 @@ def _attempt_recovery() -> None:
     time.sleep(wait)
 
 
+# the matrix labels always carry the batch; when BENCH_BATCH is unset the
+# run uses the model's class default — keep the two in sync so last_good
+# can't hand a b64 number to a default-b32 run
+_DEFAULT_BATCH = {"alexnet": 128, "googlenet": 32, "vgg16": 32,
+                  "resnet50": 32, "cifar10": 128, "transformer_lm": 16,
+                  "moe_lm": 16}
+
+
 def _cfg_matches(cfg: str) -> bool:
     """True when a matrix row label describes the SAME configuration this
     invocation was asked to measure — matching the matrix scripts' label
     conventions (model[-bN][-rule][-strategy][-spcK][-realdata][-...]).
-    A BSP run must not inherit an EASGD number and vice versa."""
+    Tokenized on '-' (substring checks would make 'asgd' match 'easgd');
+    a BSP run must not inherit an EASGD number and vice versa."""
     model = os.environ.get("BENCH_MODEL", "alexnet")
     if not cfg.startswith(model + "-"):
         return False
-    if os.environ.get("BENCH_BATCH") and \
-            f"-b{os.environ['BENCH_BATCH']}" not in cfg:
+    parts = set(cfg[len(model) + 1:].split("-"))
+    batch = os.environ.get("BENCH_BATCH") or _DEFAULT_BATCH.get(model)
+    if batch is not None and f"b{batch}" not in parts:
         return False
     rule = os.environ.get("BENCH_RULE", "bsp")
     for r in ("easgd", "asgd", "gosgd"):
-        if (r in cfg) != (rule == r):
+        if (r in parts) != (rule == r):
             return False
     strat = os.environ.get("BENCH_STRATEGY", "")
-    for s in ("topk", "onebit", "asa16", "ring", "copper"):
-        if (s in cfg) != (strat == s):
+    for s in ("topk", "onebit", "asa16", "asa32", "ring", "copper",
+              "copper16", "nccl16", "bf16"):
+        if (s in parts) != (strat == s):
             return False
     spc = os.environ.get("BENCH_SPC", "")
-    if spc and spc != "1":
-        if f"spc{spc}" not in cfg:
-            return False
-    elif "spc" in cfg:
+    want_spc = f"spc{spc}" if spc and spc != "1" else None
+    has_spc = any(p.startswith("spc") for p in parts)
+    if (want_spc is not None) != has_spc:
         return False
-    if ("realdata" in cfg) != (os.environ.get("BENCH_REAL_DATA") == "1"):
+    if want_spc is not None and want_spc not in parts:
         return False
-    if ("bnbf16" in cfg) != bool(os.environ.get("BENCH_BN_DTYPE")):
+    if ("realdata" in parts) != (os.environ.get("BENCH_REAL_DATA") == "1"):
+        return False
+    if ("bnbf16" in parts) != bool(os.environ.get("BENCH_BN_DTYPE")):
+        return False
+    if ("u8w" in parts) != (os.environ.get("BENCH_WIRE_U8") == "1"):
         return False
     return True
+
+
+def _matrix_round(path: str) -> int:
+    """Numeric round for perf_matrix_rN.jsonl (lexicographic sort would put
+    r10 before r4)."""
+    import re
+    m = re.search(r"_r(\d+)", os.path.basename(path))
+    return int(m.group(1)) if m else -1
 
 
 def _last_good() -> tuple[str, dict] | None:
     """Newest non-null perf-matrix row for the SAME configuration — the
     honest fallback number for a wedged round."""
-    best: tuple[str, dict] | None = None
     repo = os.path.dirname(os.path.abspath(__file__))
     for path in sorted(glob.glob(os.path.join(repo, "perf_matrix_*.jsonl")),
-                       reverse=True):
+                       key=_matrix_round, reverse=True):
+        rows: dict = {}
         for line in open(path):
             try:
                 row = json.loads(line)
@@ -136,13 +158,13 @@ def _last_good() -> tuple[str, dict] | None:
             cfg, res = row.get("config", ""), row.get("result")
             if not isinstance(res, dict) or not _cfg_matches(cfg):
                 continue
-            # prefer the base config (fewest suffix knobs) from the newest
-            # file; files are scanned newest-first so first-best wins ties
-            if best is None or len(cfg) < len(best[0]):
-                best = (cfg, res)
-        if best is not None:
-            return best
-    return best
+            rows[cfg] = res        # later duplicates win (newest re-measure)
+        if rows:
+            # prefer the base config (fewest suffix knobs) within the
+            # newest file that has any match
+            cfg = min(rows, key=len)
+            return cfg, rows[cfg]
+    return None
 
 
 def _fail(error: str) -> int:
@@ -248,11 +270,13 @@ def _peak_flops(device) -> float:
             return peak
     return 0.0
 
-def _ensure_bench_dataset(n_batches: int, batch_size: int) -> str:
+def _ensure_bench_dataset(n_batches: int, batch_size: int,
+                          data_dir: str = None) -> str:
     """Generate (once) a real on-disk batch-file dataset in the reference's
-    .hkl layout for the BENCH_REAL_DATA row; ~25 MB per 128-image file."""
-    d = os.environ.get("BENCH_DATA_DIR",
-                       f"/tmp/bench_imagenet_{batch_size}x{n_batches}")
+    .hkl layout for the BENCH_REAL_DATA row; ~25 MB per 128-image file.
+    Also the shared generator for scripts/loader_bench.py."""
+    d = data_dir or os.environ.get(
+        "BENCH_DATA_DIR", f"/tmp/bench_imagenet_{batch_size}x{n_batches}")
     # img_mean.npy is written LAST by make_batch_dataset.py — its presence
     # marks a complete dataset; a generation killed mid-write (the wrapper's
     # killpg on timeout) leaves train_hkl/ without it, so wipe and redo

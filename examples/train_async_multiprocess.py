@@ -14,8 +14,9 @@ One-machine demo on the simulated mesh (two terminals, or `&`):
   TMPI_FORCE_CPU=1 ROLE=server CENTER_PORT=47555 \\
       python examples/train_async_multiprocess.py
 
-  # terminal 2 — a second process joins the same center
-  TMPI_FORCE_CPU=1 ROLE=worker CENTER_ADDR=127.0.0.1:47555 ISLAND_BASE=1 \\
+  # terminal 2 — a second process joins the same center (ISLAND_BASE must
+  # clear the server's islands: it runs ids 0..ISLANDS-1, so base = 2)
+  TMPI_FORCE_CPU=1 ROLE=worker CENTER_ADDR=127.0.0.1:47555 ISLAND_BASE=2 \\
       python examples/train_async_multiprocess.py
 
 On a real pod, run ROLE=server on one host and ROLE=worker (with

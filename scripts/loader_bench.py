@@ -19,7 +19,6 @@ tunnel is down.
 import argparse
 import json
 import os
-import subprocess
 import sys
 import time
 
@@ -48,18 +47,10 @@ def main(argv=None) -> int:
     p.add_argument("--data-dir", default=None)
     args = p.parse_args(argv)
 
-    d = args.data_dir or f"/tmp/bench_imagenet_{args.batch_size}x{args.batches}"
-    if not os.path.isdir(os.path.join(d, "train_hkl")) or \
-            not os.path.exists(os.path.join(d, "img_mean.npy")):
-        print(f"generating {args.batches}x{args.batch_size} dataset at {d}",
-              file=sys.stderr)
-        subprocess.run(
-            [sys.executable,
-             os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                          "make_batch_dataset.py"),
-             "--synthetic", str(args.batches),
-             "--batch-size", str(args.batch_size), "--out", d],
-            check=True, stdout=sys.stderr)
+    # shared generator (half-generated-dir wipe included) — bench.py's
+    # import is wedge-safe: its module level touches no jax backend
+    from bench import _ensure_bench_dataset
+    d = _ensure_bench_dataset(args.batches, args.batch_size, args.data_dir)
 
     from theanompi_tpu.models.data.imagenet import ImageNet_data
 

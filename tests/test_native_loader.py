@@ -123,3 +123,37 @@ def test_u8_wire_trains_alexnet_smoke(mesh8):
     m.begin_val()
     m.val_iter(0)
     m.end_val()
+
+
+def test_u8_wire_mean_survives_para_load(tmp_path):
+    """Regression (round-4 review): with para_load on, the model's data is
+    a PrefetchLoader — the u8-wire device mean must still read the REAL
+    mean image through the wrapper, not fall back to the scalar 122."""
+    import subprocess
+    import sys as _sys
+
+    import jax.numpy as jnp
+    from theanompi_tpu.models.alex_net import AlexNet
+    from theanompi_tpu.parallel.mesh import worker_mesh
+
+    d = str(tmp_path / "mini_imagenet")
+    subprocess.run(
+        [_sys.executable, "scripts/make_batch_dataset.py", "--synthetic",
+         "4", "--batch-size", "4", "--out", d],
+        check=True, capture_output=True)
+    cfg = {"mesh": worker_mesh(1), "size": 1, "rank": 0, "verbose": False,
+           "batch_size": 4, "data_dir": d, "para_load": True,
+           "aug_wire_u8": True, "compute_dtype": jnp.float32}
+    m = AlexNet(cfg)
+    from theanompi_tpu.models.data.prefetch import PrefetchLoader
+    assert isinstance(m.data, PrefetchLoader)
+    mean = np.asarray(m._u8_input_mean())
+    # the generated img_mean.npy is a full [256,256,3] mean image — the
+    # device constant must be its center crop, not a scalar
+    assert mean.ndim == 3 and mean.shape[-1] == 3, mean.shape
+    import os as _os
+    full = np.load(_os.path.join(d, "img_mean.npy"))
+    c = mean.shape[0]
+    cy, cx = (full.shape[0] - c) // 2, (full.shape[1] - c) // 2
+    np.testing.assert_allclose(mean, full[cy:cy + c, cx:cx + c, :],
+                               rtol=1e-6)

@@ -69,6 +69,16 @@ class PrefetchLoader:
     def global_batch(self):
         return self._data.global_batch
 
+    def __getattr__(self, name):
+        # duck-typed passthrough for anything the wrapper doesn't override
+        # (img_mean/crop for the u8-wire device mean, synthetic, …) —
+        # __getattr__ fires only for MISSING attributes, so the wrapper's
+        # own surface wins.  Private/dunder lookups raise normally (also
+        # prevents recursion before __init__ sets _data).
+        if name.startswith("_"):
+            raise AttributeError(name)
+        return getattr(self._data, name)
+
     def shuffle_data(self, seed: int) -> None:
         """Reference cadence: called at epoch start; (re)starts the producer
         for one epoch's worth of train batches."""

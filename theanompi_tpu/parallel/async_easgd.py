@@ -133,10 +133,20 @@ class ElasticCenter:
             assert self._leaves is not None, "center not initialized yet"
             return [np.array(x) for x in self._leaves]
 
+    def _check_leaves(self, deltas) -> None:
+        # a client with a mismatched model config must fail LOUDLY here —
+        # zip would silently truncate the shared store and crash every
+        # other island at its next pull, far from the offender
+        assert self._leaves is not None, "center not initialized yet"
+        assert len(deltas) == len(self._leaves), (
+            f"push of {len(deltas)} leaves against a {len(self._leaves)}"
+            "-leaf center — mismatched model configs across islands?")
+
     def push_delta_leaves(self, deltas: List[np.ndarray],
                           island: int) -> None:
         a = self.alpha
         with self._lock:
+            self._check_leaves(deltas)
             self._leaves = [c + a * np.asarray(d, np.float32)
                             for c, d in zip(self._leaves, deltas)]
             self.n_updates += 1
@@ -146,6 +156,7 @@ class ElasticCenter:
     def push_pull_leaves(self, deltas: List[np.ndarray],
                          island: int) -> List[np.ndarray]:
         with self._lock:
+            self._check_leaves(deltas)
             self._leaves = [c + np.asarray(d, np.float32)
                             for c, d in zip(self._leaves, deltas)]
             self.n_updates += 1

@@ -108,33 +108,45 @@ class CenterServer:
                 try:
                     while True:
                         header, body = _recv_msg(self.request)
-                        op = header.get("op")
-                        if op == "init":
-                            center.ensure_init_leaves(_unpack_leaves(body))
-                            _send_msg(self.request, {"ok": True})
-                        elif op == "pull":
-                            _send_msg(self.request, {"ok": True},
-                                      _pack_leaves(center.pull_leaves()))
-                        elif op == "push":
-                            center.push_delta_leaves(_unpack_leaves(body),
-                                                     int(header["island"]))
-                            _send_msg(self.request, {"ok": True})
-                        elif op == "push_pull":
-                            leaves = center.push_pull_leaves(
-                                _unpack_leaves(body), int(header["island"]))
-                            _send_msg(self.request, {"ok": True},
-                                      _pack_leaves(leaves))
-                        elif op == "stats":
-                            _send_msg(self.request, {
-                                "ok": True,
-                                "n_updates": center.n_updates,
-                                "by_island": center.updates_by_island})
-                        else:
+                        try:
+                            self._dispatch(header, body)
+                        except (ConnectionError, OSError):
+                            raise
+                        except Exception as e:
+                            # op-level failures (shape/leaf-count mismatch,
+                            # pull-before-init) reply with the REAL cause —
+                            # a bare connection close would surface to the
+                            # client as an opaque network error
                             _send_msg(self.request,
-                                      {"ok": False,
-                                       "error": f"unknown op {op!r}"})
+                                      {"ok": False, "error": repr(e)})
                 except (ConnectionError, OSError):
                     return             # client went away — fine
+
+            def _dispatch(self, header, body):
+                op = header.get("op")
+                if op == "init":
+                    center.ensure_init_leaves(_unpack_leaves(body))
+                    _send_msg(self.request, {"ok": True})
+                elif op == "pull":
+                    _send_msg(self.request, {"ok": True},
+                              _pack_leaves(center.pull_leaves()))
+                elif op == "push":
+                    center.push_delta_leaves(_unpack_leaves(body),
+                                             int(header["island"]))
+                    _send_msg(self.request, {"ok": True})
+                elif op == "push_pull":
+                    leaves = center.push_pull_leaves(
+                        _unpack_leaves(body), int(header["island"]))
+                    _send_msg(self.request, {"ok": True},
+                              _pack_leaves(leaves))
+                elif op == "stats":
+                    _send_msg(self.request, {
+                        "ok": True,
+                        "n_updates": center.n_updates,
+                        "by_island": center.updates_by_island})
+                else:
+                    _send_msg(self.request,
+                              {"ok": False, "error": f"unknown op {op!r}"})
 
         socketserver.ThreadingTCPServer.allow_reuse_address = True
         self._srv = socketserver.ThreadingTCPServer((host, port), Handler)
