@@ -1,0 +1,105 @@
+"""Wedge-proof bench harness internals (bench.py at the repo root): the
+config-matched last_good fallback and the canonical matrix merge — pure
+host logic, no backend needed."""
+
+import importlib
+import json
+import os
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+bench = importlib.import_module("bench")
+merge_matrix = importlib.import_module("scripts.merge_matrix")
+
+
+@pytest.fixture
+def env(monkeypatch):
+    """Clean BENCH_* env for each case."""
+    for k in list(os.environ):
+        if k.startswith("BENCH_"):
+            monkeypatch.delenv(k, raising=False)
+    return monkeypatch
+
+
+@pytest.mark.parametrize("envs,cfg,want", [
+    # default alexnet BSP at its class-default batch
+    ({}, "alexnet-b128", True),
+    ({}, "alexnet-b128-spc4", False),          # spc row ≠ spc-less run
+    ({}, "alexnet-b128-realdata", False),
+    ({"BENCH_SPC": "4"}, "alexnet-b128-spc4", True),
+    ({"BENCH_SPC": "4"}, "alexnet-b128-spc8", False),
+    # 'asgd' must NOT substring-match 'easgd' rows (round-4 review catch)
+    ({"BENCH_MODEL": "vgg16", "BENCH_RULE": "easgd"},
+     "vgg16-b32-easgd", True),
+    ({"BENCH_MODEL": "vgg16", "BENCH_RULE": "asgd"},
+     "vgg16-b32-easgd", False),
+    ({"BENCH_MODEL": "vgg16"}, "vgg16-b32-easgd", False),
+    # default-batch pinning: a b64 row must not serve a default-b32 run
+    ({"BENCH_MODEL": "resnet50"}, "resnet50-b64", False),
+    ({"BENCH_MODEL": "resnet50"}, "resnet50-b32", True),
+    ({"BENCH_MODEL": "resnet50", "BENCH_BATCH": "64"},
+     "resnet50-b64", True),
+    # u8-wire rows are their own configuration
+    ({"BENCH_MODEL": "alexnet", "BENCH_REAL_DATA": "1"},
+     "alexnet-b128-realdata", True),
+    ({"BENCH_MODEL": "alexnet", "BENCH_REAL_DATA": "1"},
+     "alexnet-b128-realdata-u8w", False),
+    ({"BENCH_MODEL": "alexnet", "BENCH_REAL_DATA": "1",
+      "BENCH_WIRE_U8": "1"}, "alexnet-b128-realdata-u8w", True),
+    # strategy rows
+    ({"BENCH_MODEL": "vgg16", "BENCH_STRATEGY": "topk"},
+     "vgg16-b32-topk", True),
+    ({"BENCH_MODEL": "vgg16"}, "vgg16-b32-topk", False),
+    # bf16-BN lever rows
+    ({"BENCH_MODEL": "resnet50", "BENCH_BN_DTYPE": "bfloat16"},
+     "resnet50-b32-bnbf16", True),
+    ({"BENCH_MODEL": "resnet50"}, "resnet50-b32-bnbf16", False),
+])
+def test_cfg_matches(env, envs, cfg, want):
+    for k, v in envs.items():
+        env.setenv(k, v)
+    assert bench._cfg_matches(cfg) is want
+
+
+def test_last_good_prefers_newest_round_and_duplicate(env, tmp_path,
+                                                      monkeypatch):
+    """Numeric round ordering (r10 > r4 > r3) and newest-duplicate-wins
+    within a file; the base config beats suffixed ones on ties."""
+    repo = tmp_path
+    def row(cfg, value):
+        return json.dumps({"config": cfg, "result": {
+            "metric": "m", "value": value, "unit": "u",
+            "vs_baseline": 1.0}}) + "\n"
+    (repo / "perf_matrix_r3.jsonl").write_text(row("alexnet-b128", 1.0))
+    (repo / "perf_matrix_r4.jsonl").write_text(
+        row("alexnet-b128", 2.0) + row("alexnet-b128", 3.0))
+    (repo / "perf_matrix_r10.jsonl").write_text(row("alexnet-b128", 4.0))
+    # point bench's repo root at the tmp dir (its _last_good derives the
+    # matrix glob from __file__); patching the module attr is scoped
+    monkeypatch.setattr(bench, "__file__", str(repo / "bench.py"))
+    got = bench._last_good()
+    assert got is not None
+    cfg, res = got
+    assert cfg == "alexnet-b128" and res["value"] == 4.0
+    # without r10, the newest duplicate in r4 wins
+    (repo / "perf_matrix_r10.jsonl").unlink()
+    cfg, res = bench._last_good()
+    assert res["value"] == 3.0
+
+
+def test_merge_matrix_last_nonnull_wins(tmp_path):
+    p = tmp_path / "m.jsonl"
+    rows = [
+        {"config": "a", "result": None},
+        {"config": "b", "result": {"metric": "m", "value": 1}},
+        {"config": "a", "result": {"metric": "m", "value": 2}},
+        {"config": "b", "result": None},          # null cannot demote
+    ]
+    p.write_text("\n".join(json.dumps(r) for r in rows) + "\ngarbage{{{\n")
+    merge_matrix.merge([str(p)])
+    out = [json.loads(l) for l in p.read_text().splitlines()]
+    assert [r["config"] for r in out] == ["a", "b"]   # first-seen order
+    assert out[0]["result"]["value"] == 2
+    assert out[1]["result"]["value"] == 1
