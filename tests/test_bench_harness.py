@@ -103,3 +103,37 @@ def test_merge_matrix_last_nonnull_wins(tmp_path):
     assert [r["config"] for r in out] == ["a", "b"]   # first-seen order
     assert out[0]["result"]["value"] == 2
     assert out[1]["result"]["value"] == 1
+
+
+def _run_bench(env_extra, timeout=420):
+    import subprocess
+    env = {k: v for k, v in os.environ.items()
+           if not k.startswith("BENCH_")}
+    env.update(env_extra)
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    r = subprocess.run([sys.executable, os.path.join(repo, "bench.py")],
+                       capture_output=True, text=True, timeout=timeout,
+                       env=env, cwd=repo)
+    lines = [ln for ln in r.stdout.splitlines() if ln.strip()]
+    return r.returncode, (json.loads(lines[-1]) if lines else None)
+
+
+def test_wrapper_cpu_success_end_to_end():
+    """The driver's exact invocation shape, forced to CPU: one JSON line
+    with the metric contract keys."""
+    rc, out = _run_bench({"BENCH_FORCE_CPU": "1", "BENCH_MODEL": "cifar10",
+                          "BENCH_BATCH": "16", "BENCH_ITERS": "2",
+                          "BENCH_WARMUP": "1"})
+    assert rc == 0, out
+    assert set(out) >= {"metric", "value", "unit", "vs_baseline"}
+    assert out["value"] > 0 and "cpu" in out["metric"]
+
+
+def test_wrapper_timeout_kills_and_reports():
+    """A hung measurement dies at BENCH_TIMEOUT as a process group and the
+    wrapper still emits structured JSON (no last_good for this config →
+    rc 3 with the error)."""
+    rc, out = _run_bench({"BENCH_FORCE_CPU": "1", "BENCH_MODEL": "cifar10",
+                          "BENCH_BATCH": "16", "BENCH_TIMEOUT": "3"})
+    assert rc in (0, 3)
+    assert "error" in out and "BENCH_TIMEOUT" in out["error"]
