@@ -13,31 +13,36 @@ OUT="${1:-perf_matrix_r4.jsonl}"
 cd "$(dirname "$0")/.."
 . scripts/_bench_row.sh
 
+# Row order is greedy-by-value-per-minute-of-tunnel-uptime: the first
+# round-4 window lasted ~10 min (one row + one wedge mid-spc4-compile), so
+# each pass front-loads the highest-value UNMEASURED configs with the
+# quickest compiles, and pushes the wedge-correlated big compiles (spc
+# scans — today's trigger — and the transformer family) to the back.
+# Measured rows are skipped, so later passes reach the back of the list.
+
 # -- staged configs at reference batch sizes (the comparison that counts) --
 run alexnet-b128             BENCH_MODEL=alexnet
-run alexnet-b128-spc4        BENCH_MODEL=alexnet  BENCH_SPC=4
-run alexnet-b128-spc8        BENCH_MODEL=alexnet  BENCH_SPC=8 BENCH_SYNTH_BATCHES=8
-run googlenet-b32            BENCH_MODEL=googlenet
-run googlenet-b32-spc8       BENCH_MODEL=googlenet BENCH_SPC=8 BENCH_SYNTH_BATCHES=8
 run resnet50-b32             BENCH_MODEL=resnet50
-run resnet50-b32-spc8        BENCH_MODEL=resnet50 BENCH_SPC=8 BENCH_SYNTH_BATCHES=8
+run googlenet-b32            BENCH_MODEL=googlenet
+run vgg16-b32                BENCH_MODEL=vgg16
 run cifar10-b128             BENCH_MODEL=cifar10
 
 # -- bf16-BN lever A/B (round-3 trace: BN stat reductions = 16% of ResNet
 #    busy time; the verdict wants the lever MEASURED, not just shipped) --
 run resnet50-b32-bnbf16      BENCH_MODEL=resnet50 BENCH_BN_DTYPE=bfloat16
-run resnet50-b32-spc8-bnbf16 BENCH_MODEL=resnet50 BENCH_SPC=8 BENCH_SYNTH_BATCHES=8 BENCH_BN_DTYPE=bfloat16
 
 # -- batch-size headroom (MFU pushes; verdict #2 wants b128 rows) --
 run resnet50-b64             BENCH_MODEL=resnet50 BENCH_BATCH=64
 run resnet50-b128            BENCH_MODEL=resnet50 BENCH_BATCH=128
 run resnet50-b128-bnbf16     BENCH_MODEL=resnet50 BENCH_BATCH=128 BENCH_BN_DTYPE=bfloat16
-run resnet50-b128-spc4       BENCH_MODEL=resnet50 BENCH_BATCH=128 BENCH_SPC=4
 run googlenet-b128           BENCH_MODEL=googlenet BENCH_BATCH=128
-run googlenet-b128-spc4      BENCH_MODEL=googlenet BENCH_BATCH=128 BENCH_SPC=4
+run vgg16-b64                BENCH_MODEL=vgg16 BENCH_BATCH=64
 
-# -- staged rules on their staged models (BASELINE.json #3/#4) --
+# -- staged rules + compressed wire on their staged models (BASELINE #3-#5) --
+run vgg16-b32-easgd          BENCH_MODEL=vgg16 BENCH_RULE=easgd
 run resnet50-b32-gosgd       BENCH_MODEL=resnet50 BENCH_RULE=gosgd
+run vgg16-b32-topk           BENCH_MODEL=vgg16 BENCH_STRATEGY=topk
+run vgg16-b32-onebit         BENCH_MODEL=vgg16 BENCH_STRATEGY=onebit
 
 # -- real-data path (verdict #3): .hkl shards -> native loader -> device --
 run alexnet-b128-realdata    BENCH_MODEL=alexnet BENCH_REAL_DATA=1
@@ -50,13 +55,16 @@ run transformer_lm-b16       BENCH_MODEL=transformer_lm BENCH_BATCH=16 BENCH_CFG
 run transformer_lm-b16-flash BENCH_MODEL=transformer_lm BENCH_BATCH=16 BENCH_CFG="${LM_CFG%\}},\"attn_impl\":\"flash\"}"
 run moe_lm-b16               BENCH_MODEL=moe_lm         BENCH_BATCH=16 BENCH_CFG="$LM_CFG"
 
-# -- vgg16 last: prime wedge suspect (staged configs #3 and #5) --
-run vgg16-b32                BENCH_MODEL=vgg16
+# -- spc (multi-step dispatch) rows LAST: the scan-of-k-steps compile is
+#    the biggest program per model and the round-4 wedge #1 trigger --
+run alexnet-b128-spc4        BENCH_MODEL=alexnet  BENCH_SPC=4
+run alexnet-b128-spc8        BENCH_MODEL=alexnet  BENCH_SPC=8 BENCH_SYNTH_BATCHES=8
+run googlenet-b32-spc8       BENCH_MODEL=googlenet BENCH_SPC=8 BENCH_SYNTH_BATCHES=8
+run resnet50-b32-spc8        BENCH_MODEL=resnet50 BENCH_SPC=8 BENCH_SYNTH_BATCHES=8
+run resnet50-b32-spc8-bnbf16 BENCH_MODEL=resnet50 BENCH_SPC=8 BENCH_SYNTH_BATCHES=8 BENCH_BN_DTYPE=bfloat16
+run resnet50-b128-spc4       BENCH_MODEL=resnet50 BENCH_BATCH=128 BENCH_SPC=4
+run googlenet-b128-spc4      BENCH_MODEL=googlenet BENCH_BATCH=128 BENCH_SPC=4
 run vgg16-b32-spc4           BENCH_MODEL=vgg16 BENCH_SPC=4
-run vgg16-b32-easgd          BENCH_MODEL=vgg16 BENCH_RULE=easgd
-run vgg16-b32-topk           BENCH_MODEL=vgg16 BENCH_STRATEGY=topk
-run vgg16-b32-onebit         BENCH_MODEL=vgg16 BENCH_STRATEGY=onebit
-run vgg16-b64                BENCH_MODEL=vgg16 BENCH_BATCH=64
 
 python scripts/merge_matrix.py "$OUT"
 cat "$OUT"

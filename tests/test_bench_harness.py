@@ -107,8 +107,13 @@ def test_merge_matrix_last_nonnull_wins(tmp_path):
 
 def _run_bench(env_extra, timeout=420):
     import subprocess
+    import tempfile
     env = {k: v for k, v in os.environ.items()
            if not k.startswith("BENCH_")}
+    # each case gets a FRESH compile-cache dir: the timeout test's workload
+    # must pay the real compile (a warm hit from a prior case could finish
+    # inside BENCH_TIMEOUT and flip the expected failure into a success)
+    env["BENCH_COMPILE_CACHE"] = tempfile.mkdtemp(prefix="bench_cache_")
     env.update(env_extra)
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     r = subprocess.run([sys.executable, os.path.join(repo, "bench.py")],
