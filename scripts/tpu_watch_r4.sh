@@ -24,7 +24,10 @@ done_rows() {
   grep -cF '"result": {"metric"' "$OUT" || true
 }
 
-for i in $(seq 1 66); do
+# Probe every 2 min: the round-4 wedge history shows tunnel-alive windows
+# as short as ~10 min, so a 10-min probe cadence could eat a whole window.
+# 420 probes x ~2.5 min worst-case spacing covers the full ~12 h round.
+for i in $(seq 1 420); do
   # platform must be CHECKED in-process: a wedged tunnel can fall back to
   # the CPU backend with only a warning, and CPU-speed rows would corrupt
   # the MFU table this matrix feeds
@@ -40,7 +43,7 @@ for i in $(seq 1 66); do
       exit 0
     fi
   fi
-  sleep 600
+  sleep 120
 done
-echo "$(date -u) gave up after 66 probes; $(done_rows)/$N_CONFIGS rows" >&2
+echo "$(date -u) gave up after 420 probes; $(done_rows)/$N_CONFIGS rows" >&2
 exit 2
