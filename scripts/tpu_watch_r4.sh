@@ -1,5 +1,5 @@
 #!/usr/bin/env bash
-# Round-4 watcher: probe the axon TPU tunnel every 10 min; whenever a REAL
+# Round-4 watcher: probe the axon TPU tunnel every 2 min; whenever a REAL
 # TPU answers, run the round-4 perf matrix (resumable — measured rows are
 # skipped), merge, and exit once every config has a number.  Survives
 # repeat wedges: a mid-matrix wedge leaves null rows that the next recovery

@@ -135,6 +135,32 @@ class ModelBase:
                              template, model_shards=shards,
                              pspecs=pspecs, model_axes=maxes)
 
+        self._fsdp = None
+        if self.config.get("fsdp", False):
+            # FSDP / ZeRO-3 (parallel/fsdp.py): params themselves shard over
+            # the workers axis as flat [chunk] shards; the step gathers the
+            # full tree transiently and the AD transpose reduce-scatters the
+            # grads.  The optimizer (incl. an EMA wrapper above) operates on
+            # the chunk natively, so zero_opt is subsumed, not composed.
+            assert not self.config.get("zero_opt", False), (
+                "fsdp=true subsumes zero_opt (the optimizer state already "
+                "lives on the parameter chunk) — drop zero_opt")
+            assert self.param_specs() is None, (
+                "fsdp shards params over the workers axis; tensor/pipeline "
+                "models already shard them over the model axes — unsupported")
+            assert all(self.mesh.shape[a] == 1 for a in self.mesh.axis_names
+                       if a != WORKER_AXIS), (
+                "fsdp currently supports pure data-parallel meshes")
+            assert not getattr(self, "gates_opt_state_by_path", False) and \
+                type(self).postprocess_grads is ModelBase.postprocess_grads \
+                and type(self).postprocess_update is \
+                ModelBase.postprocess_update, (
+                "fsdp flattens params into per-worker chunks — models that "
+                "transform grads/updates tree-wise (the GANs) cannot compose")
+            from ..parallel.fsdp import FsdpLayout
+            self._fsdp = FsdpLayout(self.params,
+                                    self.mesh.shape[WORKER_AXIS])
+
         self.step_state: Optional[Dict[str, Any]] = None
         self._state_specs = None
         self.train_fn = None
@@ -248,6 +274,19 @@ class ModelBase:
         here: jit the SPMD train/val steps and box the state onto the mesh."""
         from ..parallel.exchanger import BSP_Exchanger
         self.exchanger = exchanger or BSP_Exchanger(self.config)
+        if self._fsdp is not None:
+            # the gradient reduction is the all_gather's AD transpose — a
+            # plain fp32 sum.  Any OTHER configured strategy (wire casts,
+            # compression) would be silently ignored: the exchanger's
+            # strategy hook never runs on the fsdp path.
+            assert (isinstance(self.exchanger, BSP_Exchanger)
+                    and self.exchanger.mode == "grads"
+                    and self.exchanger.strategy.name == "allreduce"), (
+                "fsdp=true fuses the exchange as all_gather/psum_scatter — "
+                "only BSP grads mode with the exact 'allreduce' strategy "
+                f"composes; got {type(self.exchanger).__name__} mode="
+                f"{getattr(self.exchanger, 'mode', '?')} strategy="
+                f"{getattr(getattr(self.exchanger, 'strategy', None), 'name', '?')}")
         if self.config.get("zero_opt", False) or self.config.get("ema_decay"):
             # ZeRO-1 assumes every worker sees the SAME reduced gradient and
             # holds identical params — true only under BSP grads mode with a
@@ -269,8 +308,17 @@ class ModelBase:
         n = self.mesh.shape[WORKER_AXIS]
 
         extra = self.exchanger.extra_state_template()
-        opt_state = self.opt.init(self.params)
-        unboxed = {"params": self.params, "opt_state": opt_state,
+        if self._fsdp is not None:
+            # optimizer state lives on THIS worker's flat chunk (identical
+            # zeros template per worker — broadcast replicates it; the real
+            # per-worker chunks land below via place_boxed)
+            opt_state = self.opt.init(
+                jnp.zeros((self._fsdp.chunk,), jnp.float32))
+            params_init = np.zeros((self._fsdp.chunk,), np.float32)
+        else:
+            opt_state = self.opt.init(self.params)
+            params_init = self.params
+        unboxed = {"params": params_init, "opt_state": opt_state,
                    "bn_state": self.bn_state, "extra": extra}
         self._state_specs = None if self.param_specs() is None else \
             steps.state_partition_specs(self, self.exchanger)
@@ -279,6 +327,9 @@ class ModelBase:
                 v, n, self.mesh,
                 None if self._state_specs is None else self._state_specs[k])
             for k, v in unboxed.items()}
+        if self._fsdp is not None:
+            self.step_state["params"] = steps.place_boxed(
+                self._fsdp.chunk_host(self.params), self.mesh)
         spc = int(self.steps_per_call)
         if spc > 1:
             # multi-step dispatch skips the between-steps Python exchange
@@ -368,6 +419,12 @@ class ModelBase:
             bn_mean = jax.tree.map(lambda x: np.mean(np.asarray(x), axis=0),
                                    bn)
             self._val_bn_boxed = steps.replicate_tree(bn_mean, n, self.mesh)
+        elif self._fsdp is not None:
+            # FSDP: assemble the full tree on-device from the chunks (the
+            # EMA shadow's chunks when enabled and seeded, else the live
+            # ones) — the val step then sees the standard boxed params.
+            self._val_params_boxed = self._fsdp_val_fn()(self.step_state)
+            self._val_bn_boxed = self.step_state["bn_state"]
         else:
             # BSP: validate the EMA shadow when enabled, else the replicas
             if self.config.get("ema_decay"):
@@ -463,6 +520,9 @@ class ModelBase:
             return jax.device_get(self.exchanger.canonical_params(state))
         if self.config.get("ema_decay"):
             return self._ema_host_params()
+        if self._fsdp is not None:
+            return self._fsdp.host_params_from_chunks(np.asarray(
+                steps.tree_to_host(self.step_state["params"])))
         return steps.unbox(jax.device_get(
             steps.tree_to_host(self.step_state["params"])))
 
@@ -471,6 +531,13 @@ class ModelBase:
         full tree; under zero_opt the shadow is SHARDED chunks, gathered and
         unflattened here (read-time only).  Before the first update the
         shadow is unseeded (zeros) — fall back to the live params."""
+        if self._fsdp is not None:
+            st = self.step_state["opt_state"]
+            t = int(np.asarray(jax.device_get(
+                steps.tree_to_host(st["t"])))[0])
+            src = self.step_state["params"] if t == 0 else st["ema"]
+            return self._fsdp.host_params_from_chunks(
+                np.asarray(steps.tree_to_host(src)))
         st = self.step_state["opt_state"]
         inner = st if "ema" in st else st["opt"]
         t = int(np.asarray(jax.device_get(
@@ -530,6 +597,33 @@ class ModelBase:
                 out_specs=out_specs))
         return self._zero_shadow_jit
 
+    def _fsdp_val_fn(self):
+        """Jitted on-device assemble of the full boxed params from the FSDP
+        chunks — the EMA shadow chunks when enabled AND seeded (``t > 0``),
+        else the live ones; the two branches share one traced program via a
+        ``where`` on the shadow's step counter."""
+        if getattr(self, "_fsdp_val_jit", None) is None:
+            from jax.sharding import PartitionSpec as P
+            fsdp = self._fsdp
+            ema = bool(self.config.get("ema_decay"))
+            state_spec = {k: P(WORKER_AXIS)
+                          for k in ("params", "opt_state", "bn_state",
+                                    "extra")}
+
+            def body(state):
+                chunk = steps.unbox(state["params"])
+                if ema:
+                    st = steps.unbox(state["opt_state"])
+                    chunk = jnp.where(st["t"] == 0, chunk, st["ema"])
+                tree = fsdp.gather_params(chunk)
+                return jax.tree.map(lambda v: v[None], tree)   # box/worker
+
+            self._fsdp_val_jit = jax.jit(jax.shard_map(
+                body, mesh=self.mesh, in_specs=(state_spec,),
+                out_specs=jax.tree.map(lambda _: P(WORKER_AXIS),
+                                       self.params)))
+        return self._fsdp_val_jit
+
     def next_exchange_key(self):
         self._exch_key, sub = jax.random.split(self._exch_key)
         return sub
@@ -553,6 +647,9 @@ class ModelBase:
         elif self.config.get("ema_decay"):
             # the .npy snapshot holds what inference should use — the shadow
             params_npy = self._ema_host_params()
+        elif self._fsdp is not None:
+            params_npy = self._fsdp.host_params_from_chunks(
+                np.asarray(state["params"]))
         else:
             params_npy = steps.unbox(state["params"])
         # PER-PART dedup: bit-identical parts persist ONE replica instead of

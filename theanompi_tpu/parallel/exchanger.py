@@ -76,15 +76,13 @@ class Exchanger:
     """
 
     name = "exchanger"
-    # True only when every worker's FULL step_state stays bit-identical (so
-    # checkpoints may persist one replica instead of n) — BSP grads mode with
-    # a stateless strategy; never async rules or per-worker EF state.
-    replicas_identical = False
 
     def identical_parts(self):
-        """State parts bit-identical across workers — checkpoint dedup is
-        PER PART (e.g. ZeRO-1 shards only the optimizer state, so params
-        still dedup to one replica on disk)."""
+        """State parts bit-identical across workers (checkpoint dedup is
+        PER PART — e.g. ZeRO-1 shards only the optimizer state, so params
+        still dedup to one replica on disk; FSDP chunks neither): BSP grads
+        mode with a stateless strategy; never async rules or per-worker EF
+        state."""
         return ()
 
     def _group_axes(self):
@@ -209,25 +207,22 @@ class BSP_Exchanger(Exchanger):
         self.strategy: Strategy = get_strategy(
             self.config.get("exch_strategy", "allreduce"))
 
-    @property
-    def replicas_identical(self) -> bool:
+    def identical_parts(self):
         # grads mode: every worker applies the same reduced gradient; params
         # mode keeps per-worker momentum; stateful strategies carry
         # per-worker error feedback; the measurement-only 'none' strategy
-        # skips the collective entirely; ZeRO-1 deliberately shards the
-        # optimizer state per worker — all of those break replica identity
+        # skips the collective entirely; ZeRO-1/FSDP deliberately shard
+        # their parts per worker — all of those break replica identity
         # (for checkpoint dedup purposes).
-        return (self.mode == "grads" and not self.strategy.stateful
-                and self.strategy.name != "none"
-                and not self.config.get("zero_opt", False))
-
-    def identical_parts(self):
         if not (self.mode == "grads" and not self.strategy.stateful
                 and self.strategy.name != "none"):
             return ()
         parts = {"params", "opt_state", "bn_state", "extra"}
         if self.config.get("zero_opt", False):
             parts.discard("opt_state")    # the ZeRO partition differs/worker
+        if self.config.get("fsdp", False):
+            parts.discard("params")       # FSDP chunks are the partition:
+            parts.discard("opt_state")    # genuinely per-worker state
         return tuple(sorted(parts))
 
     def extra_specs(self, param_specs):

@@ -1,0 +1,103 @@
+"""FSDP / ZeRO-3: parameters themselves sharded over the data-parallel axis.
+
+Beyond-parity capability (the reference — Theano-MPI, SURVEY.md §1 — kept a
+full parameter replica per GPU; its memory ceiling per worker was the whole
+model).  ZeRO stage 3 / PyTorch-FSDP semantics: each worker PERSISTS only
+``1/N`` of the flattened parameters (plus the optimizer state for that same
+chunk — ZeRO-1 is subsumed), and the full parameters exist only transiently
+inside the compiled step:
+
+    full   = all_gather(my_chunk)              # one ICI allgather
+    loss   = model.loss(unflatten(full), ...)  # fwd+bwd on the full tree
+    g_chunk= AD transpose                      # psum_scatter — automatic!
+    chunk' = opt.update(g_chunk/N, my_state, my_chunk)
+
+The gradient reduce-scatter is NOT written anywhere: differentiating through
+``lax.all_gather`` transposes to ``lax.psum_scatter``, so each worker's
+gradient chunk arrives already summed across workers — the BSP mean is one
+multiply away.  This is the idiomatic JAX formulation (manual-collective
+``shard_map`` flavor) of the scaling-book's FSDP recipe: persistent state
+sharded, XLA inserts the gather/scatter pair per step, both ride ICI.
+
+Memory per chip: persistent params+optimizer+EMA all ÷N (pad ≤ N−1
+elements); the transient peak still holds one full gathered parameter set
+during fwd/bwd (whole-model gather — per-layer regather would need the
+layer stack's cooperation and is out of scope; with ``n_subb`` microbatches
+the gather re-runs per microbatch inside the scan, trading one allgather
+per microbatch for activation memory).
+
+Composition: BSP grads mode with the exact ``allreduce`` strategy only (the
+reduction IS the AD transpose, so wire-compressed strategies have no hook
+here); composes with EMA (the shadow tracks the chunk), ``n_subb``,
+``steps_per_call``, ``grad_clip`` (global norm via one extra psum), and the
+checkpoint machinery (chunks are per-worker state, saved boxed).  Pure
+data-parallel layouts only (``param_specs() is None``); tensor/pipeline
+models already shard their params over the model axes.
+
+Config: ``fsdp=true`` on any BSP session.  Pinned in ``tests/test_fsdp.py``
+(trajectory equality with plain BSP, EMA/ckpt/clip composition, the ÷N
+layout fact).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from ..utils import helper_funcs
+from .mesh import WORKER_AXIS
+
+
+class FsdpLayout:
+    """Flat-chunk layout facts for a parameter tree: chunk size, padding,
+    and a shape-only template for unflattening (values never captured —
+    closing the real host params into a traced function would constant-fold
+    them into the executable)."""
+
+    def __init__(self, params, n_workers: int):
+        self.n_workers = int(n_workers)
+        self.n_total = helper_funcs.tree_size(params)
+        self.chunk = -(-self.n_total // self.n_workers)          # ceil
+        self.padded = self.chunk * self.n_workers
+        self.template = jax.tree.map(
+            lambda x: jax.ShapeDtypeStruct(np.shape(x), x.dtype), params)
+
+    # -- host side ----------------------------------------------------------
+
+    def chunk_host(self, params) -> np.ndarray:
+        """``[n_workers, chunk]`` float32 chunks of the flattened params —
+        the boxed step-state layout (each worker's row IS its shard)."""
+        leaves = jax.tree.leaves(jax.device_get(params))
+        flat = np.concatenate([np.asarray(l, np.float32).reshape(-1)
+                               for l in leaves])
+        flat = np.pad(flat, (0, self.padded - flat.shape[0]))
+        return flat.reshape(self.n_workers, self.chunk)
+
+    def host_params_from_chunks(self, boxed_chunks) -> object:
+        """Inverse of :meth:`chunk_host`: host full tree from the boxed
+        ``[n_workers, chunk]`` array (checkpoint .npy snapshots)."""
+        return helper_funcs.unflatten_like(
+            self.template, np.asarray(boxed_chunks, np.float32).reshape(-1))
+
+    # -- traced (inside shard_map) -------------------------------------------
+
+    def gather_params(self, chunk, axis: str = WORKER_AXIS):
+        """Full parameter tree from this worker's ``[chunk]`` shard.  The
+        AD transpose of the ``all_gather`` is ``psum_scatter``: the caller's
+        gradient w.r.t. ``chunk`` arrives summed over workers."""
+        full = lax.all_gather(chunk, axis, tiled=True)           # [padded]
+        return helper_funcs.unflatten_like(self.template, full)
+
+    def clip_chunk(self, g_chunk, clip: float, axis: str = WORKER_AXIS):
+        """Global-L2-norm clipping on the chunked gradient: chunks partition
+        the padded flat vector (pad entries carry zero gradient), so the
+        true global norm is one scalar psum away; every worker then scales
+        by the same factor, preserving the partition semantics."""
+        if clip <= 0.0:
+            return g_chunk
+        sq = lax.psum(jnp.sum(jnp.square(g_chunk)), axis)
+        norm = jnp.sqrt(sq)
+        scale = jnp.minimum(1.0, clip / jnp.maximum(norm, 1e-12))
+        return g_chunk * scale

@@ -252,8 +252,42 @@ def build_train_step(mesh: Mesh, model, exchanger, n_steps: int = 1) -> Callable
     axis = WORKER_AXIS
     n = mesh.shape[axis]
     n_subb = getattr(model, "n_subb", 1)
+    fsdp = getattr(model, "_fsdp", None)       # FsdpLayout when fsdp=true
+
+    def fsdp_step(state, batch, lr, rng, count):
+        # FSDP / ZeRO-3 (parallel/fsdp.py): state["params"] is this
+        # worker's [chunk] flat shard.  The loss gathers the full tree
+        # per (micro)batch; differentiating w.r.t. the chunk transposes
+        # the all_gather into psum_scatter, so grads arrive pre-summed
+        # over workers — the whole BSP exchange with no exchanger hook.
+        chunk = unbox(state["params"])
+        opt_state = unbox(state["opt_state"])
+        bn_state = unbox(state["bn_state"])
+        ridx = lax.axis_index(axis)
+        local_rng = jax.random.fold_in(jax.random.fold_in(rng, ridx), count)
+
+        def loss_fn(ch, bn, b, r, train):
+            return model.loss_and_metrics(fsdp.gather_params(ch, axis),
+                                          bn, b, r, train)
+
+        cost, err, g_chunk, new_bn = _accumulate_grads(
+            loss_fn, chunk, bn_state, batch, local_rng, n_subb)
+        g_chunk = g_chunk * (1.0 / n)          # transpose summed; BSP means
+        g_chunk = fsdp.clip_chunk(
+            g_chunk, float(model.config.get("grad_clip", 0.0) or 0.0), axis)
+        new_chunk, new_opt = model.opt.update(g_chunk, opt_state, chunk, lr)
+        new_bn = exchanger.sync_bn(new_bn, axis=axis, size=n)
+        new_state = {
+            "params": box(new_chunk),
+            "opt_state": box(new_opt),
+            "bn_state": box(new_bn),
+            "extra": state["extra"],
+        }
+        return new_state, cost, err
 
     def one_step(state, batch, lr, rng, count):
+        if fsdp is not None:
+            return fsdp_step(state, batch, lr, rng, count)
         params = unbox(state["params"])
         opt_state = unbox(state["opt_state"])
         bn_state = unbox(state["bn_state"])
