@@ -206,6 +206,17 @@ def test_two_process_spc_matches_single_step():
     _run_twoproc_and_compare("spc", fingerprint_after_steps(n_workers=4))
 
 
+def test_two_process_fsdp_matches_single_process():
+    """Multi-host FSDP/ZeRO-3 (round-4): the parameter chunks partition
+    over workers spanning BOTH processes, so the in-step all_gather and
+    its psum_scatter transpose cross the real process boundary; the
+    assembled canonical tree must match a single-process 4-worker FSDP
+    oracle (itself pinned bit-equal to dense BSP in test_fsdp.py)."""
+    from tests.twoproc_model import fingerprint_after_steps
+    _run_twoproc_and_compare("fsdp",
+                             fingerprint_after_steps(n_workers=4, fsdp=True))
+
+
 def test_two_process_sp_transformer_step():
     """Multi-host × sequence parallelism (round-4): dp across the
     processes, both seq shards within each process — ring-attention

@@ -48,6 +48,12 @@ def main() -> int:
     elif mode == "sp_spc":
         from tests.twoproc_model import fingerprint_after_steps_sp_spc
         fp = fingerprint_after_steps_sp_spc(dp=2, sp=2)
+    elif mode == "fsdp":
+        # FSDP/ZeRO-3 across REAL process boundaries: the param chunks
+        # partition over hosts, the in-step all_gather and its psum_scatter
+        # transpose cross the process boundary
+        from tests.twoproc_model import fingerprint_after_steps
+        fp = fingerprint_after_steps(n_workers=4, fsdp=True)
     elif mode == "spc":
         # multi-step dispatch on the multi-host path: each host stacks its
         # k local batches, put_batch_stack stitches [k, global, ...]

@@ -23,17 +23,23 @@ def _train_and_fingerprint(m, exchanger, n_steps: int,
     m.data.shuffle_data(0)
     for i in range(steps_per_call, n_steps + 1, steps_per_call):
         m.train_iter(i, None)
-    host = steps.tree_to_host(m.step_state["params"])
-    leaves = jax.tree_util.tree_leaves(jax.device_get(host))
+    if getattr(m, "_fsdp", None) is not None:
+        # chunks partition the params across workers (and hosts) — the
+        # comparable object is the assembled canonical tree
+        leaves = jax.tree_util.tree_leaves(m.canonical_host_params())
+    else:
+        host = steps.tree_to_host(m.step_state["params"])
+        leaves = jax.tree_util.tree_leaves(jax.device_get(host))
     return {"sums": [float(np.asarray(l).sum()) for l in leaves],
             "first": [float(np.asarray(l).reshape(-1)[0]) for l in leaves]}
 
 
 def fingerprint_after_steps(n_workers: int, n_steps: int = 2,
-                            steps_per_call: int = 1) -> dict:
+                            steps_per_call: int = 1, **cfg_extra) -> dict:
     """Run ``n_steps`` BSP iterations on a tiny MLP over ``n_workers`` and
     return a params fingerprint (per-leaf sums + first elements) computed
-    from the gathered global state."""
+    from the gathered global state.  ``cfg_extra`` passes straight into the
+    model config (e.g. ``fsdp=True``)."""
     import jax.numpy as jnp
     import numpy as np
 
@@ -74,7 +80,7 @@ def fingerprint_after_steps(n_workers: int, n_steps: int = 2,
 
     mesh = worker_mesh(n_workers)
     config = {"mesh": mesh, "size": n_workers, "rank": 0, "verbose": False,
-              "steps_per_call": steps_per_call}
+              "steps_per_call": steps_per_call, **cfg_extra}
     return _train_and_fingerprint(M(config), BSP_Exchanger(config), n_steps,
                                   steps_per_call)
 
