@@ -192,6 +192,46 @@ def test_fsdp_checkpoint_exact_resume(tmp_path, mesh4):
         np.asarray(x), np.asarray(y)), _host_params(solo), _host_params(b))
 
 
+def test_fsdp_checkpoint_is_worker_count_portable(tmp_path, mesh4, mesh8):
+    """Elastic resume for chunked state: chunking is a pure partition of
+    the padded flat vector, so a 4-worker fsdp checkpoint re-slices onto
+    8 workers (and back) — assembled params and optimizer flat identical,
+    and training continues."""
+    d = str(tmp_path / "ckpt")
+    m4, _ = _make_tiny(True, mesh4, optimizer="adam")
+    _train(m4, BSP_Exchanger(m4.config), 3)
+    m4.save(d, epoch=0, count=3)
+    ref = m4.canonical_host_params()
+    ref_m = np.asarray(jax.device_get(
+        m4.step_state["opt_state"]["m"])).reshape(-1)[:m4.n_params]
+
+    cfg8 = {"mesh": mesh8, "size": 8, "rank": 0, "verbose": False,
+            "fsdp": True, "optimizer": "adam"}
+    m8 = TinyModel(cfg8)
+    m8.compile_iter_fns(BSP_Exchanger(cfg8))
+    assert m8.load(d) == 0
+    jax.tree.map(lambda a, b: np.testing.assert_array_equal(
+        np.asarray(a), np.asarray(b)), ref, m8.canonical_host_params())
+    got_m = np.asarray(jax.device_get(
+        m8.step_state["opt_state"]["m"])).reshape(-1)[:m8.n_params]
+    np.testing.assert_array_equal(ref_m, got_m)
+    t8 = np.asarray(jax.device_get(m8.step_state["opt_state"]["t"]))
+    assert t8.shape == (8,) and (t8 == t8[0]).all() and t8[0] == 3
+    m8.train_iter(3, None)               # and it keeps training
+
+    # different model config must fail LOUDLY, not silently re-slice
+    cfg_bad = dict(cfg8, n_train=256)
+    bad = TinyModel({**cfg_bad, "batch_size": 8})
+    bad.params = jax.tree.map(
+        lambda x: np.zeros(np.shape(x)[:-1] + (np.shape(x)[-1] + 1,),
+                           np.float32), bad.params)
+    from theanompi_tpu.parallel.fsdp import FsdpLayout
+    bad._fsdp = FsdpLayout(bad.params, 8)
+    bad.compile_iter_fns(BSP_Exchanger(bad.config))
+    with pytest.raises(AssertionError, match="different model config"):
+        bad.load(d)
+
+
 def test_fsdp_rejects_incompatible_configs(mesh4, mesh8):
     """fsdp is BSP-grads + exact allreduce only; zero_opt is subsumed;
     model-parallel layouts shard params their own way."""

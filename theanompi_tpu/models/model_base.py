@@ -666,11 +666,17 @@ class ModelBase:
             # rank 0 writes, as the reference did — concurrent writers on a
             # shared filesystem would corrupt the archive
             return os.path.join(ckpt_dir, f"ckpt_epoch{epoch}.npz")
+        extra_meta = {"boxed_parts": sorted(k for k in state
+                                            if k not in ident)}
+        if self._fsdp is not None:
+            # the chunk layout facts, so a resume on a DIFFERENT worker
+            # count can re-partition the flat vector (load() refit path)
+            extra_meta["fsdp"] = {"n": self._fsdp.n_workers,
+                                  "chunk": self._fsdp.chunk,
+                                  "total": self._fsdp.n_total}
         kwargs = dict(
             rng_keys={"step": self._step_rng, "exch": self._exch_key},
-            cursor=cursor, params_npy=params_npy,
-            extra_meta={"boxed_parts": sorted(k for k in state
-                                              if k not in ident)})
+            cursor=cursor, params_npy=params_npy, extra_meta=extra_meta)
         if self.config.get("async_ckpt", False):
             # the device→host gather above is the only part that must block
             # the training loop; the disk write runs on a background thread
@@ -726,12 +732,46 @@ class ModelBase:
             boxed_parts = set(self.step_state)
         else:                               # legacy: always saved unboxed
             boxed_parts = set()
+        # FSDP worker-count refit (the BSP elastic-resume story extended to
+        # chunked state): chunking is a pure partition of the padded flat
+        # vector, so a checkpoint from n_saved workers re-slices onto n —
+        # shape the load template by the SAVED layout, then re-chunk below.
+        fs = peek.get("fsdp") if self._fsdp is not None else None
+        refit = fs is not None and int(fs["n"]) != n
+        if refit:
+            assert int(fs["total"]) == self._fsdp.n_total, (
+                f"fsdp checkpoint holds {fs['total']} params, model has "
+                f"{self._fsdp.n_total} — different model config")
+            n_s, chunk_s = int(fs["n"]), int(fs["chunk"])
+
+        def shape_of_saved(x):
+            # fsdp boxed leaves are [n, chunk] chunk vectors or [n] scalar
+            # counters (identical across workers) — map both to saved-n
+            if x.shape == (n, self._fsdp.chunk):
+                return jax.ShapeDtypeStruct((n_s, chunk_s), x.dtype)
+            assert x.shape == (n,), (
+                f"unexpected fsdp state leaf shape {x.shape}")
+            return jax.ShapeDtypeStruct((n_s,), x.dtype)
+
         template = {
-            k: jax.tree.map(lambda x: shape_of(x, k in boxed_parts), v)
+            k: jax.tree.map(
+                (shape_of_saved if refit and k in ("params", "opt_state")
+                 else lambda x: shape_of(x, k in boxed_parts)), v)
             for k, v in self.step_state.items()}
         restored = ckpt_lib.load_checkpoint(ckpt_dir, template, epoch)
         if restored is None:
             return None
+
+        if refit:
+            def refit_leaf(x):
+                x = np.asarray(x)
+                if x.shape == (n_s, chunk_s):
+                    return self._fsdp.rechunk(x)
+                # per-worker step counters are identical — broadcast one
+                return np.broadcast_to(x[:1], (n,) + x.shape[1:]).copy()
+
+            for k in ("params", "opt_state"):
+                restored[k] = jax.tree.map(refit_leaf, restored[k])
         meta = restored.pop("_meta")
         rngs = restored.pop("_rng_keys", None)
         cursor = restored.pop("_cursor", None)

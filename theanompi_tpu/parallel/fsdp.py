@@ -72,14 +72,22 @@ class FsdpLayout:
         leaves = jax.tree.leaves(jax.device_get(params))
         flat = np.concatenate([np.asarray(l, np.float32).reshape(-1)
                                for l in leaves])
-        flat = np.pad(flat, (0, self.padded - flat.shape[0]))
-        return flat.reshape(self.n_workers, self.chunk)
+        return self.rechunk(flat)          # trim is a no-op: len == n_total
 
     def host_params_from_chunks(self, boxed_chunks) -> object:
         """Inverse of :meth:`chunk_host`: host full tree from the boxed
         ``[n_workers, chunk]`` array (checkpoint .npy snapshots)."""
         return helper_funcs.unflatten_like(
             self.template, np.asarray(boxed_chunks, np.float32).reshape(-1))
+
+    def rechunk(self, boxed_saved) -> np.ndarray:
+        """Re-partition a ``[n_saved, chunk_saved]`` boxed chunk array onto
+        THIS layout's ``[n_workers, chunk]`` (worker-count-portable resume:
+        chunking is a pure partition of the same padded flat vector, so a
+        different worker count just re-slices it)."""
+        flat = np.asarray(boxed_saved, np.float32).reshape(-1)[:self.n_total]
+        flat = np.pad(flat, (0, self.padded - flat.shape[0]))
+        return flat.reshape(self.n_workers, self.chunk)
 
     # -- traced (inside shard_map) -------------------------------------------
 
