@@ -270,6 +270,15 @@ def _peak_flops(device) -> float:
             return peak
     return 0.0
 
+def _xla_flops(compiled) -> float | None:
+    """Flop count of an AOT-compiled executable via XLA's cost analysis;
+    None when unavailable or nonsensical (some backends report -1)."""
+    ca = compiled.cost_analysis()
+    ca = ca[0] if isinstance(ca, (list, tuple)) else ca
+    f = float(ca.get("flops", 0.0))
+    return f if f > 0 else None
+
+
 def _ensure_bench_dataset(n_batches: int, batch_size: int,
                           data_dir: str = None) -> str:
     """Generate (once) a real on-disk batch-file dataset in the reference's
@@ -426,11 +435,6 @@ def main() -> int:
 
         compiled = None
         mfu_this = want_mfu and spc == 1
-        if want_mfu and not mfu_this:
-            # XLA's cost_analysis does not reliably scale the scan body by
-            # its trip count — an spc>1 MFU would misread; the spc=1 row of
-            # the same config carries the MFU
-            print("mfu suppressed for steps_per_call > 1", file=sys.stderr)
         if mfu_this:
             # AOT-compile once and reuse the SAME executable for the timed
             # loop and the flop count (a separate lower().compile() after
@@ -468,12 +472,39 @@ def main() -> int:
         for i in range(iters):
             step(warmup + i)
         drain()
-        return (model, spc, n_images, time.time() - t0, compiled,
-                load_wait[0])
+        dt = time.time() - t0
+
+        spc1_flops = None
+        if want_mfu and not mfu_this and \
+                os.environ.get("BENCH_SPC_MFU", "1") != "0":
+            # XLA's cost_analysis does not reliably scale the scan body by
+            # its trip count, so the spc>1 executable can't be read
+            # directly.  AFTER the timed window (no extra buffers or
+            # compile perturbing the measurement), AOT-compile the SINGLE-
+            # step program — a persistent-compile-cache hit when this
+            # config's spc=1 row ran earlier in the matrix, as the row
+            # order guarantees; on a cold cache this pays a second compile
+            # and the wrapper's BENCH_TIMEOUT still bounds the row — purely
+            # for its flop count, scaled by spc in the caller.
+            try:
+                single_fn = steps.build_train_step(mesh, model, exchanger,
+                                                   n_steps=1)
+                dev1 = steps.put_batch(mesh, batches[0], model.batch_spec())
+                spc1_flops = _xla_flops(
+                    single_fn.lower(model.step_state, dev1,
+                                    jnp.float32(model.current_lr),
+                                    jax.random.key(0),
+                                    jnp.int32(0)).compile())
+            except Exception as e:
+                print(f"mfu for spc>1 unavailable (single-step flop "
+                      f"count failed: {e!r})", file=sys.stderr)
+        return (model, spc, n_images, dt, compiled, load_wait[0],
+                spc1_flops)
 
     retry = False
     try:
-        model, spc, n_images, dt, compiled, load_wait = measure(config)
+        model, spc, n_images, dt, compiled, load_wait, spc1_flops = \
+            measure(config)
     except Exception as e:
         if int(config.get("steps_per_call", 1)) <= 1:
             raise
@@ -485,24 +516,27 @@ def main() -> int:
         # would otherwise keep its device buffers rooted while the fallback
         # allocates a second full model
         config["steps_per_call"] = 1
-        model, spc, n_images, dt, compiled, load_wait = measure(config)
+        model, spc, n_images, dt, compiled, load_wait, spc1_flops = \
+            measure(config)
 
     ips = n_images * iters / dt
     ips_chip = ips / n_chips
 
     mfu = None
-    if compiled is not None:
+    peak = _peak_flops(jax.devices()[0])
+    if compiled is not None and peak:
         # XLA's flop count for the (per-device, SPMD-partitioned) module vs
         # one chip's bf16 peak → per-chip MFU
-        peak = _peak_flops(jax.devices()[0])
         try:
-            ca = compiled.cost_analysis()
-            ca = ca[0] if isinstance(ca, (list, tuple)) else ca
-            flops = float(ca.get("flops", 0.0))
-            if flops > 0 and peak:
+            flops = _xla_flops(compiled)
+            if flops:
                 mfu = round(flops / (dt / iters) / peak, 4)
         except Exception as e:
             print(f"mfu unavailable: {e}", file=sys.stderr)
+    elif spc1_flops and peak:
+        # spc>1 rows: flops of ONE step from the separately-compiled spc=1
+        # program × spc steps per timed call
+        mfu = round(spc1_flops * spc / (dt / iters) / peak, 4)
 
     # a sequence model's "image" is a sequence — label it honestly, and
     # don't divide sequences/sec by an AlexNet images/sec estimate
