@@ -491,9 +491,7 @@ def main() -> int:
                                                    n_steps=1)
                 dev1 = steps.put_batch(mesh, batches[0], model.batch_spec())
                 spc1_flops = _xla_flops(
-                    single_fn.lower(model.step_state, dev1,
-                                    jnp.float32(model.current_lr),
-                                    jax.random.key(0),
+                    single_fn.lower(model.step_state, dev1, lr, rng,
                                     jnp.int32(0)).compile())
             except Exception as e:
                 print(f"mfu for spc>1 unavailable (single-step flop "

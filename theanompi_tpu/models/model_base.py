@@ -107,6 +107,7 @@ class ModelBase:
             # and the full shadow is assembled only at read time.
             from ..utils.opt import ema_wrap
             self.opt = ema_wrap(self.opt, float(self.config["ema_decay"]))
+        self._zero_layout = None
         if self.config.get("zero_opt", False):
             # ZeRO-1 (parallel/zero.py): optimizer state sharded over the
             # workers axis — per-chip optimizer memory /N, bit-equal updates.
@@ -134,6 +135,10 @@ class ModelBase:
             self.opt = zero1(self.opt, self.mesh.shape[WORKER_AXIS],
                              template, model_shards=shards,
                              pspecs=pspecs, model_axes=maxes)
+            # layout facts for worker-count-portable resume (load() refit)
+            self._zero_layout = {
+                "n": self.mesh.shape[WORKER_AXIS], "shards": shards,
+                "local_total": helper_funcs.tree_size(template)}
 
         self._fsdp = None
         if self.config.get("fsdp", False):
@@ -674,6 +679,8 @@ class ModelBase:
             extra_meta["fsdp"] = {"n": self._fsdp.n_workers,
                                   "chunk": self._fsdp.chunk,
                                   "total": self._fsdp.n_total}
+        if self._zero_layout is not None:
+            extra_meta["zero"] = self._zero_layout
         kwargs = dict(
             rng_keys={"step": self._step_rng, "exch": self._exch_key},
             cursor=cursor, params_npy=params_npy, extra_meta=extra_meta)
@@ -732,45 +739,67 @@ class ModelBase:
             boxed_parts = set(self.step_state)
         else:                               # legacy: always saved unboxed
             boxed_parts = set()
-        # FSDP worker-count refit (the BSP elastic-resume story extended to
-        # chunked state): chunking is a pure partition of the padded flat
-        # vector, so a checkpoint from n_saved workers re-slices onto n —
-        # shape the load template by the SAVED layout, then re-chunk below.
-        fs = peek.get("fsdp") if self._fsdp is not None else None
-        refit = fs is not None and int(fs["n"]) != n
-        if refit:
-            assert int(fs["total"]) == self._fsdp.n_total, (
-                f"fsdp checkpoint holds {fs['total']} params, model has "
-                f"{self._fsdp.n_total} — different model config")
-            n_s, chunk_s = int(fs["n"]), int(fs["chunk"])
+        # Worker-count refit (the BSP elastic-resume story extended to
+        # chunked state): FSDP and ZeRO chunking are pure partitions of a
+        # padded flat layout, so a checkpoint from n_saved workers
+        # re-partitions onto n — shape the load template by the SAVED
+        # layout, then re-chunk below.  Chunk-vector leaves re-slice; boxed
+        # scalar counters (identical across workers) broadcast one row.
+        refit_parts: tuple = ()
+        if self._fsdp is not None:
+            fs = peek.get("fsdp")
+            if fs is not None and int(fs["n"]) != n:
+                assert int(fs["total"]) == self._fsdp.n_total, (
+                    f"fsdp checkpoint holds {fs['total']} params, model "
+                    f"has {self._fsdp.n_total} — different model config")
+                refit_parts = ("params", "opt_state")
+                n_s = int(fs["n"])
+                cur_chunk_shape = (n, self._fsdp.chunk)
+                saved_chunk_shape = (n_s, int(fs["chunk"]))
+                rechunk = self._fsdp.rechunk
+        elif self._zero_layout is not None:
+            zs = peek.get("zero")
+            if zs is not None and int(zs["n"]) != n:
+                from ..parallel import zero as zero_lib
+                lay = self._zero_layout
+                assert (int(zs["shards"]) == lay["shards"] and
+                        int(zs["local_total"]) == lay["local_total"]), (
+                    f"zero checkpoint layout {zs} does not match the "
+                    f"model's {lay} — different model/mesh config")
+                refit_parts = ("opt_state",)       # params dedup portably
+                n_s = int(zs["n"])
+                shards, local_total = lay["shards"], lay["local_total"]
+                cur_chunk_shape = (
+                    n, shards * zero_lib.chunk_size(local_total, n))
+                saved_chunk_shape = (
+                    n_s, shards * zero_lib.chunk_size(local_total, n_s))
+                rechunk = (lambda x: zero_lib.rechunk_boxed(
+                    x, n, shards, local_total))
 
         def shape_of_saved(x):
-            # fsdp boxed leaves are [n, chunk] chunk vectors or [n] scalar
-            # counters (identical across workers) — map both to saved-n
-            if x.shape == (n, self._fsdp.chunk):
-                return jax.ShapeDtypeStruct((n_s, chunk_s), x.dtype)
+            if x.shape == cur_chunk_shape:
+                return jax.ShapeDtypeStruct(saved_chunk_shape, x.dtype)
             assert x.shape == (n,), (
-                f"unexpected fsdp state leaf shape {x.shape}")
+                f"unexpected chunked state leaf shape {x.shape}")
             return jax.ShapeDtypeStruct((n_s,), x.dtype)
 
         template = {
             k: jax.tree.map(
-                (shape_of_saved if refit and k in ("params", "opt_state")
+                (shape_of_saved if k in refit_parts
                  else lambda x: shape_of(x, k in boxed_parts)), v)
             for k, v in self.step_state.items()}
         restored = ckpt_lib.load_checkpoint(ckpt_dir, template, epoch)
         if restored is None:
             return None
 
-        if refit:
+        if refit_parts:
             def refit_leaf(x):
                 x = np.asarray(x)
-                if x.shape == (n_s, chunk_s):
-                    return self._fsdp.rechunk(x)
-                # per-worker step counters are identical — broadcast one
+                if x.shape == saved_chunk_shape:
+                    return rechunk(x)
                 return np.broadcast_to(x[:1], (n,) + x.shape[1:]).copy()
 
-            for k in ("params", "opt_state"):
+            for k in refit_parts:
                 restored[k] = jax.tree.map(refit_leaf, restored[k])
         meta = restored.pop("_meta")
         rngs = restored.pop("_rng_keys", None)

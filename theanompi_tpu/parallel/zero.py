@@ -36,6 +36,36 @@ from ..utils.opt import OptPair
 from .mesh import WORKER_AXIS
 
 
+def chunk_size(n_total: int, n_workers: int) -> int:
+    """ceil(P/N) — the per-worker chunk length of an N-way flat partition."""
+    return -(-n_total // n_workers)
+
+
+def rechunk_boxed(arr, n_new: int, shards: int, local_total: int):
+    """Re-partition a saved boxed ZeRO state leaf ``[n_saved, shards·chunk_s]``
+    onto ``[n_new, shards·chunk_new]`` (worker-count-portable resume).
+
+    Dim 1 is laid out one chunk per model-group rank (``state_partition_
+    specs`` shards it over the model axes), so model rank r's local flat
+    vector is the concatenation over workers of column block r — reassemble
+    each rank's flat, trim its padding, re-pad and re-slice for the new
+    worker count.  The model-axes sizes themselves must match (``shards``
+    and ``local_total`` are properties of the model layout, not of N).
+    """
+    import numpy as np
+    n_s = int(arr.shape[0])
+    assert arr.ndim == 2 and arr.shape[1] % shards == 0, arr.shape
+    chunk_s = arr.shape[1] // shards
+    # [n_s, shards, chunk_s] -> [shards, n_s·chunk_s] -> trim pad
+    per_rank = np.transpose(np.asarray(arr).reshape(n_s, shards, chunk_s),
+                            (1, 0, 2)).reshape(shards, -1)[:, :local_total]
+    chunk_n = chunk_size(local_total, n_new)
+    per_rank = np.pad(per_rank,
+                      ((0, 0), (0, chunk_n * n_new - local_total)))
+    return np.transpose(per_rank.reshape(shards, n_new, chunk_n),
+                        (1, 0, 2)).reshape(n_new, shards * chunk_n)
+
+
 def zero1(opt: OptPair, n_workers: int, params_template,
           axis: str = WORKER_AXIS, model_shards: int = 1,
           pspecs=None, model_axes: tuple = ()) -> OptPair:
@@ -56,7 +86,7 @@ def zero1(opt: OptPair, n_workers: int, params_template,
     (``steps.state_partition_specs``).
     """
     n_total = helper_funcs.tree_size(params_template)
-    chunk = -(-n_total // n_workers)            # ceil
+    chunk = chunk_size(n_total, n_workers)
     padded = chunk * n_workers
 
     def init(params):
