@@ -267,6 +267,10 @@ class BSP_Exchanger(Exchanger):
             # device sees inside shard_map, tiled to the global
             # [prod(group) · local] layout that extra_specs shards back over
             # the group axes
+            assert not getattr(self.strategy, "leafwise_state", False), (
+                f"{self.strategy.name} keeps per-leaf state (not a flat "
+                "vector) and does not compose with model-parallel param "
+                "specs — use a flat-vector strategy (onebit/topk) there")
             local = steps.local_param_template(self.model.params, pspecs,
                                                self.mesh)
             st = self.strategy.init_state(local)
