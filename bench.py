@@ -116,7 +116,8 @@ def _cfg_matches(cfg: str) -> bool:
             return False
     strat = os.environ.get("BENCH_STRATEGY", "")
     for s in ("topk", "onebit", "asa16", "asa32", "ring", "copper",
-              "copper16", "nccl16", "bf16"):
+              "copper16", "nccl16", "bf16", "powersgd", "powersgd2",
+              "powersgd4"):
         if (s in parts) != (strat == s):
             return False
     spc = os.environ.get("BENCH_SPC", "")

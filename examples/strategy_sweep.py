@@ -22,7 +22,8 @@ from theanompi_tpu.parallel import steps  # noqa: E402
 from theanompi_tpu.parallel.exchanger import BSP_Exchanger  # noqa: E402
 from theanompi_tpu.parallel.mesh import worker_mesh  # noqa: E402
 
-STRATEGIES = ["allreduce", "nccl16", "ring", "asa16", "onebit", "topk"]
+STRATEGIES = ["allreduce", "nccl16", "ring", "asa16", "onebit", "topk",
+              "powersgd2"]
 ITERS, WARMUP = 20, 5
 
 if __name__ == "__main__":

@@ -43,6 +43,7 @@ run vgg16-b32-easgd          BENCH_MODEL=vgg16 BENCH_RULE=easgd
 run resnet50-b32-gosgd       BENCH_MODEL=resnet50 BENCH_RULE=gosgd
 run vgg16-b32-topk           BENCH_MODEL=vgg16 BENCH_STRATEGY=topk
 run vgg16-b32-onebit         BENCH_MODEL=vgg16 BENCH_STRATEGY=onebit
+run vgg16-b32-powersgd4      BENCH_MODEL=vgg16 BENCH_STRATEGY=powersgd4
 
 # -- real-data path (verdict #3): .hkl shards -> native loader -> device --
 run alexnet-b128-realdata    BENCH_MODEL=alexnet BENCH_REAL_DATA=1
