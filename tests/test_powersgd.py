@@ -140,6 +140,31 @@ def test_trains_end_to_end_and_stays_identical(mesh4):
             np.testing.assert_array_equal(arr[w], arr[0])
 
 
+def test_composes_with_zero_and_spc(mesh4):
+    """Every worker decodes the SAME update, so ZeRO's slice-my-chunk
+    assumption holds under powersgd; steps_per_call's fused-exchange
+    requirement holds too (grads mode, no post-step collective).  The
+    spc=2 run must match two single-step dispatches bit-for-bit."""
+    def make(**kw):
+        cfg = {"mesh": mesh4, "size": 4, "rank": 0, "verbose": False,
+               "exch_strategy": "powersgd2", "n_train": 512, **kw}
+        m = TinyModel(cfg)
+        m.compile_iter_fns(BSP_Exchanger(cfg))
+        m.data.shuffle_data(0)
+        return m
+
+    one = make(zero_opt=True)
+    for i in range(4):
+        one.train_iter(i, None)
+    spc = make(zero_opt=True, steps_per_call=2)
+    for last in (1, 3):
+        spc.train_iter(last, None)
+    import numpy as _np
+    jax.tree.map(lambda a, b: _np.testing.assert_array_equal(
+        _np.asarray(jax.device_get(a)), _np.asarray(jax.device_get(b))),
+        one.step_state["params"], spc.step_state["params"])
+
+
 def test_rejects_model_parallel_specs(mesh8):
     from theanompi_tpu.models.transformer_lm import TransformerLM
     from theanompi_tpu.parallel.mesh import worker_mesh

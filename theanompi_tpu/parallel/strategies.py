@@ -376,7 +376,12 @@ class PowerSGD(Strategy):
             Qn = lax.psum(Mp.T @ Ph, axis) * inv
             Mhat = Ph @ Qn.T
             out.append(Mhat.reshape(shape).astype(g.dtype))
-            new_state.append({"q": Qn, "e": Mp - Mhat})
+            # Qn is a psum result (worker-INVARIANT in the vma type
+            # system), but it persists in the boxed per-worker state whose
+            # scan carry under steps_per_call is worker-varying — re-mark
+            # it (values are identical everywhere; this is a type cast)
+            from .steps import _vary
+            new_state.append({"q": _vary(Qn, axis), "e": Mp - Mhat})
         return jax.tree_util.tree_unflatten(treedef, out), new_state
 
 
