@@ -232,6 +232,40 @@ def test_fsdp_checkpoint_is_worker_count_portable(tmp_path, mesh4, mesh8):
         bad.load(d)
 
 
+def test_rechunk_roundtrips_across_worker_counts():
+    """Pure-layout property: re-partitioning a flat vector through ANY
+    sequence of worker counts is the identity on the data (pad is sliced
+    off and re-derived each hop) — for both fsdp's flat layout and zero's
+    rank-major model-sharded layout."""
+    from theanompi_tpu.parallel import zero as zero_lib
+    from theanompi_tpu.parallel.fsdp import FsdpLayout
+    rng = np.random.RandomState(0)
+    params = {"a": rng.randn(13, 7).astype(np.float32),
+              "b": rng.randn(29).astype(np.float32)}
+    flat = np.concatenate([params["a"].reshape(-1), params["b"]])
+    for ns in ([4, 8, 3, 4], [1, 5, 1]):
+        lay = {n: FsdpLayout(params, n) for n in ns}
+        boxed = lay[ns[0]].chunk_host(params)
+        for n in ns[1:]:
+            boxed = lay[n].rechunk(boxed)
+        np.testing.assert_array_equal(
+            boxed.reshape(-1)[:flat.size], flat)
+    # zero's rank-major layout: shards=3 model ranks, each local_total=40
+    local_total, shards = 40, 3
+    per_rank = rng.randn(shards, local_total).astype(np.float32)
+
+    def to_boxed(n):
+        c = zero_lib.chunk_size(local_total, n)
+        padded = np.pad(per_rank, ((0, 0), (0, c * n - local_total)))
+        return np.transpose(padded.reshape(shards, n, c),
+                            (1, 0, 2)).reshape(n, shards * c)
+
+    boxed = to_boxed(5)
+    for n in (2, 7, 5):
+        boxed = zero_lib.rechunk_boxed(boxed, n, shards, local_total)
+    np.testing.assert_array_equal(boxed, to_boxed(5))
+
+
 def test_fsdp_rejects_incompatible_configs(mesh4, mesh8):
     """fsdp is BSP-grads + exact allreduce only; zero_opt is subsumed;
     model-parallel layouts shard params their own way."""
