@@ -302,3 +302,7 @@ def test_fsdp_transformer_trains(mesh8):
     assert np.mean(costs[-3:]) < np.mean(costs[:3])
     chunk = -(-model.n_params // 8)
     assert model.step_state["params"].shape == (8, chunk)
+    # generation reads the canonical (assembled) params — works on chunks
+    out = np.asarray(model.generate(np.array([[1, 2, 3]]),
+                                    max_new_tokens=4))
+    assert out.shape == (1, 4) and (out >= 0).all() and (out < 32).all()
