@@ -1,0 +1,86 @@
+"""The perf-matrix row harness (scripts/_bench_row.sh): the shell logic the
+measurement record depends on — resumable skip of measured rows, null
+recording on failure, and the wedge short-circuit — tested against a stub
+bench.py."""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+STUB_BENCH = textwrap.dedent("""\
+    import json, os, sys
+    mode = os.environ.get("STUB_MODE", "ok")
+    name = os.environ.get("BENCH_MODEL", "m")
+    if mode == "ok":
+        print(json.dumps({"metric": f"x ({name})", "value": 1.0,
+                          "unit": "u", "vs_baseline": 1.0}))
+        sys.exit(0)
+    if mode == "fail":
+        print(json.dumps({"error": "measurement rc=1: boom"}))
+        sys.exit(0)
+    # wedge: the wrapper's structured wedge report
+    print(json.dumps({"error": "probe hung \\u2014 tunnel wedged"}))
+    sys.exit(0)
+""")
+
+
+def _run_matrix(tmp_path, script_body):
+    (tmp_path / "bench.py").write_text(STUB_BENCH)
+    scripts = tmp_path / "scripts"
+    scripts.mkdir(exist_ok=True)
+    with open(os.path.join(REPO, "scripts", "_bench_row.sh")) as f:
+        (scripts / "_bench_row.sh").write_text(f.read())
+    # merge_matrix is invoked by the real matrix scripts, not the helper —
+    # the driver script here exercises the helper alone
+    driver = tmp_path / "driver.sh"
+    driver.write_text("#!/usr/bin/env bash\nset -u\nOUT=out.jsonl\n"
+                      "cd \"$(dirname \"$0\")\"\n"
+                      ". scripts/_bench_row.sh\n" + script_body)
+    r = subprocess.run(["bash", str(driver)], capture_output=True,
+                       text=True, cwd=tmp_path,
+                       env={**os.environ, "PATH": os.environ["PATH"]})
+    rows = []
+    out = tmp_path / "out.jsonl"
+    if out.exists():
+        rows = [json.loads(l) for l in out.read_text().splitlines()]
+    return r, rows
+
+
+def test_rows_append_and_resume_skips_measured(tmp_path):
+    r, rows = _run_matrix(tmp_path,
+                          "run a BENCH_MODEL=a STUB_MODE=ok\n"
+                          "run b BENCH_MODEL=b STUB_MODE=ok\n")
+    assert [x["config"] for x in rows] == ["a", "b"]
+    assert all(x["result"]["value"] == 1.0 for x in rows)
+    # second pass: both measured -> both skipped, file unchanged
+    r2, rows2 = _run_matrix(tmp_path,
+                            "run a BENCH_MODEL=a STUB_MODE=ok\n"
+                            "run b BENCH_MODEL=b STUB_MODE=ok\n")
+    assert len(rows2) == 2
+    assert r2.stderr.count("already measured") == 2
+
+
+def test_failure_records_null_and_is_retried(tmp_path):
+    _, rows = _run_matrix(tmp_path, "run a BENCH_MODEL=a STUB_MODE=fail\n")
+    assert rows == [{"config": "a", "result": None}]
+    # a null row is NOT treated as measured: the next pass retries it
+    r2, rows2 = _run_matrix(tmp_path, "run a BENCH_MODEL=a STUB_MODE=ok\n")
+    assert "already measured" not in r2.stderr
+    assert rows2[-1]["result"]["value"] == 1.0
+
+
+def test_wedge_short_circuits_the_pass(tmp_path):
+    r, rows = _run_matrix(
+        tmp_path,
+        "run a BENCH_MODEL=a STUB_MODE=ok\n"
+        "run b BENCH_MODEL=b STUB_MODE=wedge\n"
+        "run c BENCH_MODEL=c STUB_MODE=ok\n")
+    # a measured, b null (the wedge), c skipped without running
+    assert [x["config"] for x in rows] == ["a", "b"]
+    assert rows[1]["result"] is None
+    assert "tunnel wedged earlier this pass" in r.stderr
+    assert not any(x["config"] == "c" for x in rows)
