@@ -6,14 +6,28 @@ AlexNet BSP configuration on the available hardware — the reference's
 headline metric (time per 5120 images, SURVEY.md §6) recast per-chip as
 ``BASELINE.json`` specifies.
 
-Env knobs: ``BENCH_MODEL`` (alexnet|googlenet|vgg16|resnet50|cifar10),
+Env knobs — measurement: ``BENCH_MODEL``
+(alexnet|googlenet|vgg16|resnet50|cifar10|transformer_lm|moe_lm),
 ``BENCH_RULE`` (bsp|easgd|asgd|gosgd — the BASELINE.json staged configs pair
 VGG-16 with EASGD and ResNet-50 with GoSGD), ``BENCH_ITERS``,
 ``BENCH_WARMUP``, ``BENCH_BATCH`` (per-chip batch override),
 ``BENCH_STRATEGY`` (exchange strategy string), ``BENCH_PRNG``
 (rbg|threefry2x32 — default rbg: the TPU hardware RNG, ~10% faster on
 AlexNet's dropout; dropout statistics are unaffected; the chosen impl is
-recorded in the metric string).
+recorded in the metric string), ``BENCH_CFG`` (JSON config overrides —
+transformer dims, tp/pp/sp), ``BENCH_SPC`` (steps_per_call) +
+``BENCH_SYNTH_BATCHES``, ``BENCH_BN_DTYPE`` (bn_norm_dtype lever),
+``BENCH_MFU`` (=1 adds the MFU column; ``BENCH_SPC_MFU=0`` disables the
+spc>1 single-step-flops derivation), ``BENCH_REAL_DATA`` (=1 drives the
+whole disk→augment→device pipeline; + ``BENCH_DATA_DIR``,
+``BENCH_WIRE_U8``).
+
+Env knobs — wedge-proof wrapper: ``BENCH_TIMEOUT`` (hard kill, default
+1500 s), ``BENCH_PROBE_TIMEOUT`` (default 90 s), ``BENCH_RECOVERY_WAIT``,
+``BENCH_SKIP_PROBE`` (matrix rows probe once per pass),
+``BENCH_FORCE_CPU`` / ``BENCH_ALLOW_CPU`` (explicit CPU intent / fallback
+acceptance — otherwise CPU rows are refused), ``BENCH_COMPILE_CACHE``
+(persistent XLA compile cache dir, default /tmp/jax_bench_cache).
 
 The reference's published numbers are not retrievable this session
 (``BASELINE.md``): ``vs_baseline`` is computed against an ESTIMATED 1×K80
