@@ -4,7 +4,10 @@
 Measures steady-state training throughput (images/sec/chip) of the flagship
 AlexNet BSP configuration on the available hardware — the reference's
 headline metric (time per 5120 images, SURVEY.md §6) recast per-chip as
-``BASELINE.json`` specifies.
+``BASELINE.json`` specifies.  A bare invocation (no BENCH_* env — the
+driver's round-end run) measures the flagship at its best honest config,
+steps_per_call=4 multi-step dispatch (see ``_apply_flagship_defaults``);
+the metric string records the spc so the number is never mislabeled.
 
 Env knobs — measurement: ``BENCH_MODEL``
 (alexnet|googlenet|vgg16|resnet50|cifar10|transformer_lm|moe_lm),
@@ -143,6 +146,13 @@ def _cfg_matches(cfg: str) -> bool:
         return False
     if ("realdata" in parts) != (os.environ.get("BENCH_REAL_DATA") == "1"):
         return False
+    # 'lc' rows compile client-side (PALLAS_AXON_REMOTE_COMPILE=0) — a
+    # different compile venue the r5 matrix treats as an A/B variable, so
+    # they must not serve as fallback for the standard remote-compile
+    # config (or vice versa)
+    if ("lc" in parts) != (os.environ.get("PALLAS_AXON_REMOTE_COMPILE")
+                           == "0"):
+        return False
     if ("bnbf16" in parts) != bool(os.environ.get("BENCH_BN_DTYPE")):
         return False
     if ("u8w" in parts) != (os.environ.get("BENCH_WIRE_U8") == "1"):
@@ -156,6 +166,32 @@ def _matrix_round(path: str) -> int:
     import re
     m = re.search(r"_r(\d+)", os.path.basename(path))
     return int(m.group(1)) if m else -1
+
+
+def _is_degraded_row(row: dict) -> bool:
+    """Degraded-window marker check — the convention is DEFINED in
+    scripts/merge_matrix.py (_is_degraded); reuse it so the fallback and
+    the merge hygiene can't desynchronize.  Resolved once and cached;
+    inline fallback only if the scripts package isn't importable
+    (bench.py copied out of the repo)."""
+    global _IS_DEGRADED
+    if _IS_DEGRADED is None:
+        try:
+            repo = os.path.dirname(os.path.abspath(__file__))
+            if repo not in sys.path:
+                sys.path.insert(0, repo)
+            from scripts.merge_matrix import _is_degraded
+            _IS_DEGRADED = _is_degraded
+        except ImportError:
+            def _IS_DEGRADED(row: dict) -> bool:
+                res = row.get("result")
+                blob = str(row.get("note", "")) + str(
+                    res.get("metric", "") if isinstance(res, dict) else "")
+                return "degraded" in blob.lower()
+    return _IS_DEGRADED(row)
+
+
+_IS_DEGRADED = None
 
 
 def _last_good() -> tuple[str, dict] | None:
@@ -172,6 +208,11 @@ def _last_good() -> tuple[str, dict] | None:
                 continue
             cfg, res = row.get("config", ""), row.get("result")
             if not isinstance(res, dict) or not _cfg_matches(cfg):
+                continue
+            if _is_degraded_row(row):
+                # a reading tagged as coming from a degraded tunnel window
+                # (round-4: 6,334 img/s at 40% below the healthy r3 number)
+                # is NOT an honest fallback — skip it (verdict weak #7)
                 continue
             rows[cfg] = res        # later duplicates win (newest re-measure)
         if rows:
@@ -581,7 +622,36 @@ def main() -> int:
     return 0
 
 
+def _apply_flagship_defaults() -> None:
+    """A bare ``python bench.py`` (the driver's round-end invocation — no
+    BENCH_* env) measures the flagship at its BEST honest configuration:
+    AlexNet b128 BSP with steps_per_call=4 multi-step dispatch, the
+    round-3 record config (14,162 img/s/chip, perf_matrix_r3.jsonl).  The
+    spc=4 lever is a framework feature (BASELINE.md round-3 analysis:
+    host dispatch over the tunnel is first-order; +34% measured) and the
+    metric string records it.  ANY config-shaping BENCH_* knob disables
+    the default — matrix rows and hand runs keep their exact semantics;
+    only the truly bare invocation gets the flagship config."""
+    shaping = ("BENCH_MODEL", "BENCH_RULE", "BENCH_BATCH", "BENCH_STRATEGY",
+               "BENCH_CFG", "BENCH_SPC", "BENCH_SYNTH_BATCHES",
+               "BENCH_BN_DTYPE", "BENCH_REAL_DATA", "BENCH_WIRE_U8")
+    if any(k in os.environ for k in shaping):
+        return
+    if os.environ.get("PALLAS_AXON_REMOTE_COMPILE") == "0":
+        # an lc (local-compile) env lingering from a hand-run matrix row
+        # is ALSO config-shaping (_cfg_matches distinguishes lc rows) and
+        # the metric string doesn't record the compile venue — don't let
+        # a bare run measure a mislabeled flagship
+        print("bench: PALLAS_AXON_REMOTE_COMPILE=0 is set — skipping the "
+              "bare-run flagship spc=4 default (compile venue is a "
+              "config variable; unset it or set BENCH_* explicitly)",
+              file=sys.stderr)
+        return
+    os.environ["BENCH_SPC"] = "4"
+
+
 if __name__ == "__main__":
+    _apply_flagship_defaults()
     if os.environ.get("BENCH_INNER") == "1":
         sys.exit(main())
     sys.exit(wrapper_main())

@@ -8,7 +8,10 @@ import time
 # runnable from anywhere without installing the package
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-_PROBE_CACHE = "/tmp/tmpi_backend_probe"
+# per-user path (round-4 ADVICE): a fixed shared /tmp name would let
+# another user pre-create it (poisoning the cached verdict for the TTL)
+# and collide two users' probe writes
+_PROBE_CACHE = f"/tmp/tmpi_backend_probe.{os.getuid()}"
 _PROBE_TTL_S = 600
 
 
@@ -20,7 +23,11 @@ def _backend_answers(timeout_s: float = 60.0) -> bool:
     example runs pays one probe, not one per script."""
     try:
         st = os.stat(_PROBE_CACHE)
-        if time.time() - st.st_mtime < _PROBE_TTL_S:
+        # trust only our OWN cache file: /tmp is world-writable, so a
+        # pre-created file by another uid could poison the verdict (and
+        # our overwrite of it would fail silently below)
+        if st.st_uid == os.getuid() and \
+                time.time() - st.st_mtime < _PROBE_TTL_S:
             return open(_PROBE_CACHE).read().strip() == "ok"
     except OSError:
         pass
@@ -32,8 +39,15 @@ def _backend_answers(timeout_s: float = 60.0) -> bool:
     except subprocess.TimeoutExpired:
         ok = False
     try:
-        with open(_PROBE_CACHE, "w") as f:
+        # write via a private temp file + rename: open(path, "w") on a
+        # predictable /tmp name would follow a pre-planted symlink and
+        # truncate whatever it points at; os.replace swaps the NAME
+        # (replacing any symlink) without ever writing through it
+        import tempfile
+        fd, tmp = tempfile.mkstemp(prefix=_PROBE_CACHE + ".")
+        with os.fdopen(fd, "w") as f:
             f.write("ok" if ok else "dead")
+        os.replace(tmp, _PROBE_CACHE)
     except OSError:
         pass
     return ok

@@ -3,12 +3,16 @@
 the reference could not offer (`mpirun -np N` was fixed for a job's life;
 its checkpoints were per-rank).
 
-Checkpoints here are worker-count portable for every state layout:
-BSP grads-mode state dedups to one replica; ZeRO-1 optimizer chunks and
-FSDP parameter chunks re-partition on load (the chunk layout is recorded
-in the checkpoint meta).  This script trains 1 epoch on 8 workers with
-FSDP + adam, checkpoints, rebuilds on 4 workers, resumes, and shows the
-val accuracy carrying over.
+Checkpoints are worker-count portable for the BSP / ZeRO-1 / FSDP
+layouts: BSP grads-mode state dedups to one replica; ZeRO-1 optimizer
+chunks and FSDP parameter chunks re-partition on load (the chunk layout
+is recorded in the checkpoint meta).  Per-worker exchange-strategy state
+(onebit/topk/powersgd error-feedback buffers, async diverged replicas)
+has NO refit path — resuming such a run on a different worker count
+raises a targeted error from ``load()`` (round-4 ADVICE #3).  This
+script trains 1 epoch on 8 workers with FSDP + adam, checkpoints,
+rebuilds on 4 workers, resumes, and shows the val accuracy carrying
+over.
 """
 
 import os

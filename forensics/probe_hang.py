@@ -1,0 +1,14 @@
+"""Wedge-forensics probe: touch the axon backend with faulthandler armed.
+
+Dumps all-thread Python stacks to stderr after 45s and 90s if still
+alive, so a wedged jax.devices() leaves its own trace. Run under
+`timeout -s KILL 120` from the watcher/forensics harness."""
+import faulthandler, sys, os, time
+faulthandler.enable()
+faulthandler.dump_traceback_later(45, repeat=True, file=sys.stderr)
+print("probe pid", os.getpid(), flush=True)
+t0 = time.time()
+import jax
+print("jax imported at", round(time.time()-t0, 1), flush=True)
+ds = jax.devices()
+print("devices:", ds, "at", round(time.time()-t0, 1), flush=True)

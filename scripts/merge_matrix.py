@@ -10,15 +10,70 @@ null if none succeeded), preserving first-seen config order.
     python scripts/merge_matrix.py out.jsonl [more.jsonl ...]
 
 With several inputs, later files win ties and the FIRST file is rewritten.
+
+Degraded-window hygiene (round-4 verdict #8): a row whose result or note
+carries a "degraded" marker (the manual voiding convention — see
+perf_matrix_r4.jsonl's alexnet-b128 row and BASELINE.md's round-4 hardware
+section) never beats a healthy non-null row for the same config, so the
+stale number can't be quoted from the canonical artifact by accident.  A
+VOIDING TOMBSTONE (result null + degraded note, optionally with
+``"voided_value": N``) outranks untagged rows carrying the voided value —
+merging an old backup that still holds the original untagged reading
+cannot resurrect it — while a genuine healthy re-measure (a different
+reading) supersedes the tombstone.
 """
 
 import json
 import sys
 
 
+def _is_degraded(row: dict) -> bool:
+    """A row voided (or tagged) for coming from a degraded tunnel window.
+    Convention: the word 'degraded' in the row's note or in the result's
+    metric string.  Shared with bench.py's _last_good and
+    predict_scaling.py — keep the convention in THIS one place.
+    Defensive against foreign rows whose result isn't a dict."""
+    res = row.get("result")
+    blob = str(row.get("note", "")) + str(
+        res.get("metric", "") if isinstance(res, dict) else "")
+    return "degraded" in blob.lower()
+
+
+def _rank(row: dict, voided: dict, cfg: str) -> int:
+    """healthy non-null (3) > voiding tombstone (2) > degraded non-null
+    (1) > plain null (0).  The tombstone outranks degraded readings so a
+    merged-in old backup still holding the original untagged value can't
+    resurrect it; a non-null row whose value matches the config's
+    tombstoned reading is classified degraded even when untagged."""
+    res = row.get("result")
+    if res is None:
+        return 2 if _is_degraded(row) else 0
+    if not isinstance(res, dict):
+        return 0          # foreign/hand-edited row — never canonical
+    if _is_degraded(row):
+        return 1
+    vv = voided.get(cfg)
+    val = res.get("value")
+    if vv is not None and val is not None and \
+            abs(float(val) - float(vv)) < 1e-6:
+        return 1
+    return 3
+
+
 def merge(paths: list[str]) -> None:
     order: list[str] = []
     best: dict[str, dict] = {}
+    voided: dict[str, float] = {}   # config -> tombstoned reading
+    for path in paths:              # first sweep: collect tombstones
+        with open(path) as f:
+            for line in f:
+                try:
+                    row = json.loads(line)
+                except ValueError:
+                    continue
+                if isinstance(row, dict) and _is_degraded(row) and \
+                        row.get("voided_value") is not None:
+                    voided[row.get("config", "")] = row["voided_value"]
     for path in paths:
         with open(path) as f:
             for line in f:
@@ -37,9 +92,18 @@ def merge(paths: list[str]) -> None:
                 if cfg not in best:
                     order.append(cfg)
                     best[cfg] = row
-                elif row.get("result") is not None or \
-                        best[cfg].get("result") is None:
+                    continue
+                # within a rank class the LAST row wins (newest re-measure)
+                if _rank(row, voided, cfg) >= _rank(best[cfg], voided, cfg):
                     best[cfg] = row
+    # a degraded survivor (no healthy sibling anywhere) is flagged so
+    # nothing downstream quotes it silently
+    for cfg, row in best.items():
+        if row.get("result") is not None and \
+                _rank(row, voided, cfg) == 1:
+            print(f"merge_matrix: {cfg} only has a DEGRADED-window "
+                  "reading — do not quote; re-measure in a healthy "
+                  "window", file=sys.stderr)
     with open(paths[0], "w") as f:
         for cfg in order:
             f.write(json.dumps(best[cfg]) + "\n")

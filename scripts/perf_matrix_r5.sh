@@ -1,0 +1,83 @@
+#!/usr/bin/env bash
+# Round-5 perf matrix on the live TPU chip — round-4 verdict #1: "numbers
+# ARE the round".  Same complete config set as round 4 (every BASELINE.json
+# staged config at its reference batch, the bf16-BN and batch-size levers,
+# compressed-wire rows, the transformer family, the staged rules, the
+# real-data pipeline rows, spc multi-step dispatch), written to a FRESH
+# round-5 artifact so every number in it is from this round's windows.
+# Rows already measured in the out-file are skipped, so the script is
+# re-runnable after a tunnel wedge (scripts/tpu_watch_r5.sh drives that).
+#
+# New vs r4: two local-compile A/B rows (PALLAS_AXON_REMOTE_COMPILE=0 —
+# client-side AOT compile via the local libtpu instead of terminal-side
+# compile).  WEDGE.md's forensics point at terminal-side activity from big
+# compiles as the wedge trigger; the -lc rows test the avoidance recipe.
+# The cheap cifar10 canary runs early (validates the local-compile path
+# works at all in this image); the big-compile A/B runs last.
+#   ./scripts/perf_matrix_r5.sh [out_file]
+set -u -o pipefail
+OUT="${1:-perf_matrix_r5.jsonl}"
+cd "$(dirname "$0")/.."
+. scripts/_bench_row.sh
+
+# Row order is greedy-by-value-per-minute-of-tunnel-uptime (windows have
+# been as short as ~10 min): the round-4 degraded alexnet-b128 reading was
+# voided (verdict #8), so it re-measures FIRST; then the never-measured
+# staged configs; wedge-correlated big compiles (spc scans, VGG-16) last.
+
+# -- staged configs at reference batch sizes (the comparison that counts) --
+run alexnet-b128             BENCH_MODEL=alexnet
+run resnet50-b32             BENCH_MODEL=resnet50
+run googlenet-b32            BENCH_MODEL=googlenet
+run cifar10-b128             BENCH_MODEL=cifar10
+# local-compile canary: tiny program, proves PALLAS_AXON_REMOTE_COMPILE=0
+# initializes + compiles + runs in this image before we lean on it below
+run cifar10-b128-lc          BENCH_MODEL=cifar10 PALLAS_AXON_REMOTE_COMPILE=0
+run vgg16-b32                BENCH_MODEL=vgg16
+
+# -- bf16-BN lever A/B (BASELINE.md round-4 committed predictions) --
+run resnet50-b32-bnbf16      BENCH_MODEL=resnet50 BENCH_BN_DTYPE=bfloat16
+
+# -- batch-size headroom (MFU pushes; verdict #3 round-4 wants verdicts) --
+run resnet50-b64             BENCH_MODEL=resnet50 BENCH_BATCH=64
+run resnet50-b128            BENCH_MODEL=resnet50 BENCH_BATCH=128
+run resnet50-b128-bnbf16     BENCH_MODEL=resnet50 BENCH_BATCH=128 BENCH_BN_DTYPE=bfloat16
+run googlenet-b128           BENCH_MODEL=googlenet BENCH_BATCH=128
+run vgg16-b64                BENCH_MODEL=vgg16 BENCH_BATCH=64
+
+# -- staged rules + compressed wire on their staged models (BASELINE #3-#5) --
+run vgg16-b32-easgd          BENCH_MODEL=vgg16 BENCH_RULE=easgd
+run resnet50-b32-gosgd       BENCH_MODEL=resnet50 BENCH_RULE=gosgd
+run vgg16-b32-topk           BENCH_MODEL=vgg16 BENCH_STRATEGY=topk
+run vgg16-b32-onebit         BENCH_MODEL=vgg16 BENCH_STRATEGY=onebit
+run vgg16-b32-powersgd4      BENCH_MODEL=vgg16 BENCH_STRATEGY=powersgd4
+
+# -- real-data path (verdict #4): .hkl shards -> native loader -> device --
+run alexnet-b128-realdata    BENCH_MODEL=alexnet BENCH_REAL_DATA=1
+run alexnet-b128-realdata-u8w BENCH_MODEL=alexnet BENCH_REAL_DATA=1 BENCH_WIRE_U8=1
+
+# -- transformer family (beyond-parity; value = sequences/sec/chip) --
+run transformer_lm-b16       BENCH_MODEL=transformer_lm BENCH_BATCH=16 BENCH_CFG="$LM_CFG"
+run transformer_lm-b16-flash BENCH_MODEL=transformer_lm BENCH_BATCH=16 BENCH_CFG="${LM_CFG%\}},\"attn_impl\":\"flash\"}"
+run moe_lm-b16               BENCH_MODEL=moe_lm         BENCH_BATCH=16 BENCH_CFG="$LM_CFG"
+
+# -- spc (multi-step dispatch) rows LAST: the scan-of-k-steps compile is
+#    the biggest program per model and the round-4 wedge #1 trigger.
+#    alexnet-b128-spc4 first: it is the flagship record config (r3:
+#    14,162 img/s) and the driver's round-end bench default --
+run alexnet-b128-spc4        BENCH_MODEL=alexnet  BENCH_SPC=4
+run alexnet-b128-spc8        BENCH_MODEL=alexnet  BENCH_SPC=8 BENCH_SYNTH_BATCHES=8
+run googlenet-b32-spc8       BENCH_MODEL=googlenet BENCH_SPC=8 BENCH_SYNTH_BATCHES=8
+run resnet50-b32-spc8        BENCH_MODEL=resnet50 BENCH_SPC=8 BENCH_SYNTH_BATCHES=8
+run resnet50-b32-spc8-bnbf16 BENCH_MODEL=resnet50 BENCH_SPC=8 BENCH_SYNTH_BATCHES=8 BENCH_BN_DTYPE=bfloat16
+run resnet50-b128-spc4       BENCH_MODEL=resnet50 BENCH_BATCH=128 BENCH_SPC=4
+run googlenet-b128-spc4      BENCH_MODEL=googlenet BENCH_BATCH=128 BENCH_SPC=4
+run vgg16-b32-spc4           BENCH_MODEL=vgg16 BENCH_SPC=4
+
+# -- wedge-avoidance A/B (WEDGE.md): re-run the two biggest wedge triggers
+#    with client-side compile; identical math, different compile venue --
+run vgg16-b32-lc             BENCH_MODEL=vgg16 PALLAS_AXON_REMOTE_COMPILE=0
+run alexnet-b128-spc4-lc     BENCH_MODEL=alexnet BENCH_SPC=4 PALLAS_AXON_REMOTE_COMPILE=0
+
+python scripts/merge_matrix.py "$OUT"
+cat "$OUT"

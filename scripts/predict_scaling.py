@@ -1,0 +1,209 @@
+#!/usr/bin/env python
+"""Predicted multi-chip scaling efficiency from measured 1-chip rows.
+
+This environment exposes ONE real TPU chip (round-4 verdict: "do not ask
+for real multi-chip runs; do ask for the comm-share-derived efficiency
+prediction").  This script produces that prediction: for each staged
+BASELINE.json config with a measured TPU t_train in the canonical perf
+matrix, it models the per-step wire bytes of the config's exchange
+strategy analytically (formulas below, from the strategy implementations
+in ``theanompi_tpu/parallel/strategies.py`` / ``exchanger.py``), divides
+by the TPU v5e ICI link bandwidth, and reports predicted scaling
+efficiency at 8 and 32 chips under two bounds:
+
+- ``eff_no_overlap``  = t_step / (t_step + t_comm)   (comm fully exposed)
+- ``eff_full_overlap`` = t_step / max(t_step, t_comm) (comm fully hidden)
+
+The truth lands between the bounds; XLA overlaps collectives with
+independent compute inside the jitted step, so well-fused configs sit
+near the full-overlap bound.  The reference's own headline table
+(SURVEY.md §6: time-per-5120-images vs worker count) is the shape this
+mirrors.
+
+Wire-bytes-per-step models (P = param count, b = wire bytes/elem,
+N = chips; ring collectives over a 1D ICI ring, per-chip bytes):
+- allreduce/ring (BSP fused grads):  2 * (N-1)/N * P * b
+- bf16 wire (nccl16/asa16):          same with b=2
+- EASGD (sync_freq=f):               2 * (N-1)/N * P * b / f
+- ASGD  (sync_freq=f, default 1):    same formula
+- GoSGD (exch_prob=p):               p * P * b   (expected send per step)
+- topk (ratio=r):                    (N-1) * r * P * 8   (allgather of
+                                     (idx,val) pairs from every worker)
+- onebit:                            2 * (N-1)/N * P/8  (packed signs)
+- powersgd rank r:                   2 * (N-1)/N * r * sum(rows+cols) * 4
+
+ICI bandwidth: TPU v5e has 4 ICI links/chip at ~45 GB/s per direction
+(public "How to Scale Your Model" figures); a bidirectional ring uses
+two directions -> BW = 90 GB/s effective, with a 2x sensitivity band
+reported (45/180) since the achieved fraction depends on topology and
+XLA's collective scheduling.
+
+Usage: python scripts/predict_scaling.py [matrix.jsonl ...]
+Writes one JSON object to stdout (the watcher redirects it to
+scaling_prediction_r5.json) and a human table to stderr.
+"""
+
+import glob
+import json
+import os
+import subprocess
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+ICI_GBPS = 90e9          # bidirectional 1D-ring effective, v5e (see above)
+SENS = (45e9, 180e9)     # sensitivity band
+CHIP_COUNTS = (8, 32)
+
+# staged configs (BASELINE.json) -> (matrix row, strategy model, params key)
+CONFIGS = [
+    ("alexnet-b128",      "allreduce", 4, "alexnet", 128),
+    ("googlenet-b32",     "allreduce", 4, "googlenet", 32),
+    ("vgg16-b32",         "allreduce", 4, "vgg16", 32),
+    ("resnet50-b32",      "allreduce", 4, "resnet50", 32),
+    ("cifar10-b128",      "allreduce", 4, "cifar10", 128),
+    ("vgg16-b32-easgd",   "easgd",     4, "vgg16", 32),
+    ("resnet50-b32-gosgd", "gosgd",    4, "resnet50", 32),
+    ("vgg16-b32-topk",    "topk",      4, "vgg16", 32),
+    ("vgg16-b32-onebit",  "onebit",    4, "vgg16", 32),
+    ("vgg16-b32-powersgd4", "powersgd4", 4, "vgg16", 32),
+]
+
+_COUNT_SRC = r"""
+import json, sys
+import jax
+jax.config.update("jax_platforms", "cpu")   # never touch the axon backend
+import importlib
+from theanompi_tpu.models.registry import MODELS
+out = {}
+for name in sys.argv[1:]:
+    modelfile, modelclass, extra = MODELS[name]
+    cfg = {"size": 1, "rank": 0, "verbose": False, **extra}
+    m = getattr(importlib.import_module(modelfile), modelclass)(cfg)
+    leaves = jax.tree.leaves(m.params)
+    P = sum(int(l.size) for l in leaves)
+    rc = sum(int(l.shape[0]) + int(l.size // l.shape[0])
+             for l in leaves if getattr(l, "ndim", 0) >= 2)
+    out[name] = {"params": P, "rows_plus_cols": rc}
+print(json.dumps(out))
+"""
+
+
+def _param_counts(models: list) -> dict:
+    """Instantiate each model on the CPU backend in a SUBPROCESS (the
+    parent may live next to a wedged axon tunnel; the child forces the
+    CPU platform programmatically before any backend touch) and cache
+    the counts beside the repo."""
+    cache = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         "..", "model_param_counts.json")
+    have = {}
+    if os.path.exists(cache):
+        with open(cache) as f:
+            have = json.load(f)
+    missing = [m for m in models if m not in have]
+    if missing:
+        r = subprocess.run([sys.executable, "-c", _COUNT_SRC] + missing,
+                           capture_output=True, text=True, timeout=1200)
+        if r.returncode != 0:
+            sys.stderr.write(r.stderr[-2000:])
+            raise RuntimeError("param-count subprocess failed")
+        have.update(json.loads(r.stdout.strip().splitlines()[-1]))
+        with open(cache, "w") as f:
+            json.dump(have, f, indent=1, sort_keys=True)
+    return have
+
+
+def wire_bytes(strategy: str, P: int, rows_plus_cols: int, n: int) -> float:
+    ring = 2.0 * (n - 1) / n
+    if strategy == "allreduce":
+        return ring * P * 4
+    if strategy == "easgd":
+        return ring * P * 4 / 4            # sync_freq default 4
+    if strategy == "asgd":
+        return ring * P * 4
+    if strategy == "gosgd":
+        return 0.25 * P * 4                # exch_prob default
+    if strategy == "topk":
+        return (n - 1) * 0.01 * P * 8      # ratio default, (idx,val)
+    if strategy == "onebit":
+        return ring * P / 8
+    if strategy.startswith("powersgd"):
+        r = int(strategy[len("powersgd"):] or 2)
+        return ring * r * rows_plus_cols * 4
+    raise ValueError(strategy)
+
+
+def newest_matrix(paths: list) -> dict:
+    """config -> result dict, newest round wins, degraded rows excluded —
+    reusing the SAME convention implementations as the rest of the
+    pipeline (merge_matrix._is_degraded, bench._matrix_round) so the
+    prediction can't anchor to rows the merge hygiene considers voided."""
+    from bench import _matrix_round
+    from scripts.merge_matrix import _is_degraded
+    rows: dict = {}
+    for path in sorted(paths, key=_matrix_round):
+        for line in open(path):
+            try:
+                row = json.loads(line)
+            except ValueError:
+                continue
+            res = row.get("result")
+            if not isinstance(res, dict) or _is_degraded(row):
+                continue
+            rows[row.get("config", "")] = res
+    return rows
+
+
+def main() -> int:
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    paths = sys.argv[1:] or sorted(
+        glob.glob(os.path.join(repo, "perf_matrix_*.jsonl")))
+    measured = newest_matrix(paths)
+    counts = _param_counts(sorted({c[3] for c in CONFIGS}))
+
+    out = {"ici_bw_bytes_per_s": ICI_GBPS, "sensitivity_band": SENS,
+           "method": "analytic wire-bytes / ICI-bw anchored to measured "
+                     "1-chip t_step; see scripts/predict_scaling.py "
+                     "docstring for formulas and bounds", "rows": []}
+    hdr = (f"{'config':24} {'ips/chip':>9} {'t_step ms':>9} "
+           + "".join(f"{'eff@' + str(n) + ' (no/full ovl)':>22}"
+                     for n in CHIP_COUNTS))
+    print(hdr, file=sys.stderr)
+    for cfg, strat, b, model, batch in CONFIGS:
+        res = measured.get(cfg)
+        row = {"config": cfg, "strategy": strat, "model": model}
+        if not res or "spc" in str(res.get("metric", "")):
+            row["measured"] = None
+            out["rows"].append(row)
+            print(f"{cfg:24} {'--':>9}  (no healthy spc=1 TPU row yet)",
+                  file=sys.stderr)
+            continue
+        ips = float(res["value"])
+        t_step = batch / ips
+        P = counts[model]["params"]
+        rc = counts[model]["rows_plus_cols"]
+        row.update(measured_ips_per_chip=ips, t_step_s=round(t_step, 6),
+                   params=P)
+        cells = ""
+        for n in CHIP_COUNTS:
+            t_comm = wire_bytes(strat, P, rc, n) / ICI_GBPS
+            no_ovl = t_step / (t_step + t_comm)
+            full_ovl = t_step / max(t_step, t_comm)
+            row[f"pred_{n}chip"] = {
+                "t_comm_s": round(t_comm, 6),
+                "eff_no_overlap": round(no_ovl, 4),
+                "eff_full_overlap": round(full_ovl, 4),
+                "eff_band_low": round(t_step / (t_step + wire_bytes(
+                    strat, P, rc, n) / SENS[0]), 4),
+                "eff_band_high": round(t_step / (t_step + wire_bytes(
+                    strat, P, rc, n) / SENS[1]), 4)}
+            cells += f"{no_ovl:>11.3f}/{full_ovl:<10.3f}"
+        out["rows"].append(row)
+        print(f"{cfg:24} {ips:>9.0f} {t_step * 1e3:>9.2f} {cells}",
+              file=sys.stderr)
+    print(json.dumps(out, indent=1))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

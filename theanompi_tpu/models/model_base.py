@@ -672,7 +672,11 @@ class ModelBase:
             # shared filesystem would corrupt the archive
             return os.path.join(ckpt_dir, f"ckpt_epoch{epoch}.npz")
         extra_meta = {"boxed_parts": sorted(k for k in state
-                                            if k not in ident)}
+                                            if k not in ident),
+                      # lets load() give a targeted error (not a raw shape
+                      # mismatch) when per-worker state meets a different
+                      # worker count (round-4 ADVICE #3)
+                      "n_workers": self.mesh.shape[WORKER_AXIS]}
         if self._fsdp is not None:
             # the chunk layout facts, so a resume on a DIFFERENT worker
             # count can re-partition the flat vector (load() refit path)
@@ -783,6 +787,23 @@ class ModelBase:
                 f"unexpected chunked state leaf shape {x.shape}")
             return jax.ShapeDtypeStruct((n_s,), x.dtype)
 
+        # Per-worker state with NO refit path (exchange-strategy error-
+        # feedback buffers, async diverged replicas) cannot cross a
+        # worker-count change — fail with the real reason instead of a
+        # raw leaf-shape mismatch deep in load_checkpoint (round-4
+        # ADVICE #3).  Worker-count-portable layouts: dedup'd replicas
+        # (BSP), and the FSDP/ZeRO chunked parts handled by refit above.
+        n_saved = peek.get("n_workers")
+        if n_saved is not None and int(n_saved) != n:
+            stuck = sorted(set(boxed_parts) - set(refit_parts))
+            if stuck:
+                raise ValueError(
+                    f"checkpoint was saved on {n_saved} workers; part(s) "
+                    f"{stuck} hold per-worker state (exchange-strategy "
+                    f"error feedback / diverged replicas) with no "
+                    f"worker-count refit — resume on {n_saved} workers, "
+                    f"or use elastic resume with the portable layouts "
+                    f"(BSP / ZeRO-1 / FSDP; see docs/api.md)")
         template = {
             k: jax.tree.map(
                 (shape_of_saved if k in refit_parts
