@@ -165,14 +165,75 @@ def test_composes_with_zero_and_spc(mesh4):
         one.step_state["params"], spc.step_state["params"])
 
 
-def test_rejects_model_parallel_specs(mesh8):
+def test_composes_with_tensor_parallelism(mesh8):
+    """Round-4 verdict #6 (the one strategy×parallelism hole): powersgd
+    under tp.  Each tp rank compresses ITS local grad shard independently
+    (the flat strategies' shard-wise composition), with the per-leaf Q/e
+    state carried in a leading [prod(group)] axis sharded over 'model'.
+    Loss trains down; EF state is genuinely per-rank; Q stays identical
+    across the two WORKERS of each rank (the shared-Q invariant)."""
     from theanompi_tpu.models.transformer_lm import TransformerLM
-    from theanompi_tpu.parallel.mesh import worker_mesh
-    mesh = worker_mesh(2, tp=2)
-    cfg = {"mesh": mesh, "size": 2, "rank": 0, "tp": 2, "verbose": False,
-           "exch_strategy": "powersgd", "batch_size": 8, "seq_len": 16,
-           "vocab": 32, "d_model": 32, "n_head": 4, "n_layer": 2,
+    from theanompi_tpu.parallel.mesh import MODEL_AXIS, worker_mesh
+    mesh = worker_mesh(2, tp=4)
+    cfg = {"mesh": mesh, "size": 2, "rank": 0, "tp": 4, "verbose": False,
+           "exch_strategy": "powersgd2", "batch_size": 8, "seq_len": 16,
+           "vocab": 32, "d_model": 64, "n_head": 4, "n_layer": 2,
+           "synthetic_train": 64, "synthetic_val": 32,
            "compute_dtype": jnp.float32}
     lm = TransformerLM(cfg)
-    with pytest.raises(AssertionError, match="per-leaf state"):
-        lm.compile_iter_fns(BSP_Exchanger(cfg))
+    lm.compile_iter_fns(BSP_Exchanger(cfg))
+    lm.data.shuffle_data(0)
+    costs = []
+    for i in range(8):
+        lm.train_iter(i, None)
+        costs.append(float(lm.current_info["cost"]))
+    assert np.isfinite(costs).all(), costs
+    assert np.mean(costs[-3:]) < np.mean(costs[:3]), costs
+
+    state = lm.step_state["extra"]["strat"]
+    # state arrays: [n_workers, tp, ...] sharded (workers, model)
+    seen_ranked = rank_diff = False
+    for st in state:
+        q = np.asarray(jax.device_get(st["q"]))
+        e = np.asarray(jax.device_get(st["e"]))
+        if e.shape[-1] and e.shape[-2]:
+            for key in ("q", "e"):   # empty sentinels lose their spec —
+                leaf = st[key]       # only real state must shard (w, tp)
+                spec = tuple(leaf.sharding.spec)
+                assert spec[:2] == ("workers", MODEL_AXIS), \
+                    (leaf.shape, spec)
+            seen_ranked = True
+            # the shared-Q invariant holds per tp rank: Q is a psum over
+            # the worker axis, identical on both workers
+            np.testing.assert_allclose(q[0], q[1], rtol=1e-5, atol=1e-6)
+            # EF residuals are genuinely per-worker (different data)
+            assert not np.allclose(e[0], e[1])
+            # ...and per tp rank on SHARDED leaves (tp-replicated leaves
+            # legitimately carry rank-identical residuals)
+            rank_diff = rank_diff or not np.allclose(e[0, 0], e[0, 1])
+    assert seen_ranked, "no compressible leaf exercised the tp state path"
+    assert rank_diff, "no leaf showed per-tp-rank EF state"
+
+
+def test_composes_with_sequence_parallelism(mesh8):
+    """Regression (round-5 review): under sp the params are replicated
+    (param_specs() is None) and the per-leaf state must stay in its plain
+    layout — the leading-group-axis unwrap applies only to sharded-param
+    models."""
+    from theanompi_tpu.models.transformer_lm import TransformerLM
+    from theanompi_tpu.parallel.mesh import worker_mesh
+    mesh = worker_mesh(2, sp=4)
+    cfg = {"mesh": mesh, "size": 2, "rank": 0, "sp": 4, "verbose": False,
+           "exch_strategy": "powersgd2", "batch_size": 8, "seq_len": 32,
+           "vocab": 32, "d_model": 64, "n_head": 4, "n_layer": 2,
+           "synthetic_train": 64, "synthetic_val": 32,
+           "compute_dtype": jnp.float32}
+    lm = TransformerLM(cfg)
+    lm.compile_iter_fns(BSP_Exchanger(cfg))
+    lm.data.shuffle_data(0)
+    costs = []
+    for i in range(6):
+        lm.train_iter(i, None)
+        costs.append(float(lm.current_info["cost"]))
+    assert np.isfinite(costs).all(), costs
+    assert np.mean(costs[-2:]) < np.mean(costs[:2]), costs

@@ -319,11 +319,15 @@ class PowerSGD(Strategy):
     small to win (min dim ≤ 4r) reduce exactly — their wire share is
     negligible.
 
-    State is PER LEAF ([Q, e] list aligned with the gradient leaves),
-    not a flat vector — pure data-parallel layouts only (model-parallel
-    shards would need per-leaf sharded state specs; the flat-vector
-    strategies cover that case).  Select via ``exch_strategy='powersgd'``
-    (rank 2) or ``'powersgd<r>'``.
+    State is PER LEAF ([Q, e] list aligned with the gradient leaves), not
+    a flat vector.  Under model parallelism (tp/pp) each model/pipe rank
+    compresses ITS local grad shard independently — the same shard-wise
+    composition the flat strategies use — with the per-leaf state carried
+    in a leading ``[prod(group)]`` axis sharded over the group axes
+    (``BSP_Exchanger.extra_state_template`` builds it from the LOCAL
+    shard template and ``extra_specs`` declares ``P(group)``; the
+    exchanger unwraps the leading axis around the call).  Select via
+    ``exch_strategy='powersgd'`` (rank 2) or ``'powersgd<r>'``.
     """
 
     stateful = True
