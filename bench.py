@@ -253,6 +253,24 @@ def _run_inner(run_timeout: float, force_cpu: bool) -> tuple[int, str, str]:
     env = dict(os.environ, BENCH_INNER="1")
     if force_cpu:
         env["BENCH_FORCE_CPU"] = "1"
+    proc = None
+
+    def _reap(signum, frame):
+        # the wrapper itself being TERM'd (an outer `timeout`, a watcher
+        # restart) must not orphan the detached inner session — a leaked
+        # 100%-CPU inner on this 1-core box poisons every later
+        # measurement (observed round 5).  Handlers are installed BEFORE
+        # the Popen (no-op while proc is None) so there is no window
+        # where a signal can still orphan the inner.
+        if proc is not None:
+            try:
+                os.killpg(proc.pid, signal.SIGKILL)
+            except ProcessLookupError:
+                pass
+        raise SystemExit(128 + signum)
+
+    prev = {s: signal.signal(s, _reap)
+            for s in (signal.SIGTERM, signal.SIGINT, signal.SIGHUP)}
     proc = subprocess.Popen([sys.executable, os.path.abspath(__file__)],
                             env=env, stdout=subprocess.PIPE,
                             stderr=subprocess.PIPE, text=True,
@@ -267,6 +285,9 @@ def _run_inner(run_timeout: float, force_cpu: bool) -> tuple[int, str, str]:
             pass
         out, err = proc.communicate()
         return -9, out, err
+    finally:
+        for s, h in prev.items():
+            signal.signal(s, h)
 
 
 def wrapper_main() -> int:

@@ -73,6 +73,10 @@ run resnet50-b32-spc8-bnbf16 BENCH_MODEL=resnet50 BENCH_SPC=8 BENCH_SYNTH_BATCHE
 run resnet50-b128-spc4       BENCH_MODEL=resnet50 BENCH_BATCH=128 BENCH_SPC=4
 run googlenet-b128-spc4      BENCH_MODEL=googlenet BENCH_BATCH=128 BENCH_SPC=4
 run vgg16-b32-spc4           BENCH_MODEL=vgg16 BENCH_SPC=4
+# flagship record-setter headroom: double the batch on the spc4 record
+# config (r3 trace: after spc fixed host dispatch, HBM/MXU utilization is
+# the next lever — bigger batch amortizes both)
+run alexnet-b256-spc4        BENCH_MODEL=alexnet BENCH_BATCH=256 BENCH_SPC=4
 
 # -- wedge-avoidance A/B (WEDGE.md): re-run the two biggest wedge triggers
 #    with client-side compile; identical math, different compile venue --

@@ -236,3 +236,75 @@ def test_merge_matrix_tombstone_blocks_resurrection(tmp_path, capsys):
     merge_matrix.merge([str(main)])
     out = [json.loads(l) for l in main.read_text().splitlines()]
     assert out[0]["result"]["value"] == 10584.5
+
+
+def test_wrapper_sigterm_reaps_detached_inner():
+    """Round-5 regression: the inner measurement runs in its OWN session
+    (so BENCH_TIMEOUT can killpg it), which means a TERM'd wrapper (outer
+    `timeout`, watcher restart) would orphan it — a leaked 100%-CPU inner
+    on a 1-core box poisons later measurements.  The wrapper must reap the
+    inner when it is itself terminated."""
+    import signal
+    import subprocess
+    import time
+    env = {k: v for k, v in os.environ.items()
+           if not k.startswith("BENCH_")}
+    env.update(BENCH_FORCE_CPU="1", BENCH_MODEL="cifar10",
+               BENCH_TIMEOUT="600",
+               XLA_FLAGS="--xla_force_host_platform_device_count=8")
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    proc = subprocess.Popen([sys.executable, os.path.join(repo, "bench.py")],
+                            env=env, cwd=repo, stdout=subprocess.DEVNULL,
+                            stderr=subprocess.DEVNULL)
+
+    def my_inner_pid():
+        # ONLY this wrapper's child (ppid match): a machine-wide
+        # BENCH_INNER scan could find — and later kill — a live
+        # production measurement (the round-5 watcher runs on this box)
+        for p in os.listdir("/proc"):
+            if not p.isdigit():
+                continue
+            try:
+                stat = open(f"/proc/{p}/stat").read()
+                environ = open(f"/proc/{p}/environ", "rb").read()
+            except OSError:
+                continue
+            ppid = int(stat.rsplit(")", 1)[1].split()[1])
+            if ppid == proc.pid and b"BENCH_INNER=1" in environ:
+                return int(p)
+        return None
+
+    def alive(pid):
+        try:
+            os.kill(pid, 0)
+            return True
+        except ProcessLookupError:
+            return False
+
+    inner = None
+    try:
+        # wait for the inner to exist (wrapper spawns it immediately — the
+        # probe is skipped under BENCH_FORCE_CPU)
+        deadline = time.time() + 60
+        while inner is None and time.time() < deadline:
+            inner = my_inner_pid()
+            if inner is None:
+                time.sleep(0.5)
+        assert inner is not None, "inner measurement process never appeared"
+        proc.send_signal(signal.SIGTERM)
+        proc.wait(timeout=30)
+        deadline = time.time() + 10
+        while alive(inner) and time.time() < deadline:
+            time.sleep(0.5)
+        leaked = alive(inner)
+        assert not leaked, f"wrapper TERM leaked inner pid {inner}"
+    finally:
+        # never leave a CPU-burner behind, whatever failed above; the
+        # inner is a session leader, so killpg takes its children too
+        if proc.poll() is None:
+            proc.kill()
+        if inner is not None and alive(inner):
+            try:
+                os.killpg(inner, signal.SIGKILL)
+            except (ProcessLookupError, PermissionError):
+                pass

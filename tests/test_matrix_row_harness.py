@@ -84,3 +84,68 @@ def test_wedge_short_circuits_the_pass(tmp_path):
     assert rows[1]["result"] is None
     assert "tunnel wedged earlier this pass" in r.stderr
     assert not any(x["config"] == "c" for x in rows)
+
+
+def test_r5_matrix_script_row_inventory():
+    """The round-5 matrix script's static contract: unique labels, the
+    watcher's N_CONFIGS grep counts them all, the lc A/B rows flip the
+    compile-venue env, and the big-compile rows stay at the back."""
+    path = os.path.join(REPO, "scripts", "perf_matrix_r5.sh")
+    lines = [ln.strip() for ln in open(path)
+             if ln.strip().startswith("run ")]
+    labels = [ln.split()[1] for ln in lines]
+    assert len(labels) == len(set(labels)), "duplicate row labels"
+    assert len(labels) >= 30
+    # the degraded r4 row re-measures FIRST (verdict #8)
+    assert labels[0] == "alexnet-b128"
+    # wedge-correlated big compiles last: all spc rows after all spc-less
+    # non-lc rows
+    first_spc = next(i for i, l in enumerate(labels) if "spc" in l)
+    assert all("spc" in l or l.endswith("-lc")
+               for l in labels[first_spc:]), labels[first_spc:]
+    # every lc row flips the compile venue for exactly that row
+    for ln in lines:
+        assert (" PALLAS_AXON_REMOTE_COMPILE=0" in ln) == \
+            (ln.split()[1].endswith("-lc")), ln
+    # the watcher counts rows with the same grep it gates completion on
+    import subprocess as sp
+    n = int(sp.run(["grep", "-c", "^run ", path],
+                   capture_output=True, text=True).stdout.strip())
+    assert n == len(labels)
+
+
+def test_r5_watcher_fresh_bench_gating(tmp_path):
+    """The watcher re-runs the flagship bench until one HEALTHY reading
+    lands: the gating grep must treat a missing file, an error-only file,
+    and a STALE last-good as 'retry', and a healthy value as 'done'."""
+    import subprocess as sp
+
+    # extract the LIVE compound condition from the watcher script, so an
+    # edit there (e.g. dropping the STALE clause) fails THIS test rather
+    # than leaving a stale inline copy green
+    import re
+    src = open(os.path.join(REPO, "scripts", "tpu_watch_r5.sh")).read()
+    m = re.search(
+        r"if (! grep -qs.*?BENCH_r05_fresh\.json.*?); then", src, re.S)
+    assert m, "fresh-bench gating condition not found in tpu_watch_r5.sh"
+    cond = m.group(1).replace("\\\n", " ")
+
+    def needs_retry(content):
+        f = tmp_path / "BENCH_r05_fresh.json"
+        if content is None:
+            f.unlink(missing_ok=True)
+        else:
+            f.write_text(content)
+        r = sp.run(["bash", "-c",
+                    f"if {cond}; then echo retry; else echo done; fi"],
+                   capture_output=True, text=True, cwd=tmp_path)
+        return r.stdout.strip() == "retry"
+
+    assert needs_retry(None)
+    assert needs_retry(json.dumps({"error": "backend probe hung"}))
+    assert needs_retry(json.dumps(
+        {"metric": "STALE last-good (alexnet-b128-spc4) ...",
+         "value": 14162.35}))
+    assert not needs_retry(json.dumps(
+        {"metric": "images_per_sec_per_chip (alexnet ... spc=4)",
+         "value": 15000.0, "unit": "images/sec/chip"}))
