@@ -12,19 +12,27 @@ import textwrap
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 STUB_BENCH = textwrap.dedent("""\
-    import json, os, sys
-    mode = os.environ.get("STUB_MODE", "ok")
-    name = os.environ.get("BENCH_MODEL", "m")
-    if mode == "ok":
-        print(json.dumps({"metric": f"x ({name})", "value": 1.0,
-                          "unit": "u", "vs_baseline": 1.0}))
+    import json, os, re, sys
+
+    def _matrix_round(path):
+        # predict_scaling.py does `from bench import _matrix_round` —
+        # keep the stub import-compatible with the real bench.py
+        m = re.search(r"_r(\\d+)", os.path.basename(path))
+        return int(m.group(1)) if m else -1
+
+    if __name__ == "__main__":
+        mode = os.environ.get("STUB_MODE", "ok")
+        name = os.environ.get("BENCH_MODEL", "flagship")
+        if mode == "ok":
+            print(json.dumps({"metric": f"x ({name})", "value": 1.0,
+                              "unit": "u", "vs_baseline": 1.0}))
+            sys.exit(0)
+        if mode == "fail":
+            print(json.dumps({"error": "measurement rc=1: boom"}))
+            sys.exit(0)
+        # wedge: the wrapper's structured wedge report
+        print(json.dumps({"error": "probe hung \\u2014 tunnel wedged"}))
         sys.exit(0)
-    if mode == "fail":
-        print(json.dumps({"error": "measurement rc=1: boom"}))
-        sys.exit(0)
-    # wedge: the wrapper's structured wedge report
-    print(json.dumps({"error": "probe hung \\u2014 tunnel wedged"}))
-    sys.exit(0)
 """)
 
 
@@ -149,3 +157,70 @@ def test_r5_watcher_fresh_bench_gating(tmp_path):
     assert not needs_retry(json.dumps(
         {"metric": "images_per_sec_per_chip (alexnet ... spc=4)",
          "value": 15000.0, "unit": "images/sec/chip"}))
+
+
+def test_r5_watcher_full_chain_rehearsal(tmp_path):
+    """Round-4 verdict weak #1 ('the measurement layer is untested in
+    anger ... still a rehearsal'): rehearse the ENTIRE unattended
+    recovery chain — tpu_watch_r5.sh -> perf_matrix_r5.sh -> per-row
+    bench -> merge_matrix -> flagship BENCH_r05_fresh -> predict_scaling
+    -> clean exit — against a stubbed healthy backend.  This drives the
+    real scripts byte-for-byte except: the TPU probe is forced true, the
+    probe/sleep cadence collapsed, the lockfile moved (the REAL watcher
+    is live on this box), and bench.py replaced by a stub that emits a
+    healthy row per invocation."""
+    import re
+    import subprocess as sp
+
+    # the shared stub models bench.py's output contract in ONE place
+    # (healthy JSON per invocation + the _matrix_round import surface)
+    (tmp_path / "bench.py").write_text(STUB_BENCH)
+    scripts = tmp_path / "scripts"
+    scripts.mkdir()
+    for f in ("_bench_row.sh", "perf_matrix_r5.sh", "merge_matrix.py",
+              "predict_scaling.py"):
+        scripts.joinpath(f).write_text(
+            open(os.path.join(REPO, "scripts", f)).read())
+    # pre-seed the param-count cache so predict_scaling needs no model
+    # instantiation subprocess
+    cache = os.path.join(REPO, "model_param_counts.json")
+    (tmp_path / "model_param_counts.json").write_text(open(cache).read())
+
+    watch = open(os.path.join(REPO, "scripts", "tpu_watch_r5.sh")).read()
+    # force the probe healthy, collapse the cadence, relocate the lock
+    watch2, n1 = re.subn(
+        r"if timeout 90 python -c \\\n.*?>/dev/null 2>&1; then",
+        "if true; then", watch, flags=re.S)
+    watch2, n2 = re.subn(r"\bsleep 120\b", "sleep 0", watch2)
+    watch2, n3 = re.subn(r"LOCK=/tmp/tpu_watch_r5\.pid",
+                         f"LOCK={tmp_path}/watch.pid", watch2)
+    # the backgrounded net_snapshot would hold the captured pipes open
+    # for its full ~180s sleep ladder after the watcher exits — skip it
+    watch2, n4 = re.subn(r"net_snapshot &", ": net_snapshot-skipped",
+                         watch2)
+    assert (n1, n2, n3, n4) == (1, 1, 1, 1), (n1, n2, n3, n4)
+    scripts.joinpath("tpu_watch_r5.sh").write_text(watch2)
+    for f in scripts.iterdir():
+        f.chmod(0o755)
+
+    r = sp.run(["bash", str(scripts / "tpu_watch_r5.sh")],
+               capture_output=True, text=True, cwd=tmp_path, timeout=300)
+    assert r.returncode == 0, (r.returncode, r.stderr[-2000:])
+    assert "matrix complete" in r.stderr
+
+    rows = [json.loads(l)
+            for l in (tmp_path / "perf_matrix_r5.jsonl").read_text()
+            .splitlines()]
+    n_expected = sum(1 for ln in open(os.path.join(
+        REPO, "scripts", "perf_matrix_r5.sh")) if ln.startswith("run "))
+    assert len(rows) == n_expected
+    assert all(r["result"]["value"] == 1.0 for r in rows)
+
+    fresh = json.loads((tmp_path / "BENCH_r05_fresh.json").read_text())
+    assert fresh["value"] == 1.0 and "STALE" not in fresh["metric"]
+    pred = json.loads((tmp_path / "scaling_prediction_r5.json").read_text())
+    # spc-less staged configs got anchored predictions from the stub rows
+    anchored = [row for row in pred["rows"]
+                if row.get("pred_32chip") is not None]
+    assert anchored, pred
+    assert (tmp_path / "forensics" / "probe_timeline.log").exists()
