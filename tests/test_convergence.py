@@ -34,8 +34,25 @@ GATE_ACC = 0.90
     # workers, 0.02/8 reached 100% by epoch 10 (rules_time_to_acc.json)
     ("ASGD", 14, {"sync_freq": 2, "learning_rate": 0.0025}),
     ("GOSGD", 10, {"exch_prob": 0.25}),
-])
+    # Round-5 compressed-wire gates: BSP training through each wire
+    # format must still reach the gate, not just pass the algebraic
+    # oracle tests.  Calibration (2026-07-31 probe): at the plain-BSP
+    # lr 0.02 the sign/low-rank wire is UNSTABLE on this task (onebit
+    # hit 90% in epoch 1 then diverged to chance; powersgd2 never left
+    # ~13%); at lr 0.005 both train cleanly (onebit 100% by epoch 2,
+    # powersgd2 by 3) — the standard EF-compression smaller-stable-lr
+    # practice, pinned here and documented in docs/api.md §4.  topk is
+    # gated at the PLAIN lr 0.02 on purpose: the docs say only
+    # onebit/powersgd need the lr drop, so topk's stability at the
+    # unmodified rate is machine-checked.
+    ("BSP", 6, {"exch_strategy": "onebit", "learning_rate": 0.005}),
+    ("BSP", 7, {"exch_strategy": "topk"}),
+    ("BSP", 6, {"exch_strategy": "powersgd2", "learning_rate": 0.005}),
+], ids=lambda v: v.get("exch_strategy", "") or None
+   if isinstance(v, dict) else None)
 def test_rule_trains_cifar10_to_accuracy(rule_name, epochs, extra):
+    label = rule_name + (f"+{extra['exch_strategy']}"
+                         if "exch_strategy" in extra else "")
     rule = getattr(tmpi, rule_name)()
     kw = dict(devices=8, modelfile="theanompi_tpu.models.cifar10",
               modelclass="Cifar10_model", epochs=epochs,
@@ -49,7 +66,7 @@ def test_rule_trains_cifar10_to_accuracy(rule_name, epochs, extra):
     assert len(accs) == epochs
     best = max(accs)
     assert best >= GATE_ACC, (
-        f"{rule_name} reached only {best:.1%} val accuracy in {epochs} "
+        f"{label} reached only {best:.1%} val accuracy in {epochs} "
         f"epochs (gate {GATE_ACC:.0%}); per-epoch: "
         f"{[round(a, 3) for a in accs]}")
     # and it should not be a fluke of one epoch: the training tail holds
