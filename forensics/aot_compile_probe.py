@@ -1,0 +1,85 @@
+#!/usr/bin/env python
+"""Wedge-independent proof that the LOCAL compile venue works (WEDGE.md §4).
+
+The `-lc` matrix rows flip `PALLAS_AXON_REMOTE_COMPILE=0`, moving XLA
+compilation from the remote terminal (the suspected wedge trigger) to the
+baked local libtpu.  A wedged tunnel blocks the *client* (claim leg), but
+the compile engine itself needs no tunnel: this probe builds a TPU v5e
+topology description (`jax.experimental.topologies`, local libtpu,
+TPU_SKIP_MDS_QUERY=1), lowers a representative training step (conv
+forward+backward + cross-worker pmean inside shard_map over a 4-chip
+mesh), compiles it for v5e ON THIS CPU HOST, and serializes the
+executable.
+
+Measured 2026-07-31 (this box, 1 vCPU, tunnel wedged the whole time):
+    mesh: {'workers': 4} -> lowered 0.1 s
+    COMPILED conv train step for v5e on this host: 9.1 s
+    serialized executable: 1,624,747 bytes
+
+So the -lc rows' compile path is proven reachable and fast enough; only
+executable load/execution needs the (healthy) tunnel client.
+
+Run under a killable timeout like every jax-touching probe on this host
+(the wedged tunnel hangs any accidental backend touch forever):
+
+    timeout -s KILL 420 python forensics/aot_compile_probe.py
+
+faulthandler is armed below as well, so a hang leaves its own stack.
+"""
+
+import faulthandler
+import os
+import sys
+import time
+
+os.environ.setdefault("TPU_SKIP_MDS_QUERY", "1")
+faulthandler.enable()
+faulthandler.dump_traceback_later(120, repeat=True, file=sys.stderr)
+
+import numpy as np                                  # noqa: E402
+import jax                                          # noqa: E402
+import jax.numpy as jnp                             # noqa: E402
+from jax.experimental import topologies             # noqa: E402
+from jax.sharding import Mesh, PartitionSpec as P   # noqa: E402
+
+
+def main() -> int:
+    topo = topologies.get_topology_desc(platform="tpu",
+                                        topology_name="v5e:2x2x1")
+    mesh = Mesh(np.array(topo.devices).reshape(4), ("workers",))
+    print("mesh:", dict(mesh.shape), flush=True)
+
+    def conv_loss(w, x, y):
+        h = jax.lax.conv_general_dilated(
+            x, w, (1, 1), "SAME",
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))
+        return jnp.mean((h - y) ** 2)
+
+    def train_step(w, x, y):
+        def body(w, x, y):
+            loss, g = jax.value_and_grad(conv_loss)(w, x, y)
+            g = jax.lax.pmean(g, "workers")
+            return w - 0.01 * g, loss[None]
+        w2, loss = jax.shard_map(body, mesh=mesh,
+                                 in_specs=(P(), P("workers"), P("workers")),
+                                 out_specs=(P(), P("workers")))(w, x, y)
+        return w2, loss.mean()
+
+    w = jax.ShapeDtypeStruct((3, 3, 64, 64), jnp.bfloat16)
+    x = jax.ShapeDtypeStruct((32, 56, 56, 64), jnp.bfloat16)
+    y = jax.ShapeDtypeStruct((32, 56, 56, 64), jnp.bfloat16)
+    t0 = time.time()
+    lowered = jax.jit(train_step).lower(w, x, y)
+    print("lowered", round(time.time() - t0, 1), "s", flush=True)
+    t0 = time.time()
+    compiled = lowered.compile()
+    print("COMPILED conv train step for v5e on this host:",
+          round(time.time() - t0, 1), "s", flush=True)
+    from jax.experimental.serialize_executable import serialize
+    payload, _, _ = serialize(compiled)
+    print("serialized executable:", len(payload), "bytes", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
