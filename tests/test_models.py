@@ -76,3 +76,44 @@ def test_train_decreases_loss_alexnet_tiny():
         model.train_iter(i + 1, None)
         costs.append(float(np.asarray(model.current_info["cost"])))
     assert costs[-1] < costs[0], costs
+
+
+@pytest.mark.parametrize("n_workers", [1, 4])
+def test_resnet_bn_composes_with_steps_per_call(n_workers):
+    """Round-5 regression (found pre-hardware by the AOT compile of the
+    staged resnet50-*-spc8 rows): sync_bn's pmean returns worker-INVARIANT
+    BN stats, which mismatched the worker-varying scan carry under
+    steps_per_call > 1 — BN models never met spc>1 anywhere else
+    (AlexNet/GoogLeNet/VGG use LRN).  Must trace, run, and keep updating
+    BN stats on both a single-worker mesh (the real-TPU-row shape) and a
+    multi-worker mesh."""
+    mesh = worker_mesh(n_workers)
+    model = _build("theanompi_tpu.models.resnet50", "ResNet50", 8,
+                   mesh=mesh, size=n_workers, batch_size=2,
+                   steps_per_call=2, synthetic_batches=2)
+    model.compile_iter_fns(BSP_Exchanger(model.config))
+    model.data.shuffle_data(0)
+    model.train_iter(1, None)                   # steps 0 and 1, one call
+    assert np.isfinite(float(np.asarray(model.current_info["cost"])))
+    bn = jax.device_get(model.step_state["bn_state"])
+    means = [np.asarray(v) for k, v in
+             jax.tree_util.tree_flatten_with_path(bn)[0]
+             if "mean" in str(k[-1])]
+    assert any((m != 0).any() for m in means)
+
+
+def test_resnet_bn_trains_under_async_rules():
+    """Round-5 review regression: the async rules' sync_bn is the
+    identity (replicas diverge on purpose), so their BN stats reach
+    _revary_bn already worker-varying — the re-mark must be idempotent,
+    not crash with pcast varying->varying.  (Rule tests elsewhere use the
+    BN-free TinyModel, which is how this stayed latent.)"""
+    from theanompi_tpu.parallel.exchanger import get_exchanger
+    mesh = worker_mesh(4)
+    model = _build("theanompi_tpu.models.resnet50", "ResNet50", 8,
+                   mesh=mesh, size=4, batch_size=2)
+    cfg = dict(model.config)
+    model.compile_iter_fns(get_exchanger("gosgd", cfg))
+    model.data.shuffle_data(0)
+    model.train_iter(0, None)
+    assert np.isfinite(float(np.asarray(model.current_info["cost"])))

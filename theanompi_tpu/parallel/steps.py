@@ -180,11 +180,32 @@ def local_param_template(params, pspecs, mesh: Mesh):
 
 def _vary(x, axis: str):
     """Mark a replicated value as device-varying for shard_map's vma type
-    system (scan carries that accumulate per-worker values need this)."""
+    system (scan carries that accumulate per-worker values need this).
+    Idempotent: an already-varying value passes through — pcast raises on
+    varying→varying, and callers like _revary_bn see either (the async
+    rules' sync_bn is the identity, so their BN stats arrive varying;
+    BSP's pmean'd stats arrive invariant)."""
+    vma = getattr(jax.typeof(x), "vma", None) if hasattr(jax, "typeof") \
+        else None
+    if vma is not None and axis in vma:
+        return x
     try:
         return lax.pcast(x, (axis,), to="varying")
     except (AttributeError, TypeError):
         return lax.pvary(x, (axis,))
+
+
+def _revary_bn(bn_state, axis: str):
+    """Re-mark synced BN stats as worker-varying.  ``sync_bn``'s pmean
+    returns worker-INVARIANT values (the whole point — replicas stay in
+    lockstep), but the boxed state carry is worker-varying by type: under
+    ``steps_per_call > 1`` the ``lax.scan`` carry would mismatch
+    (``float32[...]{V:workers}`` in, plain ``float32[...]`` out) and
+    refuse to trace — found pre-hardware by the round-5 AOT compile of
+    the staged ``resnet50-*-spc8`` rows (BN models never met spc>1
+    anywhere else: AlexNet/GoogLeNet/VGG use LRN).  The cast is
+    type-level only; values are identical on every worker."""
+    return jax.tree.map(lambda x: _vary(x, axis), bn_state)
 
 
 def _accumulate_grads(loss_and_metrics: Callable, params, bn_state, batch,
@@ -276,7 +297,8 @@ def build_train_step(mesh: Mesh, model, exchanger, n_steps: int = 1) -> Callable
         g_chunk = fsdp.clip_chunk(
             g_chunk, float(model.config.get("grad_clip", 0.0) or 0.0), axis)
         new_chunk, new_opt = model.opt.update(g_chunk, opt_state, chunk, lr)
-        new_bn = exchanger.sync_bn(new_bn, axis=axis, size=n)
+        new_bn = _revary_bn(exchanger.sync_bn(new_bn, axis=axis, size=n),
+                            axis)
         new_state = {
             "params": box(new_chunk),
             "opt_state": box(new_opt),
@@ -311,7 +333,8 @@ def build_train_step(mesh: Mesh, model, exchanger, n_steps: int = 1) -> Callable
             new_params, new_opt = pu(params, opt_state, new_params, new_opt,
                                      count)
         params, opt_state = new_params, new_opt
-        new_bn = exchanger.sync_bn(new_bn, axis=axis, size=n)
+        new_bn = _revary_bn(exchanger.sync_bn(new_bn, axis=axis, size=n),
+                            axis)
 
         new_state = {
             "params": box(params),
