@@ -255,17 +255,56 @@ def _run_inner(run_timeout: float, force_cpu: bool) -> tuple[int, str, str]:
         env["BENCH_FORCE_CPU"] = "1"
     proc = None
 
+    def _inner_children():
+        """Pids of the inner-measurement child when a signal lands DURING
+        the Popen call itself (child live, ``proc`` not yet bound; round-5
+        ADVICE).  Post-exec children carry BENCH_INNER=1 in
+        /proc/<pid>/environ; a child caught between fork and exec still
+        shows the PARENT's environ, so when the environ filter finds
+        nothing, fall back to ppid alone — inside ``_run_inner`` the
+        wrapper's only live child IS the inner (probes run before/after,
+        never concurrently).  /proc scan, linux-only; [] elsewhere."""
+        matched, children = [], []
+        try:
+            for ent in os.listdir("/proc"):
+                if not ent.isdigit():
+                    continue
+                try:
+                    with open(f"/proc/{ent}/stat", "rb") as f:
+                        # "pid (comm) state ppid ..." — comm may hold spaces
+                        ppid = int(f.read().rsplit(b") ", 1)[1].split()[1])
+                    if ppid != os.getpid():
+                        continue
+                    children.append(int(ent))
+                    with open(f"/proc/{ent}/environ", "rb") as f:
+                        if b"BENCH_INNER=1" in f.read():
+                            matched.append(int(ent))
+                except (OSError, ValueError, IndexError):
+                    continue
+        except OSError:
+            pass
+        return matched or children
+
     def _reap(signum, frame):
         # the wrapper itself being TERM'd (an outer `timeout`, a watcher
         # restart) must not orphan the detached inner session — a leaked
         # 100%-CPU inner on this 1-core box poisons every later
         # measurement (observed round 5).  Handlers are installed BEFORE
-        # the Popen (no-op while proc is None) so there is no window
-        # where a signal can still orphan the inner.
-        if proc is not None:
+        # the Popen; if the signal lands mid-Popen (child live, ``proc``
+        # still None) the /proc scan finds the BENCH_INNER child anyway.
+        targets = [proc.pid] if proc is not None else _inner_children()
+        for pid in targets:
             try:
-                os.killpg(proc.pid, signal.SIGKILL)
+                os.killpg(pid, signal.SIGKILL)
             except ProcessLookupError:
+                # forked but not yet setsid'd: no own pgroup yet — kill
+                # the pid directly (it shares OUR pgroup, killpg on it
+                # would take the wrapper down with an uncontrolled signal)
+                try:
+                    os.kill(pid, signal.SIGKILL)
+                except ProcessLookupError:
+                    pass
+            except PermissionError:
                 pass
         raise SystemExit(128 + signum)
 
@@ -463,7 +502,9 @@ def main() -> int:
         # multi-step dispatch (BASELINE.md round-3 analysis) — opt-in:
         # measured faster on TPU where host dispatch dominates, but the CPU
         # sim shows the opposite, so the default stays 1 until the TPU
-        # numbers justify flipping it (scripts/perf_matrix.sh probes it)
+        # numbers justify flipping it (scripts/perf_matrix.sh probes it).
+        # Valid for every rule: async-rule rows (easgd-spcK / gosgd-spcK)
+        # fuse their exchange cadence into the scanned dispatch
         config["steps_per_call"] = int(os.environ["BENCH_SPC"])
     if os.environ.get("BENCH_BN_DTYPE"):
         config["bn_norm_dtype"] = os.environ["BENCH_BN_DTYPE"]
@@ -537,9 +578,16 @@ def main() -> int:
                 load_wait[0] += time.time() - t0   # consumer BLOCKED on the
             else:                                  # producer = overlap gap
                 b = dev_batch
+            # stride the count exactly like the worker loop (1-based,
+            # count += spc before the dispatch): the fused in-scan cadence
+            # (easgd-spcK / gosgd-spcK rows) fires at its true rate, and
+            # the spc=1 rows fire at the SAME phase — no extra step-0
+            # exchange skewing the spc1-vs-spcK comparison
+            c = (i + 1) * spc
             model.step_state, cost, err = train_fn(
-                model.step_state, b, lr, rng, jnp.int32(i))
-            exchanger.exchange(None, i)  # rule cadence (no-op for BSP grads)
+                model.step_state, b, lr, rng, jnp.int32(c))
+            exchanger.exchange(None, c)  # rule cadence (no-op when fused
+            #                              in-scan or for BSP grads)
 
         def drain():
             # block on the state, not the cost: the last exchange collective

@@ -41,6 +41,7 @@ import jax                                          # noqa: E402
 import jax.numpy as jnp                             # noqa: E402
 from jax.experimental import topologies             # noqa: E402
 from jax.sharding import Mesh, PartitionSpec as P   # noqa: E402
+from theanompi_tpu.jax_compat import shard_map as _shard_map  # noqa: E402
 
 
 def main() -> int:
@@ -60,7 +61,7 @@ def main() -> int:
             loss, g = jax.value_and_grad(conv_loss)(w, x, y)
             g = jax.lax.pmean(g, "workers")
             return w - 0.01 * g, loss[None]
-        w2, loss = jax.shard_map(body, mesh=mesh,
+        w2, loss = _shard_map(body, mesh=mesh,
                                  in_specs=(P(), P("workers"), P("workers")),
                                  out_specs=(P(), P("workers")))(w, x, y)
         return w2, loss.mean()

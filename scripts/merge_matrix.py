@@ -44,7 +44,10 @@ def _rank(row: dict, voided: dict, cfg: str) -> int:
     (1) > plain null (0).  The tombstone outranks degraded readings so a
     merged-in old backup still holding the original untagged value can't
     resurrect it; a non-null row whose value matches the config's
-    tombstoned reading is classified degraded even when untagged."""
+    tombstoned reading is classified degraded even when untagged —
+    UNLESS the row carries a ``ts`` newer than the tombstone's (a genuine
+    healthy re-measure can coincide with the voided reading; round-5
+    ADVICE), and the demotion is always logged so it is never silent."""
     res = row.get("result")
     if res is None:
         return 2 if _is_degraded(row) else 0
@@ -52,10 +55,18 @@ def _rank(row: dict, voided: dict, cfg: str) -> int:
         return 0          # foreign/hand-edited row — never canonical
     if _is_degraded(row):
         return 1
-    vv = voided.get(cfg)
+    tomb = voided.get(cfg)
     val = res.get("value")
-    if vv is not None and val is not None and \
-            abs(float(val) - float(vv)) < 1e-6:
+    if tomb is not None and val is not None and \
+            abs(float(val) - float(tomb["value"])) < 1e-6:
+        ts, tomb_ts = row.get("ts"), tomb.get("ts")
+        if ts is not None and tomb_ts is not None and \
+                float(ts) > float(tomb_ts):
+            return 3      # re-measured after the voiding — trust it
+        print(f"merge_matrix: {cfg} non-null value {val} matches the "
+              f"tombstoned voided_value — demoting to degraded (a genuine "
+              f"re-measure should carry a 'ts' newer than the tombstone's)",
+              file=sys.stderr)
         return 1
     return 3
 
@@ -63,7 +74,8 @@ def _rank(row: dict, voided: dict, cfg: str) -> int:
 def merge(paths: list[str]) -> None:
     order: list[str] = []
     best: dict[str, dict] = {}
-    voided: dict[str, float] = {}   # config -> tombstoned reading
+    # config -> {"value": tombstoned reading, "ts": tombstone timestamp}
+    voided: dict[str, dict] = {}
     for path in paths:              # first sweep: collect tombstones
         with open(path) as f:
             for line in f:
@@ -73,7 +85,18 @@ def merge(paths: list[str]) -> None:
                     continue
                 if isinstance(row, dict) and _is_degraded(row) and \
                         row.get("voided_value") is not None:
-                    voided[row.get("config", "")] = row["voided_value"]
+                    cfg = row.get("config", "")
+                    new = {"value": row["voided_value"],
+                           "ts": row.get("ts")}
+                    old = voided.get(cfg)
+                    # the NEWEST tombstone governs (a stamped one beats an
+                    # unstamped one): last-file-wins here would let an old
+                    # backup's earlier tombstone re-open the ts window and
+                    # resurrect the very reading the newer tombstone voids
+                    if old is None or old.get("ts") is None or \
+                            (new["ts"] is not None and
+                             float(new["ts"]) >= float(old["ts"])):
+                        voided[cfg] = new
     for path in paths:
         with open(path) as f:
             for line in f:

@@ -1,0 +1,35 @@
+#!/usr/bin/env bash
+# Round-6 perf matrix — the fused-cadence rows (ISSUE 1 tentpole): the
+# async rules' exchange now fuses into the steps_per_call scan
+# (lax.cond on the in-scan count), so EASGD/ASGD/GoSGD and BSP params
+# mode can ride the multi-step dispatch that round-3 profiling showed
+# recovers ~26% host-dispatch overhead.  This script stages the A/B that
+# quantifies it on the real chip: each staged rule config (BASELINE.json
+# pairs VGG-16 with EASGD and ResNet-50 with GoSGD) at spc=1 (already in
+# r5) vs spc=4/spc=8 with the cadence in-scan.  Rows already measured in
+# the out-file are skipped, so the script is re-runnable after a tunnel
+# wedge (same convention as perf_matrix_r5.sh).
+#   ./scripts/perf_matrix_r6.sh [out_file]
+set -u -o pipefail
+OUT="${1:-perf_matrix_r6.jsonl}"
+cd "$(dirname "$0")/.."
+. scripts/_bench_row.sh
+
+# cheap canary first: proves the fused-cadence compile path works on the
+# chip at all before the big VGG/ResNet scans are attempted
+run cifar10-b128-easgd-spc4   BENCH_MODEL=cifar10  BENCH_RULE=easgd BENCH_SPC=4
+
+# -- the acceptance rows: staged async rules with the cadence in-scan --
+run vgg16-b32-easgd-spc8      BENCH_MODEL=vgg16    BENCH_RULE=easgd BENCH_SPC=8 BENCH_SYNTH_BATCHES=8
+run resnet50-b32-gosgd-spc8   BENCH_MODEL=resnet50 BENCH_RULE=gosgd BENCH_SPC=8 BENCH_SYNTH_BATCHES=8
+
+# -- spc scaling shape for the same configs (is spc=4 already enough?) --
+run vgg16-b32-easgd-spc4      BENCH_MODEL=vgg16    BENCH_RULE=easgd BENCH_SPC=4
+run resnet50-b32-gosgd-spc4   BENCH_MODEL=resnet50 BENCH_RULE=gosgd BENCH_SPC=4
+
+# -- the remaining fused rules, on the flagship model --
+run alexnet-b128-asgd-spc8    BENCH_MODEL=alexnet  BENCH_RULE=asgd  BENCH_SPC=8 BENCH_SYNTH_BATCHES=8
+run alexnet-b128-gosgd-spc8   BENCH_MODEL=alexnet  BENCH_RULE=gosgd BENCH_SPC=8 BENCH_SYNTH_BATCHES=8
+
+python scripts/merge_matrix.py "$OUT"
+cat "$OUT"

@@ -1,0 +1,8 @@
+#!/usr/bin/env bash
+# The ROADMAP tier-1 verify gate, wrapped verbatim so the builder and the
+# reviewer run the SAME command (one place to keep the pytest flags, the
+# timeout, and the DOTS_PASSED accounting in sync).
+#   scripts/tier1.sh
+# Exits with pytest's return code; prints DOTS_PASSED=<n> as the last line.
+cd "$(dirname "$0")/.."
+set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=${PIPESTATUS[0]}; echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c); exit $rc

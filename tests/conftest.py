@@ -20,6 +20,12 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 
+# NOTE: do NOT enable the persistent XLA compile cache here (the lever
+# bench.py pulls) — on this container's jax/CPU backend, serializing the
+# big 8-device shard_map executables SEGFAULTS the whole pytest process
+# (observed round 6, test_3d_mesh).  bench.py's use is unaffected (its
+# inner runs in a disposable subprocess and targets the TPU plugin).
+
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 

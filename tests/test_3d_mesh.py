@@ -127,3 +127,7 @@ def test_4axis_tp_pp_sp_matches_dense(mesh8):
     m4.begin_val()
     m4.val_iter(0)
     m4.end_val()
+
+# excluded from the 870s-budgeted tier-1 gate; see pytest.ini (slow marker)
+import pytest as _pytest
+pytestmark = _pytest.mark.slow

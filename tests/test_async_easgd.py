@@ -119,3 +119,7 @@ def test_async_easgd_trains():
         return float(L.softmax_cross_entropy(logits, jnp.asarray(b["y"])))
 
     assert loss(tr.center_params) < loss(p0)
+
+# excluded from the 870s-budgeted tier-1 gate; see pytest.ini (slow marker)
+import pytest as _pytest
+pytestmark = _pytest.mark.slow

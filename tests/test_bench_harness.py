@@ -308,3 +308,69 @@ def test_wrapper_sigterm_reaps_detached_inner():
                 os.killpg(inner, signal.SIGKILL)
             except (ProcessLookupError, PermissionError):
                 pass
+
+
+def test_merge_matrix_value_match_demotion_logged_and_ts_gated(tmp_path,
+                                                               capsys):
+    """Round-5 ADVICE: a healthy re-measure that coincidentally reproduces
+    a tombstoned reading must not be silently discarded — the demotion is
+    logged, and a row whose ``ts`` postdates the tombstone's survives."""
+    p = tmp_path / "m.jsonl"
+    tomb = {"config": "a", "result": None, "ts": 100.0,
+            "note": "voided: degraded window", "voided_value": 6333.91}
+    same_no_ts = {"config": "a", "result": {"metric": "m", "value": 6333.91}}
+    p.write_text(json.dumps(tomb) + "\n" + json.dumps(same_no_ts) + "\n")
+    merge_matrix.merge([str(p)])
+    out = [json.loads(l) for l in p.read_text().splitlines()]
+    assert out[0]["result"] is None          # demoted: tombstone wins...
+    err = capsys.readouterr().err
+    assert "matches the tombstoned" in err   # ...but never silently
+    # a value-matching row STAMPED newer than the tombstone is a genuine
+    # healthy re-measure — it supersedes
+    newer = {"config": "a", "ts": 200.0,
+             "result": {"metric": "m", "value": 6333.91}}
+    p.write_text(json.dumps(tomb) + "\n" + json.dumps(newer) + "\n")
+    merge_matrix.merge([str(p)])
+    out = [json.loads(l) for l in p.read_text().splitlines()]
+    assert out[0]["result"]["value"] == 6333.91
+
+
+def test_powersgd_wire_bytes_uses_real_factorization():
+    """Round-5 ADVICE (medium): the wire model must follow PowerSGD's own
+    [prod(shape[:-1]), shape[-1]] per-leaf factorization gated by
+    _compressible, plus a dense psum term for the rejected leaves — for
+    vgg16 the corrected rows+cols is ~80k, ~60x below the old
+    shape[0]+size//shape[0] figure that overstated the wire."""
+    from scripts.predict_scaling import wire_bytes
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    counts = json.load(open(os.path.join(repo, "model_param_counts.json")))
+    vgg = counts["vgg16"]
+    assert 60_000 < vgg["rows_plus_cols"] < 120_000, vgg
+    assert vgg["powersgd_dense"] > 0
+    wb = wire_bytes("powersgd4", vgg["params"], vgg["rows_plus_cols"], 8,
+                    vgg["powersgd_dense"])
+    ring = 2.0 * 7 / 8
+    assert wb == ring * (4 * vgg["rows_plus_cols"]
+                         + vgg["powersgd_dense"]) * 4
+    # and it stays far below both the dense allreduce and the old estimate
+    assert wb < 0.05 * wire_bytes("allreduce", vgg["params"], 0, 8)
+
+
+def test_merge_matrix_newest_tombstone_governs(tmp_path, capsys):
+    """An old backup's EARLIER tombstone for the same config must not
+    re-open the ts window: the newest tombstone governs, so a reading
+    voided by it (ts between the two tombstones) stays demoted."""
+    main = tmp_path / "m.jsonl"
+    backup = tmp_path / "old.jsonl"
+    tomb_new = {"config": "a", "result": None, "ts": 200.0,
+                "note": "voided: degraded window", "voided_value": 6333.91}
+    tomb_old = {"config": "a", "result": None, "ts": 100.0,
+                "note": "voided: degraded window", "voided_value": 6333.91}
+    voided_reading = {"config": "a", "ts": 150.0,
+                      "result": {"metric": "m", "value": 6333.91}}
+    main.write_text(json.dumps(tomb_new) + "\n"
+                    + json.dumps(voided_reading) + "\n")
+    backup.write_text(json.dumps(tomb_old) + "\n")
+    merge_matrix.merge([str(main), str(backup)])
+    out = [json.loads(l) for l in main.read_text().splitlines()]
+    assert out[0]["result"] is None      # ts=150 reading stays voided

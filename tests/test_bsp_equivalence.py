@@ -166,16 +166,32 @@ def test_steps_per_call_with_para_load_across_epochs():
     assert np.isfinite(float(np.asarray(model.current_info["cost"])))
 
 
-def test_steps_per_call_rejects_post_step_exchanges():
-    """Multi-step dispatch would skip the Python-side exchange cadence —
-    must be refused for anything but fused BSP grads mode."""
-    from theanompi_tpu.parallel.exchanger import EASGD_Exchanger
+def test_steps_per_call_accepts_every_rule():
+    """Multi-step dispatch is no longer BSP-grads-only: rules with a
+    post-step collective get their cadence fused INTO the scanned step
+    (ISSUE 1 tentpole) — compile_iter_fns accepts them and flags the
+    exchanger so the Python hook knows to stand down."""
+    from theanompi_tpu.parallel.exchanger import (ASGD_Exchanger,
+                                                  BSP_Exchanger,
+                                                  EASGD_Exchanger,
+                                                  GOSGD_Exchanger)
     mesh = worker_mesh(4)
+    for cls, cfg in ((EASGD_Exchanger, {}), (ASGD_Exchanger, {}),
+                     (GOSGD_Exchanger, {}),
+                     (BSP_Exchanger, {"exch_mode": "params"})):
+        config = {"mesh": mesh, "size": 4, "rank": 0, "verbose": False,
+                  "batch_size": 8, "steps_per_call": 2, **cfg}
+        model = TinyModel(config)
+        exch = cls(config)
+        model.compile_iter_fns(exch)          # must not raise
+        assert exch.fused, cls.__name__
+    # BSP grads mode has no post-step hook — nothing to fuse, flag stays off
     config = {"mesh": mesh, "size": 4, "rank": 0, "verbose": False,
               "batch_size": 8, "steps_per_call": 2}
     model = TinyModel(config)
-    with pytest.raises(AssertionError, match="fused exchange"):
-        model.compile_iter_fns(EASGD_Exchanger(config))
+    exch = BSP_Exchanger(config)
+    model.compile_iter_fns(exch)
+    assert not exch.fused
 
 
 def test_training_reduces_loss():

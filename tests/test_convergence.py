@@ -15,6 +15,11 @@ stops learning.
 
 Deselected by default (~12 min of CPU-sim training):
     python -m pytest tests/test_convergence.py -m convergence -q
+
+Also marked ``slow``: the tier-1 gate's ``-m 'not slow'`` OVERRIDES the
+ini's ``-m 'not convergence'`` (last -m wins in pytest), so without the
+second marker these 12 minutes of training would silently re-enter the
+870s-budgeted gate and starve it.
 """
 
 import numpy as np
@@ -25,6 +30,7 @@ import theanompi_tpu as tmpi
 GATE_ACC = 0.90
 
 
+@pytest.mark.slow
 @pytest.mark.convergence
 @pytest.mark.parametrize("rule_name,epochs,extra", [
     ("BSP", 5, {}),

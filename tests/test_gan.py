@@ -188,3 +188,7 @@ def test_wgan_rejects_ema_plus_zero_opt():
     unclipped critic shadow silently."""
     with pytest.raises(AssertionError, match="EMA shadow"):
         _build("WGAN", ema_decay=0.99, zero_opt=True)
+
+# excluded from the 870s-budgeted tier-1 gate; see pytest.ini (slow marker)
+import pytest as _pytest
+pytestmark = _pytest.mark.slow

@@ -134,3 +134,7 @@ def test_generate_from_model_parallel_layouts(mesh8):
     assert "blocks" in fresh.params
     from theanompi_tpu.parallel.exchanger import BSP_Exchanger
     fresh.compile_iter_fns(BSP_Exchanger(fresh.config))
+
+# excluded from the 870s-budgeted tier-1 gate; see pytest.ini (slow marker)
+import pytest as _pytest
+pytestmark = _pytest.mark.slow

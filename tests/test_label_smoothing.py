@@ -8,6 +8,7 @@ import jax
 import jax.numpy as jnp
 
 from theanompi_tpu.models import layers as L
+from theanompi_tpu.jax_compat import shard_map
 
 
 def _oracle(logits, labels, eps):
@@ -41,7 +42,7 @@ def test_tp_smoothing_matches_dense(mesh8):
     r = np.random.RandomState(1)
     logits = jnp.asarray(r.randn(16, 32).astype(np.float32) * 2)
     labels = jnp.asarray(r.randint(0, 32, 16).astype(np.int32))
-    sm = jax.jit(jax.shard_map(
+    sm = jax.jit(shard_map(
         lambda lg, lb: tplib.tp_softmax_cross_entropy(
             lg, lb, label_smoothing=0.2),
         mesh=mesh, in_specs=(P(None, MODEL_AXIS), P()), out_specs=P()))

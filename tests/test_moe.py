@@ -16,6 +16,7 @@ from theanompi_tpu.models.transformer_lm import MoETransformerLM
 from theanompi_tpu.parallel.exchanger import BSP_Exchanger
 from theanompi_tpu.parallel.mesh import MODEL_AXIS, worker_mesh
 from theanompi_tpu.parallel.moe import MoE
+from theanompi_tpu.jax_compat import shard_map
 
 CFG = dict(verbose=False, batch_size=8, seq_len=16, vocab=32,
            synthetic_train=64, synthetic_val=32,
@@ -197,7 +198,7 @@ def test_moe_sp_a2a_layer_exact_vs_dense(mesh8):
         y, _aux = sp.apply(p, xb, train=True)
         return y
 
-    f = jax.jit(jax.shard_map(
+    f = jax.jit(shard_map(
         body, mesh=mesh, in_specs=(pspec, P("workers", "seq", None)),
         out_specs=P("workers", "seq", None)))
     pp = {k: jax.device_put(params[k], NamedSharding(mesh, pspec[k]))
@@ -336,3 +337,7 @@ def test_moe_top2_lm_trains_and_composes_with_ep(mesh8):
         costs = _train_steps(m, 5)
         assert np.isfinite(costs).all()
         assert np.mean(costs[-2:]) < np.mean(costs[:2])
+
+# excluded from the 870s-budgeted tier-1 gate; see pytest.ini (slow marker)
+import pytest as _pytest
+pytestmark = _pytest.mark.slow

@@ -246,3 +246,7 @@ def test_two_process_compressed_wire_matches_oracle():
     from tests.twoproc_model import fingerprint_after_steps_onebit
     _run_twoproc_and_compare("onebit",
                              fingerprint_after_steps_onebit(n_workers=4))
+
+# excluded from the 870s-budgeted tier-1 gate; see pytest.ini (slow marker)
+import pytest as _pytest
+pytestmark = _pytest.mark.slow

@@ -99,3 +99,7 @@ def test_prng_impl_config_applies():
         assert jax.config.jax_default_prng_impl == "rbg"
     finally:
         jax.config.update("jax_default_prng_impl", old)
+
+# excluded from the 870s-budgeted tier-1 gate; see pytest.ini (slow marker)
+import pytest as _pytest
+pytestmark = _pytest.mark.slow

@@ -17,6 +17,7 @@ from theanompi_tpu.ops import compress
 from theanompi_tpu.parallel.mesh import WORKER_AXIS, worker_local_sharding
 from theanompi_tpu.parallel import strategies
 from theanompi_tpu.parallel.strategies import get_strategy
+from theanompi_tpu.jax_compat import shard_map
 
 N = 8
 
@@ -31,7 +32,7 @@ def _run_strategy(mesh, strat, per_worker_trees, state_boxed=None):
         out, new_state = strat(tree, state, axis=WORKER_AXIS, size=N)
         return steps.box(out), steps.box(new_state)
 
-    sm = jax.jit(jax.shard_map(
+    sm = jax.jit(shard_map(
         body, mesh=mesh,
         in_specs=(P(WORKER_AXIS), P(WORKER_AXIS)),
         out_specs=(P(WORKER_AXIS), P(WORKER_AXIS))))

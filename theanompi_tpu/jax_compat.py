@@ -1,0 +1,27 @@
+"""Version-portability shim for ``shard_map``.
+
+The framework is written against current jax (``jax.shard_map`` with the
+vma varying-axes type system).  The container this repo grows in may pin
+an older release (observed: 0.4.37) where shard_map still lives in
+``jax.experimental.shard_map`` and replication is tracked by the legacy
+``check_rep`` pass instead of vma.  Every shard_map call site goes
+through this one shim so the SPMD machinery imports and runs on both.
+
+On the legacy path ``check_rep=False``: the old replication checker
+predates the vma typing this code is written for (per-worker varying
+scan carries, ``steps.anchor_invariant``) and rejects valid programs
+here; on current jax the vma system supersedes it anyway.
+"""
+
+from __future__ import annotations
+
+import jax
+
+
+def shard_map(f, *, mesh, in_specs, out_specs):
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs)
+    from jax.experimental.shard_map import shard_map as _sm
+    return _sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+               check_rep=False)

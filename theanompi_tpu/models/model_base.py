@@ -20,6 +20,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..jax_compat import shard_map
 from ..parallel import steps
 from ..parallel.mesh import WORKER_AXIS, worker_mesh
 from ..utils import checkpoint as ckpt_lib
@@ -36,7 +37,8 @@ class ModelBase:
     batch_size: int = 128          # per-worker, as in the reference
     epochs: int = 60
     n_subb: int = 1                # sub-batches per comm step (grad accum)
-    steps_per_call: int = 1        # full steps per dispatch (BSP grads only)
+    steps_per_call: int = 1        # full steps per dispatch (any rule —
+                                   # cadenced exchanges fuse into the scan)
     learning_rate: float = 0.01
     momentum: float = 0.9
     weight_decay: float = 0.0001
@@ -336,13 +338,29 @@ class ModelBase:
             self.step_state["params"] = steps.place_boxed(
                 self._fsdp.chunk_host(self.params), self.mesh)
         spc = int(self.steps_per_call)
+        # multi-step dispatch fuses the exchange cadence INTO the scanned
+        # step for every rule with a post-step collective (EASGD/ASGD/
+        # GoSGD, BSP params mode — build_train_step wraps exchange_body in
+        # lax.cond on the in-scan count); BSP grads mode has no post-step
+        # hook to begin with.  The worker-loop Python exchange() must then
+        # not run the collective a second time: exchange() no-ops while
+        # exchanger.fused is set.  Assigned UNCONDITIONALLY so a recompile
+        # back to spc=1 clears a stale flag (which would silently disable
+        # the rule's exchanges outright).
+        self.exchanger.fused = spc > 1 and self.exchanger.has_exchange()
         if spc > 1:
-            # multi-step dispatch skips the between-steps Python exchange
-            # hook — only legal when the exchange is fused into the step
-            assert self.exchanger._exchange_fn is None, (
-                "steps_per_call > 1 requires a fused exchange "
-                "(BSP grads mode); post-step collectives have a cadence "
-                "the in-call scan would skip")
+            # fail-loud guard for out-of-tree exchangers still on the
+            # pre-round-6 pattern (jitting _exchange_fn directly in
+            # prepare() without declaring has_exchange): their cadence
+            # would neither fuse nor fire per-step from the spc-strided
+            # worker loop — silently undersampled exchanges
+            assert not (self.exchanger._exchange_fn is not None
+                        and not self.exchanger.has_exchange()), (
+                f"{type(self.exchanger).__name__} builds _exchange_fn but "
+                "has_exchange() is False — steps_per_call > 1 fuses the "
+                "cadence via exchange_body/has_exchange (see "
+                "Exchanger._build_exchange_fn); declare them or keep "
+                "steps_per_call=1")
             if self.data is not None:
                 assert spc <= self.data.n_batch_train, (
                     f"steps_per_call={spc} exceeds n_batch_train="
@@ -597,7 +615,7 @@ class ModelBase:
                             if not steps.spec_mentions(s, (a,)))),
                     pspecs, tree, is_leaf=steps._is_spec)
 
-            self._zero_shadow_jit = jax.jit(jax.shard_map(
+            self._zero_shadow_jit = jax.jit(shard_map(
                 body, mesh=self.mesh, in_specs=(state_spec,),
                 out_specs=out_specs))
         return self._zero_shadow_jit
@@ -623,7 +641,7 @@ class ModelBase:
                 tree = fsdp.gather_params(chunk)
                 return jax.tree.map(lambda v: v[None], tree)   # box/worker
 
-            self._fsdp_val_jit = jax.jit(jax.shard_map(
+            self._fsdp_val_jit = jax.jit(shard_map(
                 body, mesh=self.mesh, in_specs=(state_spec,),
                 out_specs=jax.tree.map(lambda _: P(WORKER_AXIS),
                                        self.params)))

@@ -29,6 +29,8 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ..jax_compat import shard_map as _shard_map
+
 NEG_INF = -1e30
 
 
@@ -109,7 +111,7 @@ def ring_attention_sharded(q, k, v, mesh: Mesh, *, axis: str,
     ``mesh`` (sequence dim) and run :func:`ring_attention` under
     ``shard_map``."""
     spec = P(None, None, axis, None)
-    fn = jax.shard_map(
+    fn = _shard_map(
         partial(ring_attention, axis=axis, causal=causal, scale=scale),
         mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
     sh = NamedSharding(mesh, spec)

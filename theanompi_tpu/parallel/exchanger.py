@@ -32,6 +32,15 @@ to its *synchronous-cadence* variant with the update algebra kept exact:
   weighted-average merge and the Σα invariant exactly.
 
 Exchange cost rides ICI inside compiled programs in all cases.
+
+**Fused cadence (round 6):** each rule's exchange algebra is one pure
+per-worker ``exchange_body(state, key, count)`` backing two dispatch
+shapes — the standalone jitted collective the worker loop calls between
+dispatches (``steps_per_call=1``), and, for ``steps_per_call > 1``, an
+in-scan ``lax.cond(count % exchange_freq == 0, exchange_body, identity)``
+inside the multi-step train dispatch (``steps.build_train_step``), so one
+XLA dispatch covers k full steps INCLUDING their cadenced exchanges.
+See docs/design.md §8 for the GoSGD traced-RNG contract.
 """
 
 from __future__ import annotations
@@ -46,6 +55,7 @@ from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
 from . import steps
+from ..jax_compat import shard_map
 from .mesh import WORKER_AXIS
 from .strategies import Strategy, get_strategy
 
@@ -71,8 +81,13 @@ class Exchanger:
       jit the exchange collective.
     * :meth:`step_update` — traced INSIDE the per-worker train step: apply
       grads locally, optionally reducing them first (BSP fused mode).
+    * :meth:`exchange_body` — the rule's exchange algebra as a PURE traced
+      per-worker function, reused by both the standalone collective and the
+      in-scan fused cadence (``steps_per_call > 1``).
     * :meth:`exchange` — Python-level cadence hook called by the worker loop
-      after each ``train_iter``; runs the rule's collective when due.
+      after each ``train_iter``; runs the rule's collective when due.  A
+      no-op when the cadence is fused into the multi-step dispatch
+      (``self.fused``, set by ``model_base.compile_iter_fns``).
     """
 
     name = "exchanger"
@@ -96,6 +111,10 @@ class Exchanger:
         self.mesh: Optional[Mesh] = None
         self.model = None
         self._exchange_fn = None
+        # True when compile_iter_fns fused this rule's cadence into the
+        # scanned multi-step train dispatch (steps_per_call > 1): the
+        # Python exchange() hook then must not run the collective again.
+        self.fused = False
 
     # -- wiring ------------------------------------------------------------
 
@@ -103,6 +122,39 @@ class Exchanger:
         self.mesh = mesh
         self.model = model
         self.size = mesh.shape[WORKER_AXIS]
+
+    def has_exchange(self) -> bool:
+        """True when the rule runs a post-step exchange collective (the
+        async rules always; BSP only in params mode).  False means the
+        whole rule already lives inside the train step (BSP grads mode)
+        and there is no cadence to fuse or hook."""
+        return False
+
+    def exchange_body(self, state, key, count):
+        """The rule's exchange algebra as a PURE per-worker function:
+        ``(boxed state dict, key, count) -> boxed state dict``, traced
+        inside ``shard_map`` over the worker axis (state leaves are the
+        local ``[1, ...]`` shards).  ONE definition serves both dispatch
+        shapes: the standalone jitted ``_exchange_fn`` (steps_per_call=1
+        and the session API) and the in-scan fused cadence that
+        ``steps.build_train_step`` wraps in ``lax.cond`` for
+        ``steps_per_call > 1``."""
+        raise NotImplementedError(
+            f"{type(self).__name__}.has_exchange() is True but no "
+            "exchange_body is defined")
+
+    def _build_exchange_fn(self) -> None:
+        """Jit :meth:`exchange_body` as the standalone whole-state
+        collective (kept even when the cadence is fused — checkpoint
+        tooling and the session API still call it for spc=1 runs)."""
+        if not self.has_exchange():
+            return
+        state_spec = steps.state_partition_specs(self.model, self,
+                                                 WORKER_AXIS)
+        sm = shard_map(self.exchange_body, mesh=self.mesh,
+                       in_specs=(state_spec, P(), P()),
+                       out_specs=state_spec)
+        self._exchange_fn = jax.jit(sm, donate_argnums=(0,))
 
     def extra_state_template(self) -> Dict[str, Any]:
         """Unboxed per-worker persistent state (error feedback, center, α...)."""
@@ -175,7 +227,8 @@ class Exchanger:
         return self._exchange_fn is not None and count % self.exchange_freq == 0
 
     def exchange(self, recorder=None, count: int = 0) -> None:
-        if not self.due(count):
+        if self.fused or not self.due(count):
+            # fused: the cadence already ran inside the multi-step dispatch
             return
         if recorder:
             recorder.start()
@@ -247,27 +300,25 @@ class BSP_Exchanger(Exchanger):
             return {"strat": P(group) if group else P()}
         return {}
 
+    def has_exchange(self) -> bool:
+        return self.mode == "params"
+
+    def exchange_body(self, state, key, count):
+        # reference-exact cadence: local update happened in step_update;
+        # here the strategy averages the PARAMETERS across workers
+        params = steps.unbox(state["params"])
+        extra = steps.unbox(state["extra"])
+        strat_state = extra.get("strat", ())
+        params, strat_state = self._strat_call(
+            params, strat_state, axis=WORKER_AXIS, size=self.size)
+        if "strat" in extra:
+            extra = dict(extra, strat=strat_state)
+        return dict(state, params=steps.box(params),
+                    extra=steps.box(extra))
+
     def prepare(self, mesh: Mesh, model) -> None:
         super().prepare(mesh, model)
-        if self.mode == "params":
-            axis, n = WORKER_AXIS, self.size
-            state_spec = steps.state_partition_specs(model, self, axis)
-
-            def body(state, key, count):
-                params = steps.unbox(state["params"])
-                extra = steps.unbox(state["extra"])
-                strat_state = extra.get("strat", ())
-                params, strat_state = self._strat_call(
-                    params, strat_state, axis=axis, size=n)
-                if "strat" in extra:
-                    extra = dict(extra, strat=strat_state)
-                return dict(state, params=steps.box(params),
-                            extra=steps.box(extra))
-
-            sm = jax.shard_map(body, mesh=mesh,
-                               in_specs=(state_spec, P(), P()),
-                               out_specs=state_spec)
-            self._exchange_fn = jax.jit(sm, donate_argnums=(0,))
+        self._build_exchange_fn()
 
     def extra_state_template(self) -> Dict[str, Any]:
         if self.strategy.stateful:
@@ -383,27 +434,26 @@ class EASGD_Exchanger(Exchanger):
         # the center is a params-shaped tree: same per-leaf layout
         return {"center": param_specs}
 
+    def has_exchange(self) -> bool:
+        return True
+
+    def exchange_body(self, state, key, count):
+        axis, alpha = WORKER_AXIS, self.alpha
+        params = steps.unbox(state["params"])
+        extra = steps.unbox(state["extra"])
+        center = extra["center"]
+        delta = jax.tree.map(lambda p, c: p - c, params, center)
+        mean_delta = jax.tree.map(lambda d: lax.pmean(d, axis), delta)
+        new_center = jax.tree.map(lambda c, d: c + alpha * d,
+                                  center, mean_delta)
+        new_params = jax.tree.map(lambda p, d: p - alpha * d, params, delta)
+        extra = dict(extra, center=new_center)
+        return dict(state, params=steps.box(new_params),
+                    extra=steps.box(extra))
+
     def prepare(self, mesh: Mesh, model) -> None:
         super().prepare(mesh, model)
-        axis, alpha = WORKER_AXIS, self.alpha
-        state_spec = steps.state_partition_specs(model, self, axis)
-
-        def body(state, key, count):
-            params = steps.unbox(state["params"])
-            extra = steps.unbox(state["extra"])
-            center = extra["center"]
-            delta = jax.tree.map(lambda p, c: p - c, params, center)
-            mean_delta = jax.tree.map(lambda d: lax.pmean(d, axis), delta)
-            new_center = jax.tree.map(lambda c, d: c + alpha * d,
-                                      center, mean_delta)
-            new_params = jax.tree.map(lambda p, d: p - alpha * d, params, delta)
-            extra = dict(extra, center=new_center)
-            return dict(state, params=steps.box(new_params),
-                        extra=steps.box(extra))
-
-        sm = jax.shard_map(body, mesh=mesh, in_specs=(state_spec, P(), P()),
-                           out_specs=state_spec)
-        self._exchange_fn = jax.jit(sm, donate_argnums=(0,))
+        self._build_exchange_fn()
 
     def canonical_params(self, state):
         """Validation/checkpoint read the CENTER (the reference validated
@@ -432,25 +482,24 @@ class ASGD_Exchanger(Exchanger):
     def extra_specs(self, param_specs):
         return {"center": param_specs}
 
+    def has_exchange(self) -> bool:
+        return True
+
+    def exchange_body(self, state, key, count):
+        axis = WORKER_AXIS
+        params = steps.unbox(state["params"])
+        extra = steps.unbox(state["extra"])
+        center = extra["center"]
+        delta_sum = jax.tree.map(
+            lambda p, c: lax.psum(p - c, axis), params, center)
+        new_center = jax.tree.map(jnp.add, center, delta_sum)
+        extra = dict(extra, center=new_center)
+        return dict(state, params=steps.box(new_center),
+                    extra=steps.box(extra))
+
     def prepare(self, mesh: Mesh, model) -> None:
         super().prepare(mesh, model)
-        axis = WORKER_AXIS
-        state_spec = steps.state_partition_specs(model, self, axis)
-
-        def body(state, key, count):
-            params = steps.unbox(state["params"])
-            extra = steps.unbox(state["extra"])
-            center = extra["center"]
-            delta_sum = jax.tree.map(
-                lambda p, c: lax.psum(p - c, axis), params, center)
-            new_center = jax.tree.map(jnp.add, center, delta_sum)
-            extra = dict(extra, center=new_center)
-            return dict(state, params=steps.box(new_center),
-                        extra=steps.box(extra))
-
-        sm = jax.shard_map(body, mesh=mesh, in_specs=(state_spec, P(), P()),
-                           out_specs=state_spec)
-        self._exchange_fn = jax.jit(sm, donate_argnums=(0,))
+        self._build_exchange_fn()
 
     def canonical_params(self, state):
         return steps.unbox(state["extra"])["center"]
@@ -567,10 +616,12 @@ class GOSGD_Exchanger(Exchanger):
             rounds[r].append((i, int(d)))
         return rounds
 
+    def has_exchange(self) -> bool:
+        return True
+
     def prepare(self, mesh: Mesh, model) -> None:
         super().prepare(mesh, model)
-        axis, n, p_share = WORKER_AXIS, self.size, self.p_share
-        state_spec = steps.state_partition_specs(model, self, axis)
+        axis, n = WORKER_AXIS, self.size
         n_bits = max(1, int(np.ceil(np.log2(max(n, 2)))))
         if self.peers_mode == "perm":
             perms = self._derangements(n, self.n_perms,
@@ -638,34 +689,42 @@ class GOSGD_Exchanger(Exchanger):
 
             return lax.switch(kidx, [mk(d) for d in iid_maps], payload)
 
-        def body(state, key, count):
-            params = steps.unbox(state["params"])
-            extra = steps.unbox(state["extra"])
-            alpha = extra["alpha"]
-            ridx = lax.axis_index(axis)
-            step_key = jax.random.fold_in(key, count)
-            # Per-worker Bernoulli send gate
-            send = jax.random.bernoulli(
-                jax.random.fold_in(step_key, ridx), p_share)
-            w_send = jnp.where(send, alpha * 0.5, 0.0)
-            w_keep = alpha - w_send
-            msg = jax.tree.map(lambda p: p * w_send, params)
-            payload = (msg, w_send)
-            route = {"perm": route_perm, "shift": route_shift,
-                     "iid": route_iid}[mode]
-            payload = route(payload, step_key)
-            recv_msg, w_recv = payload
+        # routing tables are static per (mesh size, mode, family seed) —
+        # pre-built here so exchange_body stays a pure traced function
+        # whichever dispatch shape (standalone / in-scan fused) traces it
+        self._route = {"perm": route_perm, "shift": route_shift,
+                       "iid": route_iid}[mode]
+        self._build_exchange_fn()
 
-            new_alpha = w_keep + w_recv
-            new_params = jax.tree.map(
-                lambda p, m: (w_keep * p + m) / new_alpha, params, recv_msg)
-            extra = dict(extra, alpha=new_alpha)
-            return dict(state, params=steps.box(new_params),
-                        extra=steps.box(extra))
+    def exchange_body(self, state, key, count):
+        """Gossip draw contract: every random choice (Bernoulli send gate,
+        routing pick) derives from ``fold_in(key, count)`` — a TRACED
+        function of the base key and the step count, so the fused in-scan
+        cadence (which passes one base key per k-step dispatch,
+        ``steps.fused_exchange_key``) draws exactly like k standalone
+        calls handed the same base key."""
+        axis = WORKER_AXIS
+        params = steps.unbox(state["params"])
+        extra = steps.unbox(state["extra"])
+        alpha = extra["alpha"]
+        ridx = lax.axis_index(axis)
+        step_key = jax.random.fold_in(key, count)
+        # Per-worker Bernoulli send gate
+        send = jax.random.bernoulli(
+            jax.random.fold_in(step_key, ridx), self.p_share)
+        w_send = jnp.where(send, alpha * 0.5, 0.0)
+        w_keep = alpha - w_send
+        msg = jax.tree.map(lambda p: p * w_send, params)
+        payload = (msg, w_send)
+        payload = self._route(payload, step_key)
+        recv_msg, w_recv = payload
 
-        sm = jax.shard_map(body, mesh=mesh, in_specs=(state_spec, P(), P()),
-                           out_specs=state_spec)
-        self._exchange_fn = jax.jit(sm, donate_argnums=(0,))
+        new_alpha = w_keep + w_recv
+        new_params = jax.tree.map(
+            lambda p, m: (w_keep * p + m) / new_alpha, params, recv_msg)
+        extra = dict(extra, alpha=new_alpha)
+        return dict(state, params=steps.box(new_params),
+                    extra=steps.box(extra))
 
     def canonical_params(self, state):
         """Consensus estimate: the α-weighted average of worker replicas."""

@@ -28,6 +28,7 @@ import numpy as np
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ..jax_compat import shard_map
 from .mesh import WORKER_AXIS, batch_sharding, worker_local_sharding
 
 
@@ -184,15 +185,49 @@ def _vary(x, axis: str):
     Idempotent: an already-varying value passes through — pcast raises on
     varying→varying, and callers like _revary_bn see either (the async
     rules' sync_bn is the identity, so their BN stats arrive varying;
-    BSP's pmean'd stats arrive invariant)."""
-    vma = getattr(jax.typeof(x), "vma", None) if hasattr(jax, "typeof") \
-        else None
-    if vma is not None and axis in vma:
+    BSP's pmean'd stats arrive invariant).
+
+    Version-robust across the jax API churn around the vma system
+    (round-5 ADVICE): ``jax.typeof`` may be absent while ``lax.pcast``
+    exists — the varying→varying pcast then fails with whatever error
+    that version raises, so the failure is caught BROADLY and falls back
+    to ``lax.pvary``.  A failure is masked only when the value cannot be
+    proven non-varying (no typeof to consult): when typeof CAN prove the
+    value was not already varying, the error is genuine misuse (wrong
+    axis name, outside shard_map) and re-raises at the call site.  On
+    versions predating the vma system entirely (no pcast, no pvary —
+    e.g. 0.4.x, where shard_map tracks replication via check_rep
+    instead) the marker is a no-op by construction."""
+    typeof = getattr(jax, "typeof", None)
+
+    def already_varying():
+        """True/False when typeof can answer, None when it can't."""
+        if typeof is None:
+            return None
+        try:
+            vma = getattr(typeof(x), "vma", None)
+            return None if vma is None else (axis in vma)
+        except Exception:
+            return None
+
+    if already_varying():
         return x
-    try:
-        return lax.pcast(x, (axis,), to="varying")
-    except (AttributeError, TypeError):
-        return lax.pvary(x, (axis,))
+    pcast = getattr(lax, "pcast", None)
+    if pcast is not None:
+        try:
+            return pcast(x, (axis,), to="varying")
+        except Exception:      # varying→varying, or signature drift
+            if already_varying() is False:
+                raise          # provably NOT varying — genuine misuse
+    pvary = getattr(lax, "pvary", None)
+    if pvary is not None:
+        try:
+            return pvary(x, (axis,))
+        except Exception:      # already varying on a pvary that checks
+            if already_varying() is False:
+                raise
+            return x
+    return x
 
 
 def _revary_bn(bn_state, axis: str):
@@ -253,6 +288,22 @@ def _accumulate_grads(loss_and_metrics: Callable, params, bn_state, batch,
 # step builders
 # ---------------------------------------------------------------------------
 
+# fold tag separating the fused-exchange key stream from the step rng's
+# dropout stream (which folds (ridx, count) in the other order)
+FUSED_EXCHANGE_FOLD = 0x0E5D
+
+
+def fused_exchange_key(rng):
+    """Base key for the in-scan fused exchange cadence (one per multi-step
+    dispatch, traced).  The standalone cadence consumes host-split keys
+    (``model.next_exchange_key()``); fusing the exchange into the scanned
+    step replaces that host draw with a deterministic traced stream:
+    rules fold the step count in themselves (``exchange_body``'s
+    ``fold_in(key, count)``), so ONE base key per call yields per-step
+    draws — the GoSGD RNG contract (docs/design.md §"fused cadence")."""
+    return jax.random.fold_in(rng, FUSED_EXCHANGE_FOLD)
+
+
 def build_train_step(mesh: Mesh, model, exchanger, n_steps: int = 1) -> Callable:
     """Compile the training step.
 
@@ -266,14 +317,21 @@ def build_train_step(mesh: Mesh, model, exchanger, n_steps: int = 1) -> Callable
     the per-call host cost (pytree flatten + hundreds of buffer handles) is
     paid once per k steps instead of per step.  Profiling motivation: on one
     v5e chip the ResNet-50 step showed 13.2 ms device-busy inside a 17.8 ms
-    wall step — ~26% host dispatch.  Only valid when the exchange is fused
-    into the step (BSP grads mode), where the between-steps Python hook is a
-    no-op; ``count`` is the index of the LAST step in the call.
+    wall step — ~26% host dispatch.  Valid for EVERY rule: exchangers with a
+    post-step collective (EASGD/ASGD/GoSGD, BSP params mode) have their
+    cadence fused into the scan — each scanned step ends with
+    ``lax.cond(count % exchange_freq == 0, exchange_body, identity)`` — so
+    one dispatch covers k steps INCLUDING their cadenced exchanges and the
+    between-steps Python hook is skipped (``exchanger.fused``); BSP grads
+    mode has no post-step hook to begin with.  ``count`` is the index of
+    the LAST step in the call.
     """
     axis = WORKER_AXIS
     n = mesh.shape[axis]
     n_subb = getattr(model, "n_subb", 1)
     fsdp = getattr(model, "_fsdp", None)       # FsdpLayout when fsdp=true
+    fuse_exchange = n_steps > 1 and exchanger.has_exchange()
+    exchange_freq = int(getattr(exchanger, "exchange_freq", 1))
 
     def fsdp_step(state, batch, lr, rng, count):
         # FSDP / ZeRO-3 (parallel/fsdp.py): state["params"] is this
@@ -348,7 +406,7 @@ def build_train_step(mesh: Mesh, model, exchanger, n_steps: int = 1) -> Callable
         def per_worker(state, batch, lr, rng, count):
             new_state, cost, err = one_step(state, batch, lr, rng, count)
             return new_state, cost[None], err[None]
-    else:
+    elif not fuse_exchange:
         def per_worker(state, batches, lr, rng, count):
             # batches leaves: [k, local_rows, ...]; count names the LAST step
             count0 = count - (n_steps - 1)
@@ -362,6 +420,39 @@ def build_train_step(mesh: Mesh, model, exchanger, n_steps: int = 1) -> Callable
             js = _vary(jnp.arange(n_steps), axis)
             state, (costs, errs) = lax.scan(body, state, (batches, js))
             return state, jnp.mean(costs)[None], jnp.mean(errs)[None]
+    else:
+        def per_worker(state, batches, lr, rng, count):
+            # fused cadence: the scan carries an INVARIANT step counter c
+            # alongside the state — the cond predicate (and the collectives
+            # inside the taken branch) must be provably uniform across
+            # workers; the varying js stream still feeds one_step's
+            # per-step rng fold exactly as in the unfused trace
+            count0 = count - (n_steps - 1)
+            exch_key = fused_exchange_key(rng)
+
+            def do_exchange(s, c):
+                s = exchanger.exchange_body(s, exch_key, c)
+                # exchange collectives (pmean/psum-averaged params) come
+                # back worker-INVARIANT by type; the scan carry is varying
+                # — re-mark, values untouched (same move as _revary_bn)
+                return jax.tree.map(lambda x: _vary(x, axis), s)
+
+            def body(carry, xs):
+                s, c = carry
+                batch, j = xs
+                s, cost, err = one_step(s, batch, lr, rng, count0 + j)
+                if exchange_freq == 1:
+                    s = do_exchange(s, c)
+                else:
+                    s = lax.cond(c % exchange_freq == 0,
+                                 lambda s: do_exchange(s, c),
+                                 lambda s: s, s)
+                return (s, c + 1), (cost, err)
+
+            js = _vary(jnp.arange(n_steps), axis)
+            (state, _), (costs, errs) = lax.scan(
+                body, (state, count0), (batches, js))
+            return state, jnp.mean(costs)[None], jnp.mean(errs)[None]
 
     state_spec = state_partition_specs(model, exchanger, axis)
     bs = model.batch_spec()
@@ -369,7 +460,7 @@ def build_train_step(mesh: Mesh, model, exchanger, n_steps: int = 1) -> Callable
     # n_steps > 1 prefixes the scan dim (round-4: composes with custom
     # batch specs — a sequence-parallel stack is P(None, workers, seq))
     batch_spec = P(*base) if n_steps == 1 else P(None, *base)
-    sm = jax.shard_map(
+    sm = shard_map(
         per_worker, mesh=mesh,
         in_specs=(state_spec, batch_spec, P(), P(), P()),
         out_specs=(state_spec, P(axis), P(axis)),
@@ -401,7 +492,7 @@ def build_val_step(mesh: Mesh, model) -> Callable:
     vb_spec = model.batch_spec()
     if vb_spec is None:
         vb_spec = P(axis)
-    sm = jax.shard_map(
+    sm = shard_map(
         per_worker, mesh=mesh,
         in_specs=(p_spec, bn_spec, vb_spec),
         out_specs=(P(axis), P(axis), P(axis)),

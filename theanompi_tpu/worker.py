@@ -77,8 +77,13 @@ class Worker(MeshProcess):
         t0 = time.time()
         # steps_per_call > 1: each train_iter dispatch covers several steps
         # (count strides accordingly; leftover batches < spc roll to the
-        # next epoch's shuffle, like the reference's drop-last batching)
+        # next epoch's shuffle, like the reference's drop-last batching).
+        # When compile_iter_fns fused the rule's exchange cadence into the
+        # scanned dispatch (exchanger.fused), the Python exchange hook is
+        # skipped outright — one XLA dispatch per k-step window covers the
+        # steps AND their cadenced exchanges.
         spc = max(1, int(getattr(model, "steps_per_call", 1)))
+        fused = bool(getattr(self.exchanger, "fused", False))
         # failure detection (SURVEY §5): stall_timeout seconds without an
         # iteration completing → off-thread diagnostic (hung collectives /
         # transfers block the main thread inside jax, so detection can't
@@ -115,7 +120,8 @@ class Worker(MeshProcess):
                             trace_pending = False
                             trace_stop_at = count + trace_iters
                         model.train_iter(count, self.recorder)
-                        self.exchanger.exchange(self.recorder, count)
+                        if not fused:
+                            self.exchanger.exchange(self.recorder, count)
                         watchdog.beat(f"epoch {epoch} iter {count}")
                         if trace_stop_at is not None and count + 1 >= trace_stop_at:
                             _stop_trace()
