@@ -23,10 +23,14 @@ transformer dims, tp/pp/sp), ``BENCH_SPC`` (steps_per_call) +
 ``BENCH_MFU`` (=1 adds the MFU column; ``BENCH_SPC_MFU=0`` disables the
 spc>1 single-step-flops derivation), ``BENCH_REAL_DATA`` (=1 drives the
 whole disk→augment→device pipeline; + ``BENCH_DATA_DIR``,
-``BENCH_WIRE_U8``).
+``BENCH_WIRE_U8``), ``BENCH_WINLOAD`` (=1, with BENCH_SPC>1: para_load
+window mode — the producer stacks+stages whole spc windows off the hot
+path and the timed loop dequeues mesh-resident windows).
 
 Env knobs — wedge-proof wrapper: ``BENCH_TIMEOUT`` (hard kill, default
-1500 s), ``BENCH_PROBE_TIMEOUT`` (default 90 s), ``BENCH_RECOVERY_WAIT``,
+1500 s), ``BENCH_PROBE_TIMEOUT`` (default 90 s), ``BENCH_PROBE_RETRIES``
+(recovery re-probes, default 3, exponential backoff + jitter from
+``BENCH_RECOVERY_WAIT``),
 ``BENCH_SKIP_PROBE`` (matrix rows probe once per pass),
 ``BENCH_FORCE_CPU`` / ``BENCH_ALLOW_CPU`` (explicit CPU intent / fallback
 acceptance — otherwise CPU rows are refused), ``BENCH_COMPILE_CACHE``
@@ -96,20 +100,48 @@ def _probe(timeout_s: float, cpu: bool = False) -> str | None:
     return lines[-1] if lines else None
 
 
-def _attempt_recovery() -> None:
-    """The documented tunnel-recovery recipe (memory: tpu-tunnel-wedge):
-    nothing local holds the chip, so recovery is limited to clearing a stale
-    libtpu lockfile and letting the tunnel settle before one re-probe."""
+def _clear_stale_locks() -> None:
+    """The local half of the documented tunnel-recovery recipe (memory:
+    tpu-tunnel-wedge): nothing local holds the chip, so recovery is limited
+    to clearing a stale libtpu lockfile and letting the tunnel settle."""
     for lock in glob.glob("/tmp/libtpu_lockfile*"):
         try:
             os.remove(lock)
             print(f"bench: removed stale {lock}", file=sys.stderr)
         except OSError:
             pass
-    wait = float(os.environ.get("BENCH_RECOVERY_WAIT", "45"))
-    print(f"bench: backend probe failed; waiting {wait:.0f}s before the "
-          "one documented recovery re-probe", file=sys.stderr)
-    time.sleep(wait)
+
+
+def _recovery_waits() -> list:
+    """Bounded exponential backoff schedule for the recovery re-probes:
+    ``BENCH_PROBE_RETRIES`` attempts (default 3), base
+    ``BENCH_RECOVERY_WAIT`` seconds (default 15) doubling per attempt,
+    capped at 120 s, with ±25% jitter so fleet-mates retrying the same
+    wedged tunnel don't re-probe in lockstep.  The old single fixed 45 s
+    re-probe lost BENCH_r05 to one wedge that settled just after it."""
+    import random
+    retries = max(0, int(os.environ.get("BENCH_PROBE_RETRIES", "3")))
+    base = float(os.environ.get("BENCH_RECOVERY_WAIT", "15"))
+    return [min(base * (2 ** i), 120.0) * (0.75 + 0.5 * random.random())
+            for i in range(retries)]
+
+
+def _probe_with_recovery(timeout_s: float):
+    """Probe the backend; on failure retry per ``_recovery_waits`` with the
+    stale-lock clear before each attempt.  Returns the platform or None."""
+    platform = _probe(timeout_s)
+    if platform is not None:
+        return platform
+    waits = _recovery_waits()
+    for i, wait in enumerate(waits):
+        _clear_stale_locks()
+        print(f"bench: backend probe failed; recovery re-probe "
+              f"{i + 1}/{len(waits)} in {wait:.0f}s", file=sys.stderr)
+        time.sleep(wait)
+        platform = _probe(timeout_s)
+        if platform is not None:
+            return platform
+    return None
 
 
 # the matrix labels always carry the batch; when BENCH_BATCH is unset the
@@ -151,6 +183,11 @@ def _cfg_matches(cfg: str) -> bool:
     if want_spc is not None and want_spc not in parts:
         return False
     if ("realdata" in parts) != (os.environ.get("BENCH_REAL_DATA") == "1"):
+        return False
+    # winload rows stream through the para_load window producer (staged
+    # [spc, ...] windows dequeued per dispatch) — a different pipeline
+    # from the reused staged stack the plain spc rows measure
+    if ("winload" in parts) != (os.environ.get("BENCH_WINLOAD") == "1"):
         return False
     # 'lc' rows compile client-side (PALLAS_AXON_REMOTE_COMPILE=0) — a
     # different compile venue the r5 matrix treats as an A/B variable, so
@@ -240,6 +277,10 @@ def _fail(error: str) -> int:
         out["value"] = res.get("value")
         out["unit"] = res.get("unit")
         out["vs_baseline"] = res.get("vs_baseline")
+        # machine-readable staleness: scripts/merge_matrix.py ranks stale
+        # rows below fresh measurements (a re-emitted old number must
+        # never shadow a genuine re-measure in the canonical matrix)
+        out["stale"] = True
     print(json.dumps(out))
     return 0 if lg is not None else 3
 
@@ -341,17 +382,16 @@ def wrapper_main() -> int:
     skip_probe = os.environ.get("BENCH_SKIP_PROBE") == "1"
 
     if not force_cpu and not skip_probe:
-        platform = _probe(probe_timeout)
-        if platform is None:
-            # a wedged tunnel either hangs the probe or silently falls back
-            # to CPU — both are failures for the metric of record
-            _attempt_recovery()
-            platform = _probe(probe_timeout)
+        # a wedged tunnel either hangs the probe or silently falls back
+        # to CPU — both are failures for the metric of record
+        platform = _probe_with_recovery(probe_timeout)
         if platform != "tpu" and allow_cpu:
             force_cpu = _probe(probe_timeout, cpu=True) == "cpu"
         if platform is None and not force_cpu:
-            return _fail(f"backend probe hung twice ({probe_timeout:.0f}s "
-                         "each) — TPU tunnel wedged")
+            n = 1 + len(_recovery_waits())
+            return _fail(f"backend probe hung {n} time(s) "
+                         f"({probe_timeout:.0f}s each, backed-off retries) "
+                         "— TPU tunnel wedged")
         if platform != "tpu" and not force_cpu:
             return _fail(f"only the {platform!r} backend answered (TPU "
                          "unavailable; set BENCH_ALLOW_CPU=1 to accept CPU)")
@@ -513,18 +553,38 @@ def main() -> int:
         # (4× smaller host→device transfers — the real-data lever)
         config["aug_wire_u8"] = True
     real_data = os.environ.get("BENCH_REAL_DATA") == "1"
+    winload = os.environ.get("BENCH_WINLOAD") == "1"
+    spc_cfg = int(config.get("steps_per_call", 1))
+    if winload:
+        # window-granular staging row (ISSUE 2): para_load on, the
+        # PrefetchLoader producer stacks+stages whole spc windows off the
+        # hot path and the timed loop dequeues mesh-resident windows
+        assert spc_cfg > 1, "BENCH_WINLOAD needs BENCH_SPC > 1"
+        config["para_load"] = True
+        if not real_data:
+            # synthetic data: size one epoch to cover the whole timed run
+            # — windows stream FRESH batches (spc each), and an exhausted
+            # epoch would block the dequeue until BENCH_TIMEOUT.  Both
+            # synthetic knobs: batch-file-family (ImageNet) counts
+            # batches, DataBase-family (cifar10) counts images.
+            need = (warmup + iters + 2) * spc_cfg
+            config.setdefault("synthetic_batches", need)
+            config.setdefault(
+                "synthetic_train",
+                need * n_chips * int(config.get(
+                    "batch_size", _DEFAULT_BATCH.get(model_name, 128))))
     if real_data:
         # verdict #3: drive the TPU from DISK — real batch files through the
         # native augment pass + PrefetchLoader staging to device — so the
         # recorded img/s includes the whole input pipeline, not just compute
-        assert int(config.get("steps_per_call", 1)) == 1, (
+        assert spc_cfg == 1 or winload, (
             "BENCH_REAL_DATA measures the streaming pipeline; spc>1 reuses "
-            "a staged stack and would not exercise it")
+            "a staged stack unless BENCH_WINLOAD=1 streams staged windows")
         # each training step consumes `size` batch FILES (one per chip,
         # imagenet.py files_per_step) — scale the dataset so one epoch
         # covers the whole timed run on any mesh size
         config["data_dir"] = _ensure_bench_dataset(
-            n_batches=max(32, warmup + iters + 4) * n_chips,
+            n_batches=max(32, (warmup + iters + 4) * spc_cfg) * n_chips,
             batch_size=int(config.get("batch_size", 128)))
         config["para_load"] = True
 
@@ -538,7 +598,16 @@ def main() -> int:
         exchanger = get_exchanger(rule, cfg)
         model.compile_iter_fns(exchanger)
         spc = int(cfg.get("steps_per_call", 1))
-        if real_data:
+        streaming = real_data or winload
+        if streaming and spc > 1:
+            # window mode (BENCH_WINLOAD): the producer assembles+stages
+            # whole [spc, ...] windows in the background; every timed step
+            # dequeues a FRESH mesh-resident window
+            model.data.shuffle_data(int(cfg.get("seed", 42)))
+            dev_batch = model.data.next_train_window(0)
+            n_images = int(dev_batch["y"].shape[0]) * int(
+                dev_batch["y"].shape[1])
+        elif streaming:
             # PrefetchLoader producer: loads .hkl from disk, augments via the
             # native pass, stages to device; every timed step consumes a
             # FRESH batch so the whole pipeline is on the clock
@@ -572,9 +641,10 @@ def main() -> int:
         load_wait = [0.0]
 
         def step(i):
-            if real_data:
+            if streaming:
                 t0 = time.time()
-                b = model.data.next_train_batch(i)
+                b = model.data.next_train_window((i + 1) * spc) if spc > 1 \
+                    else model.data.next_train_batch(i)
                 load_wait[0] += time.time() - t0   # consumer BLOCKED on the
             else:                                  # producer = overlap gap
                 b = dev_batch
@@ -606,7 +676,7 @@ def main() -> int:
         dt = time.time() - t0
 
         spc1_flops = None
-        if want_mfu and not mfu_this and \
+        if want_mfu and not mfu_this and not streaming and \
                 os.environ.get("BENCH_SPC_MFU", "1") != "0":
             # XLA's cost_analysis does not reliably scale the scan body by
             # its trip count, so the spc>1 executable can't be read
@@ -680,6 +750,7 @@ def main() -> int:
                   f"{jax.devices()[0].platform}, prng={prng or 'default'}"
                   f"{', spc=' + str(spc) if spc > 1 else ''}"
                   f"{', real-data (disk->native augment->device)' if real_data else ''}"
+                  f"{', winload (producer-staged spc windows)' if winload else ''}"
                   f"; {base_note})",
         "value": round(ips_chip, 2),
         "unit": f"{kind}/sec/chip",
@@ -688,7 +759,7 @@ def main() -> int:
     }
     if mfu is not None:
         out["mfu"] = mfu
-    if real_data:
+    if real_data or winload:
         # overlap evidence (SURVEY §2.8 "input pipeline at AlexNet
         # speeds"): the share of the timed window the consumer spent
         # BLOCKED waiting for the loader; ~0 = the producer kept up
@@ -709,7 +780,8 @@ def _apply_flagship_defaults() -> None:
     only the truly bare invocation gets the flagship config."""
     shaping = ("BENCH_MODEL", "BENCH_RULE", "BENCH_BATCH", "BENCH_STRATEGY",
                "BENCH_CFG", "BENCH_SPC", "BENCH_SYNTH_BATCHES",
-               "BENCH_BN_DTYPE", "BENCH_REAL_DATA", "BENCH_WIRE_U8")
+               "BENCH_BN_DTYPE", "BENCH_REAL_DATA", "BENCH_WIRE_U8",
+               "BENCH_WINLOAD")
     if any(k in os.environ for k in shaping):
         return
     if os.environ.get("PALLAS_AXON_REMOTE_COMPILE") == "0":

@@ -44,6 +44,16 @@ def main(argv=None) -> int:
     p.add_argument("--workers", type=int, default=1,
                    help="PrefetchLoader materializer pool size (implies "
                         "--prefetch when > 1)")
+    p.add_argument("--windows", type=int, default=0, metavar="K",
+                   help="window mode A/B (steps_per_call=K dispatch "
+                        "inputs): staged-window DEQUEUE latency through "
+                        "the PrefetchLoader window producer vs serial "
+                        "consumer-side assembly (K draws + stack + put)")
+    p.add_argument("--compute-ms", type=float, default=0.0,
+                   help="simulated per-window compute between dequeues "
+                        "(0 = use the measured serial assembly time, so "
+                        "the producer gets the same overlap budget a real "
+                        "training dispatch would give it)")
     p.add_argument("--data-dir", default=None)
     args = p.parse_args(argv)
 
@@ -57,6 +67,8 @@ def main(argv=None) -> int:
     cfg = {"size": 1, "data_dir": d}
     if args.u8_wire:
         cfg["aug_wire_u8"] = True
+    if args.windows > 1:
+        return _bench_windows(args, cfg)
     data = ImageNet_data(cfg, batch_size=args.batch_size)
     if args.prefetch or args.workers > 1:
         from theanompi_tpu.models.data.prefetch import PrefetchLoader
@@ -91,6 +103,83 @@ def main(argv=None) -> int:
         "note": "host pipeline only (disk->.hkl->augment); the rate it can "
                 "feed a chip at — AlexNet v5e needs ~14k img/s "
                 "(BASELINE.md)",
+    }
+    print(json.dumps(out))
+    return 0
+
+
+def _bench_windows(args, cfg) -> int:
+    """--windows K: the ISSUE-2 A/B in isolation — what does the CONSUMER
+    thread pay per ``steps_per_call`` dispatch input?  Serial path: k draws
+    + host stack + device_put on the consumer (the pre-window train_iter).
+    Window path: the PrefetchLoader producer assembles+stages whole
+    windows in the background and the consumer only DEQUEUES — with a
+    compute-sized gap between dequeues (as training provides), the
+    dequeue latency is the stall the chip actually sees."""
+    import jax as _jax
+
+    from theanompi_tpu.models.data.imagenet import ImageNet_data
+    from theanompi_tpu.models.data.prefetch import PrefetchLoader
+    from theanompi_tpu.parallel import steps
+    from theanompi_tpu.parallel.mesh import worker_mesh
+
+    k = args.windows
+    mesh = worker_mesh(1)
+
+    def block(w):
+        _jax.block_until_ready(_jax.tree_util.tree_leaves(w)[0])
+
+    serial = ImageNet_data(cfg, batch_size=args.batch_size)
+    n_windows = serial.n_batch_train // k
+    assert n_windows >= 2, (f"--windows {k} needs >= {2 * k} batches "
+                            f"(have {serial.n_batch_train})")
+    serial.shuffle_data(0)
+    # warm: page cache, native-library build, first device_put
+    block(steps.put_batch_stack(
+        mesh, [serial.next_train_batch(j) for j in range(k)], None))
+    t_serial = []
+    for ep in range(args.epochs):
+        serial.shuffle_data(ep + 1)
+        for wi in range(n_windows):
+            t1 = time.time()
+            batches = [serial.next_train_batch(wi * k + j) for j in range(k)]
+            block(steps.put_batch_stack(mesh, batches, None))
+            t_serial.append(time.time() - t1)
+    serial_ms = 1e3 * sum(t_serial) / len(t_serial)
+
+    compute_s = (args.compute_ms / 1e3) if args.compute_ms > 0 \
+        else serial_ms / 1e3
+    data = PrefetchLoader(ImageNet_data(cfg, batch_size=args.batch_size),
+                          n_workers=args.workers)
+    data.set_window(k, lambda w: steps.stage_window(mesh, w, None))
+    t_deq = []
+    for ep in range(args.epochs):
+        data.shuffle_data(ep + 1)
+        for wi in range(n_windows):
+            t1 = time.time()
+            w = data.next_train_window((wi + 1) * k)
+            block(w)
+            dt = time.time() - t1
+            if wi > 0:          # window 0 pays the producer spin-up
+                t_deq.append(dt)
+            time.sleep(compute_s)     # the producer's overlap budget
+    deq_ms = 1e3 * sum(t_deq) / len(t_deq)
+
+    out = {
+        "metric": f"staged_window_dequeue_vs_serial_assembly (k={k}, "
+                  f"batch {args.batch_size}"
+                  + (", u8-wire" if args.u8_wire else "")
+                  + f", pool x{args.workers})",
+        "value": round(deq_ms, 3),
+        "unit": "ms/window dequeue",
+        "serial_assembly_ms": round(serial_ms, 3),
+        "window_dequeue_ms": round(deq_ms, 3),
+        "consumer_stall_saved_ms": round(serial_ms - deq_ms, 3),
+        "compute_ms_between_dequeues": round(compute_s * 1e3, 3),
+        "windows": len(t_deq),
+        "note": "serial = k draws + stack + put ON the consumer thread "
+                "(pre-window train_iter); dequeue = what window-mode "
+                "train_iter pays (producer staged off-thread)",
     }
     print(json.dumps(out))
     return 0

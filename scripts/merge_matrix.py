@@ -21,6 +21,11 @@ VOIDING TOMBSTONE (result null + degraded note, optionally with
 merging an old backup that still holds the original untagged reading
 cannot resurrect it — while a genuine healthy re-measure (a different
 reading) supersedes the tombstone.
+
+Stale hygiene (round 7): bench.py's wedge fallback tags re-emitted
+last-good numbers ``stale: true`` — they rank below any fresh measurement
+(but above tombstones/degraded rows), so a wedged round's fallback can
+never shadow a later genuine re-measure.
 """
 
 import json
@@ -39,15 +44,28 @@ def _is_degraded(row: dict) -> bool:
     return "degraded" in blob.lower()
 
 
+def _is_stale(row: dict) -> bool:
+    """A STALE-last-good row: bench.py's wedge fallback re-emitting an
+    older healthy reading (``stale: true`` in the result, set by _fail;
+    the metric-string marker covers hand-merged pre-tag artifacts).  An
+    honest number, but never fresher than a real measurement."""
+    res = row.get("result")
+    if not isinstance(res, dict):
+        return False
+    return bool(res.get("stale")) or \
+        "stale last-good" in str(res.get("metric", "")).lower()
+
+
 def _rank(row: dict, voided: dict, cfg: str) -> int:
-    """healthy non-null (3) > voiding tombstone (2) > degraded non-null
-    (1) > plain null (0).  The tombstone outranks degraded readings so a
-    merged-in old backup still holding the original untagged value can't
-    resurrect it; a non-null row whose value matches the config's
-    tombstoned reading is classified degraded even when untagged —
-    UNLESS the row carries a ``ts`` newer than the tombstone's (a genuine
-    healthy re-measure can coincide with the voided reading; round-5
-    ADVICE), and the demotion is always logged so it is never silent."""
+    """fresh healthy non-null (4) > stale last-good non-null (3) >
+    voiding tombstone (2) > degraded non-null (1) > plain null (0).
+    The tombstone outranks degraded readings so a merged-in old backup
+    still holding the original untagged value can't resurrect it; a
+    non-null row whose value matches the config's tombstoned reading is
+    classified degraded even when untagged — UNLESS the row carries a
+    ``ts`` newer than the tombstone's (a genuine healthy re-measure can
+    coincide with the voided reading; round-5 ADVICE), and the demotion
+    is always logged so it is never silent."""
     res = row.get("result")
     if res is None:
         return 2 if _is_degraded(row) else 0
@@ -62,13 +80,17 @@ def _rank(row: dict, voided: dict, cfg: str) -> int:
         ts, tomb_ts = row.get("ts"), tomb.get("ts")
         if ts is not None and tomb_ts is not None and \
                 float(ts) > float(tomb_ts):
-            return 3      # re-measured after the voiding — trust it
+            # re-measured after the voiding — trust it; but a STALE
+            # fallback is ts-stamped at re-EMISSION time, so it passes
+            # this check while still carrying the voided old reading —
+            # it must stay below fresh measurements
+            return 3 if _is_stale(row) else 4
         print(f"merge_matrix: {cfg} non-null value {val} matches the "
               f"tombstoned voided_value — demoting to degraded (a genuine "
               f"re-measure should carry a 'ts' newer than the tombstone's)",
               file=sys.stderr)
         return 1
-    return 3
+    return 3 if _is_stale(row) else 4
 
 
 def merge(paths: list[str]) -> None:
@@ -119,14 +141,18 @@ def merge(paths: list[str]) -> None:
                 # within a rank class the LAST row wins (newest re-measure)
                 if _rank(row, voided, cfg) >= _rank(best[cfg], voided, cfg):
                     best[cfg] = row
-    # a degraded survivor (no healthy sibling anywhere) is flagged so
-    # nothing downstream quotes it silently
+    # a degraded or stale survivor (no fresh sibling anywhere) is flagged
+    # so nothing downstream quotes it silently
     for cfg, row in best.items():
-        if row.get("result") is not None and \
-                _rank(row, voided, cfg) == 1:
+        r = _rank(row, voided, cfg) if row.get("result") is not None else None
+        if r == 1:
             print(f"merge_matrix: {cfg} only has a DEGRADED-window "
                   "reading — do not quote; re-measure in a healthy "
                   "window", file=sys.stderr)
+        elif r == 3:
+            print(f"merge_matrix: {cfg} only has a STALE last-good "
+                  "reading — re-measure when the tunnel answers",
+                  file=sys.stderr)
     with open(paths[0], "w") as f:
         for cfg in order:
             f.write(json.dumps(best[cfg]) + "\n")

@@ -1,0 +1,34 @@
+#!/usr/bin/env bash
+# Round-7 perf matrix — window-granular input staging (ISSUE 2 tentpole):
+# with para_load on and steps_per_call>1 the PrefetchLoader producer
+# assembles + stages whole spc windows OFF the consumer thread
+# (k draws → host stack → steps.stage_window), so train_iter dequeues a
+# mesh-resident window and dispatches immediately.  These rows stage the
+# A/B for the next hardware window: each winload config against its
+# consumer-assembled sibling (alexnet-b128-spc4 is the r3 flagship
+# record row; vgg16-b32-easgd-spc8 is the r6 fused-cadence row).
+# load_wait_share in the result is the overlap evidence: ~0 = the
+# producer kept up with the chip.
+# Rows already measured in the out-file are skipped, so the script is
+# re-runnable after a tunnel wedge (same convention as perf_matrix_r6.sh).
+#   ./scripts/perf_matrix_r7.sh [out_file]
+set -u -o pipefail
+OUT="${1:-perf_matrix_r7.jsonl}"
+cd "$(dirname "$0")/.."
+. scripts/_bench_row.sh
+
+# cheap canary: proves the window producer + staged-window dispatch path
+# compiles and streams on the chip before the big scans are attempted
+run cifar10-b128-spc4-winload   BENCH_MODEL=cifar10  BENCH_SPC=4 BENCH_WINLOAD=1
+
+# -- the acceptance rows: flagship + fused-cadence configs, window-staged --
+run alexnet-b128-spc4-winload   BENCH_MODEL=alexnet  BENCH_SPC=4 BENCH_WINLOAD=1
+run vgg16-b32-easgd-spc8-winload BENCH_MODEL=vgg16   BENCH_RULE=easgd BENCH_SPC=8 BENCH_WINLOAD=1
+
+# -- the full pipeline: DISK -> native augment -> window stack -> staged
+#    window, all off-thread while the chip trains (streams fresh data
+#    every step; compare against r4's alexnet-b128-realdata spc=1 row) --
+run alexnet-b128-realdata-spc4-winload BENCH_MODEL=alexnet BENCH_SPC=4 BENCH_REAL_DATA=1 BENCH_WINLOAD=1
+
+python scripts/merge_matrix.py "$OUT"
+cat "$OUT"

@@ -60,6 +60,13 @@ def env(monkeypatch):
     ({"BENCH_MODEL": "resnet50", "BENCH_BN_DTYPE": "bfloat16"},
      "resnet50-b32-bnbf16", True),
     ({"BENCH_MODEL": "resnet50"}, "resnet50-b32-bnbf16", False),
+    # winload rows (producer-staged spc windows) ≠ plain spc rows
+    ({"BENCH_SPC": "4", "BENCH_WINLOAD": "1"},
+     "alexnet-b128-spc4-winload", True),
+    ({"BENCH_SPC": "4"}, "alexnet-b128-spc4-winload", False),
+    ({"BENCH_SPC": "4", "BENCH_WINLOAD": "1"}, "alexnet-b128-spc4", False),
+    ({"BENCH_MODEL": "vgg16", "BENCH_RULE": "easgd", "BENCH_SPC": "8",
+      "BENCH_WINLOAD": "1"}, "vgg16-b32-easgd-spc8-winload", True),
 ])
 def test_cfg_matches(env, envs, cfg, want):
     for k, v in envs.items():
@@ -354,6 +361,92 @@ def test_powersgd_wire_bytes_uses_real_factorization():
                          + vgg["powersgd_dense"]) * 4
     # and it stays far below both the dense allreduce and the old estimate
     assert wb < 0.05 * wire_bytes("allreduce", vgg["params"], 0, 8)
+
+
+def test_recovery_backoff_schedule(env):
+    """bench.py's probe recovery (BENCH_r05 postmortem: the single fixed
+    45 s re-probe lost the round to one wedged tunnel): BENCH_PROBE_RETRIES
+    attempts with exponential backoff from BENCH_RECOVERY_WAIT, capped at
+    120 s, jittered ±25% so fleet-mates don't re-probe in lockstep."""
+    env.setenv("BENCH_PROBE_RETRIES", "5")
+    env.setenv("BENCH_RECOVERY_WAIT", "10")
+    waits = bench._recovery_waits()
+    assert len(waits) == 5
+    for i, w in enumerate(waits):
+        nominal = min(10.0 * 2 ** i, 120.0)
+        assert 0.75 * nominal <= w <= 1.25 * nominal, (i, w)
+    assert min(10.0 * 2 ** 4, 120.0) == 120.0      # the cap engages
+    env.setenv("BENCH_PROBE_RETRIES", "0")
+    assert bench._recovery_waits() == []           # opt out entirely
+
+
+def test_fail_tags_stale_last_good(env, capsys, monkeypatch):
+    """The wedge fallback's re-emitted last-good row carries stale: true
+    so downstream ranking can never mistake it for a fresh measurement."""
+    monkeypatch.setattr(bench, "_last_good", lambda: (
+        "alexnet-b128", {"metric": "m", "value": 5.0, "unit": "u",
+                         "vs_baseline": 1.0}))
+    rc = bench._fail("tunnel wedged")
+    out = json.loads(capsys.readouterr().out.strip())
+    assert rc == 0
+    assert out["stale"] is True and out["value"] == 5.0
+    assert "STALE last-good" in out["metric"]
+    # no last_good → no stale tag, rc 3
+    monkeypatch.setattr(bench, "_last_good", lambda: None)
+    rc = bench._fail("tunnel wedged")
+    out = json.loads(capsys.readouterr().out.strip())
+    assert rc == 3 and "stale" not in out
+
+
+def test_merge_matrix_stale_ranks_below_fresh(tmp_path, capsys):
+    """A stale last-good row (bench's wedge fallback) must lose to any
+    fresh measurement — whatever the file order — but still beat nulls
+    and degraded rows; a stale-only survivor is flagged on stderr."""
+    p = tmp_path / "m.jsonl"
+    rows = [
+        {"config": "a", "result": {"metric": "m", "value": 3.0,
+                                   "stale": True}},
+        {"config": "a", "result": {"metric": "m", "value": 2.0}},
+        # stale arriving AFTER the fresh row must not supersede it —
+        # also via the metric-string marker (pre-tag artifacts)
+        {"config": "a", "result": {
+            "metric": "STALE last-good (a) — run failed", "value": 4.0}},
+        {"config": "b", "result": None},
+        {"config": "b", "result": {"metric": "m", "value": 1.0,
+                                   "stale": True}},
+        {"config": "c", "result": {"metric": "m (degraded window)",
+                                   "value": 9.0}},
+        {"config": "c", "result": {"metric": "m", "value": 8.0,
+                                   "stale": True}},
+    ]
+    p.write_text("\n".join(json.dumps(r) for r in rows) + "\n")
+    merge_matrix.merge([str(p)])
+    by = {r["config"]: r for r in
+          (json.loads(l) for l in p.read_text().splitlines())}
+    assert by["a"]["result"]["value"] == 2.0       # fresh beats stale
+    assert by["b"]["result"]["value"] == 1.0       # stale beats null
+    assert by["c"]["result"]["value"] == 8.0       # stale beats degraded
+    err = capsys.readouterr().err
+    assert "STALE last-good" in err                # survivors are flagged
+
+
+def test_merge_matrix_stale_cannot_launder_through_ts(tmp_path):
+    """A stale fallback re-emitting a tombstoned value is ts-stamped at
+    re-emission time — NEWER than the tombstone — so it passes the
+    genuine-re-measure ts escape; it must still rank as stale and lose
+    to a fresh measurement."""
+    p = tmp_path / "m.jsonl"
+    rows = [
+        {"config": "a", "ts": 50, "result": None,
+         "note": "degraded window — reading voided", "voided_value": 3.0},
+        {"config": "a", "ts": 100,
+         "result": {"metric": "m", "value": 3.0, "stale": True}},
+        {"config": "a", "ts": 60, "result": {"metric": "m", "value": 2.0}},
+    ]
+    p.write_text("\n".join(json.dumps(r) for r in rows) + "\n")
+    merge_matrix.merge([str(p)])
+    got = [json.loads(l) for l in p.read_text().splitlines()]
+    assert got[0]["result"]["value"] == 2.0
 
 
 def test_merge_matrix_newest_tombstone_governs(tmp_path, capsys):

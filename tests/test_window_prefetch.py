@@ -1,0 +1,283 @@
+"""Window-granular input staging (ISSUE 2 tentpole): with ``para_load``
+on and ``steps_per_call > 1`` the PrefetchLoader producer assembles whole
+spc windows — k sequential draws, one host stack, one
+``steps.stage_window`` — and the bounded queue holds DEVICE-RESIDENT
+windows, so ``train_iter`` dequeues a mesh-resident dispatch input.
+
+Contracts pinned here:
+
+* bit-equivalence — the window-staged batch stream AND the params after N
+  windows equal the serial path (k× ``next_train_batch`` +
+  ``put_batch_stack`` on the consumer) exactly;
+* the acceptance accounting — window mode's ``stage`` recorder bucket is
+  ~0 (the producer staged off-thread; the consumer bracket is a
+  pass-through) and ``load`` reflects only dequeue wait;
+* restart-mid-epoch cursor exactness at window granularity;
+* a producer error (load/augment/stage) surfaces in the consumer.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+from tests.conftest import SyntheticData, TinyModel
+from theanompi_tpu.models.data.prefetch import PrefetchLoader
+from theanompi_tpu.parallel import steps
+from theanompi_tpu.parallel.exchanger import BSP_Exchanger
+from theanompi_tpu.parallel.mesh import worker_mesh
+from theanompi_tpu.utils.recorder import Recorder
+
+
+def _mk_model(spc, para_load, n=4, **cfg):
+    mesh = worker_mesh(n)
+    config = {"mesh": mesh, "size": n, "rank": 0, "verbose": False,
+              "batch_size": 8, "steps_per_call": spc, "n_train": 512,
+              "para_load": para_load, **cfg}
+    model = TinyModel(config)
+    model.compile_iter_fns(BSP_Exchanger(config))
+    model.data.shuffle_data(0)
+    return model
+
+
+def _leaves(tree):
+    return [np.asarray(x) for x in jax.tree_util.tree_leaves(
+        jax.device_get(tree))]
+
+
+def test_stage_window_stream_bit_equals_put_batch_stack():
+    """The dequeued device window IS the serial path's staged stack,
+    bit for bit — same draws, same stack, same sharding."""
+    mesh = worker_mesh(4)
+    k = 4
+    ref = SyntheticData({"size": 4}, 8)
+    ref.shuffle_data(5)
+    loader = PrefetchLoader(SyntheticData({"size": 4}, 8))
+    loader.set_window(k, lambda w: steps.stage_window(mesh, w, None))
+    loader.shuffle_data(5)
+    for w in range(2):
+        batches = [ref.next_train_batch(w * k + j + 1) for j in range(k)]
+        want = steps.put_batch_stack(mesh, batches, None)
+        got = loader.next_train_window((w + 1) * k)
+        assert steps.is_device_window(got)
+        for a, b in zip(_leaves(want), _leaves(got)):
+            np.testing.assert_array_equal(a, b)
+
+
+def test_window_staged_params_bit_equal_serial_after_n_windows():
+    """The acceptance criterion: N windows through the window-staged
+    pipeline leave the model in the EXACT state of the serial
+    k× next_train_batch + consumer put_batch_stack path."""
+    k, windows = 4, 3
+    serial = _mk_model(k, para_load=False)
+    staged = _mk_model(k, para_load=True)
+    assert getattr(staged.data, "window", 0) == k
+    for w in range(1, windows + 1):
+        serial.train_iter(w * k, None)
+        staged.train_iter(w * k, None)
+    for part in ("params", "opt_state"):
+        for a, b in zip(_leaves(serial.step_state[part]),
+                        _leaves(staged.step_state[part])):
+            np.testing.assert_array_equal(a, b, err_msg=part)
+
+
+def test_window_mode_stage_bucket_near_zero():
+    """The recorder contract: in window mode the consumer's `stage`
+    bracket is a pass-through (the producer already staged the window),
+    so its bucket stays ~0 while `load` (dequeue wait) and `train` book
+    the real time — the overlap win is visible in records."""
+    staged = _mk_model(4, para_load=True)
+    rec = Recorder({"verbose": False, "printFreq": 4, "size": 4})
+    for w in range(1, 4):
+        staged.train_iter(w * 4, rec)
+        rec.print_train_info(w * 4, stride=4)
+    assert rec.t_sec_total["stage"] < 0.05, rec.t_sec_total
+    assert rec.t_sec_total["train"] > 0.0
+    # row accounting matches the serial path: k × global rows per window
+    assert rec.n_images_total == 3 * 4 * 32
+    # and the JSONL record carries the new bucket
+    assert "t_stage" in rec._all_records[-1]
+    # serial contrast: the consumer pays the stack+put in `stage`
+    serial = _mk_model(4, para_load=False)
+    rec1 = Recorder({"verbose": False})
+    for w in range(1, 4):
+        serial.train_iter(w * 4, rec1)
+    assert rec1.t_sec_total["stage"] > 0.0
+    assert rec1.n_images_total == rec.n_images_total
+
+
+def test_window_cursor_restart_mid_epoch_exact():
+    """Mid-epoch restart at window granularity: resuming from
+    get_cursor() replays the remaining windows bit-identically (the
+    committed cursor is as of after the last CONSUMED window's k-th
+    batch, never the producer's read-ahead)."""
+    mesh = worker_mesh(2)
+    k = 4
+
+    def fresh():
+        l = PrefetchLoader(SyntheticData({"size": 2}, 8))
+        l.set_window(k, lambda w: steps.stage_window(mesh, w, None))
+        return l
+
+    a = fresh()
+    a.shuffle_data(3)
+    wins = [_leaves(a.next_train_window((i + 1) * k)) for i in range(3)]
+
+    b = fresh()
+    b.shuffle_data(3)
+    b.next_train_window(k)
+    cur = b.get_cursor()
+    assert cur["train_ptr"] == k          # window granularity, exactly
+
+    c = fresh()
+    c.set_cursor(cur)
+    for want in wins[1:]:
+        got = _leaves(c.next_train_window(0))
+        for x, y in zip(want, got):
+            np.testing.assert_array_equal(x, y)
+
+
+def test_producer_error_surfaces_in_consumer():
+    """A load failure inside the producer (here: batch 6 of window 2)
+    must re-raise at the consumer's next dequeue, not hang or vanish."""
+
+    class BoomData(SyntheticData):
+        def next_train_batch(self, count):
+            if self._train_ptr >= 6:
+                raise RuntimeError("boom at batch 6")
+            return super().next_train_batch(count)
+
+    l = PrefetchLoader(BoomData({"size": 2}, 8))
+    l.set_window(4)                      # host windows: staging not at issue
+    l.shuffle_data(0)
+    l.next_train_window(4)               # batches 0-3: fine
+    with pytest.raises(RuntimeError, match="boom at batch 6"):
+        l.next_train_window(8)
+
+
+def test_stage_error_surfaces_in_consumer():
+    """An error in the staging hook itself (device_put on the producer
+    thread) surfaces in the consumer too."""
+    def bad_stage(window):
+        raise ValueError("stage blew up")
+
+    l = PrefetchLoader(SyntheticData({"size": 2}, 8))
+    l.set_window(4, bad_stage)
+    l.shuffle_data(0)
+    with pytest.raises(ValueError, match="stage blew up"):
+        l.next_train_window(4)
+
+
+def test_pooled_window_producer_stream_identical():
+    """n_workers > 1 + plan/materialize data: a window's k batches
+    materialize in the pool, but plans stay sequential — the staged
+    stream is bit-identical to the 1-worker window producer's."""
+
+    class PlannedData(SyntheticData):
+        """plan/materialize split over the synthetic set (the ImageNet
+        contract shape, cheap enough for tier-1)."""
+
+        def plan_train_batch(self, count):
+            i = self._train_ptr % self.n_batch_train
+            self._train_ptr += 1
+            return {"idx": self._perm[self._local(i * self.global_batch)]}
+
+        def materialize(self, plan):
+            idx = plan["idx"]
+            return self._make_batch(self.x_train[idx], self.y_train[idx],
+                                    train=True)
+
+    mesh = worker_mesh(2)
+
+    def fresh(n_workers):
+        l = PrefetchLoader(PlannedData({"size": 2}, 8), n_workers=n_workers)
+        l.set_window(4, lambda w: steps.stage_window(mesh, w, None))
+        l.shuffle_data(9)
+        return l
+
+    a, b = fresh(1), fresh(4)
+    for _ in range(3):
+        for x, y in zip(_leaves(a.next_train_window(0)),
+                        _leaves(b.next_train_window(0))):
+            np.testing.assert_array_equal(x, y)
+
+
+def test_put_batch_stack_stages_host_window():
+    """set_window(k, stage_fn=None) leaves host windows on the queue; the
+    consumer's put_batch_stack stages them (the documented contract),
+    bit-equal to producer-side staging."""
+    mesh = worker_mesh(2)
+    k = 4
+    a = PrefetchLoader(SyntheticData({"size": 2}, 8))
+    a.set_window(k)                      # host windows
+    a.shuffle_data(7)
+    host_w = a.next_train_window(k)
+    assert not steps.is_device_window(host_w)
+    staged = steps.put_batch_stack(mesh, host_w, None)
+    assert steps.is_device_window(staged)
+    b = PrefetchLoader(SyntheticData({"size": 2}, 8))
+    b.set_window(k, lambda w: steps.stage_window(mesh, w, None))
+    b.shuffle_data(7)
+    for x, y in zip(_leaves(b.next_train_window(k)), _leaves(staged)):
+        np.testing.assert_array_equal(x, y)
+
+
+def test_set_window_midstream_rewire_drops_nothing():
+    """Re-wiring window mode with a live producer (session recompile
+    passes a NEW stage_fn closure) rewinds to the last CONSUMED position:
+    the read-ahead the drained queue held is re-drawn, so the stream
+    stays bit-identical to an uninterrupted run."""
+    mesh = worker_mesh(2)
+    k = 4
+
+    def fresh():
+        l = PrefetchLoader(SyntheticData({"size": 2}, 8))
+        l.set_window(k, lambda w: steps.stage_window(mesh, w, None))
+        l.shuffle_data(3)
+        return l
+
+    ref = fresh()
+    wins = [_leaves(ref.next_train_window((i + 1) * k)) for i in range(3)]
+
+    l = fresh()
+    got = [_leaves(l.next_train_window(k))]
+    # same k, new closure — the recompile case; the producer has read
+    # ahead past window 0 by now (or will have: restart handles both)
+    l.set_window(k, lambda w: steps.stage_window(mesh, w, None))
+    got += [_leaves(l.next_train_window(0)) for _ in range(2)]
+    for want, have in zip(wins, got):
+        for x, y in zip(want, have):
+            np.testing.assert_array_equal(x, y)
+
+
+def test_mixed_granularity_consumption_refused():
+    """next_train_batch against a live window-mode producer would desync
+    the queue granularity — refused loudly."""
+    l = PrefetchLoader(SyntheticData({"size": 2}, 8))
+    l.set_window(4)
+    l.shuffle_data(0)
+    with pytest.raises(RuntimeError, match="window mode"):
+        l.next_train_batch(1)
+
+
+def test_recompile_to_spc1_reverts_to_per_batch():
+    """compile_iter_fns re-wires window mode every compile: going back to
+    steps_per_call=1 must revert the loader to per-batch production (a
+    stale window setting would wedge the queue granularity)."""
+    model = _mk_model(4, para_load=True)
+    assert model.data.window == 4
+    model.steps_per_call = 1
+    model.compile_iter_fns(BSP_Exchanger(model.config))
+    assert model.data.window == 0
+    model.data.shuffle_data(1)
+    model.train_iter(1, None)            # per-batch path works again
+    assert np.isfinite(float(model.current_info["cost"]))
+
+
+def test_para_load_window_opt_out():
+    """para_load_window=false keeps the pre-window behavior (per-batch
+    producer + consumer-side stack) — the A/B lever."""
+    model = _mk_model(4, para_load=True, para_load_window=False)
+    assert getattr(model.data, "window", 0) == 0
+    model.train_iter(4, None)
+    assert np.isfinite(float(model.current_info["cost"]))

@@ -25,6 +25,13 @@ import queue
 import threading
 from typing import Optional
 
+def _stack_host(batches):
+    """Host-side ``[k, ...]`` stack of k per-step batches — delegates to
+    ``steps.stack_host`` (lazy: keeps this module importable without
+    jax) so the window layout has exactly one definition."""
+    from ...parallel.steps import stack_host
+    return stack_host(batches)
+
 
 class PrefetchLoader:
     """Double-buffered background loader over any DataBase-shaped object.
@@ -36,7 +43,12 @@ class PrefetchLoader:
     materializes several in flight — disk reads and the native augment
     release the GIL, so file-based pipelines scale near-linearly.  The
     bounded queue holds ordered futures: the batch STREAM is bit-identical
-    to the serial path, whatever the pool size."""
+    to the serial path, whatever the pool size.
+
+    ``set_window(k, stage_fn)`` (``steps_per_call`` > 1): production goes
+    WINDOW-granular — the queue holds whole ``[k, ...]`` dispatch inputs,
+    staged to the mesh by the producer, consumed via
+    ``next_train_window`` (docs/design.md §9)."""
 
     def __init__(self, data, depth: int = 2, device_put_fn=None,
                  n_workers: int = 1):
@@ -44,6 +56,8 @@ class PrefetchLoader:
         self.depth = depth
         self.n_workers = max(1, int(n_workers))
         self._device_put_fn = device_put_fn  # optional: stage host→device too
+        self.window = 0                      # set_window: spc window mode
+        self._stage_window_fn = None
         self._q: Optional[queue.Queue] = None
         self._thread: Optional[threading.Thread] = None
         # per-producer stop event: a timed-out old producer must keep seeing
@@ -51,6 +65,40 @@ class PrefetchLoader:
         # revive it against the new queue / shared data object)
         self._stop: Optional[threading.Event] = None
         self._consumed_cursor: dict = {}
+
+    def set_window(self, k: int, stage_fn=None) -> None:
+        """Switch to WINDOW-granular production (``steps_per_call`` > 1):
+        the producer assembles whole spc windows — k sequential draws
+        (cursor/augmentation RNG stay exact), ONE host ``np.stack`` to
+        ``[k, ...]`` leaves, one ``stage_fn(window)`` (normally
+        ``steps.stage_window`` bound to the mesh) — so the bounded queue
+        holds DEVICE-RESIDENT windows, depth 2 = double buffering of
+        entire dispatch inputs, and the consumer dequeues via
+        :meth:`next_train_window` and dispatches immediately.
+
+        ``k <= 1`` reverts to per-batch production.  ``stage_fn=None``
+        leaves the window on the host (tests; the consumer's
+        ``put_batch_stack`` then stages it).  ``device_put_fn`` (per-batch
+        staging) is ignored while window mode is on — staging happens once
+        per window.  A live producer is restarted so the queue granularity
+        switches immediately; ``model_base.compile_iter_fns`` calls this
+        before the first ``shuffle_data``."""
+        k = int(k)
+        was = (self.window, self._stage_window_fn)
+        self.window = k if k > 1 else 0
+        self._stage_window_fn = stage_fn if self.window else None
+        if self._thread is not None and \
+                (self.window, self._stage_window_fn) != was:
+            self._shutdown()
+            # rewind to the last CONSUMED position before restarting: the
+            # old producer ran ahead and the drained queue held up to
+            # ``depth`` unconsumed items — resuming from the wrapped
+            # data's live cursor would silently skip them.  Cursor-less
+            # duck-typed data can't rewind and degrades to the wrapped
+            # object's live position (the set_cursor contract above).
+            if self._consumed_cursor and hasattr(self._data, "set_cursor"):
+                self._data.set_cursor(self.get_cursor())
+            self._restart_producer()
 
     # duck-typed passthrough surface ---------------------------------------
     @property
@@ -121,7 +169,8 @@ class PrefetchLoader:
         # materialization or q.put blocks the submit loop at depth+1 and
         # caps the effective pool (review finding)
         pooled = self.n_workers > 1 and hasattr(self._data,
-                                                "plan_train_batch")
+                                                "plan_train_batch") \
+            and not self.window
         self._q = queue.Queue(
             maxsize=self.depth + (self.n_workers if pooled else 0))
         self._stop = threading.Event()
@@ -131,6 +180,11 @@ class PrefetchLoader:
         self._thread.start()
 
     def next_train_batch(self, count: int):
+        if self.window > 1 and self._q is not None:
+            raise RuntimeError(
+                "window mode is active — the queue holds whole "
+                f"[{self.window}, ...] windows; consume via "
+                "next_train_window (or set_window(0) first)")
         if self._q is None:          # shuffle_data not called yet (smoke use)
             return self._maybe_put(self._data.next_train_batch(count))
         item = self._q.get()
@@ -144,6 +198,26 @@ class PrefetchLoader:
         self._consumed_cursor = cursor
         return batch
 
+    def next_train_window(self, count: int):
+        """Dequeue one whole spc window — ALREADY staged to the mesh when
+        ``set_window`` got a ``stage_fn`` (queue items are device-resident:
+        the consumer's only cost is the dequeue wait).  ``count`` names the
+        LAST step of the window, as in ``train_iter``."""
+        assert self.window > 1, "set_window(k) first"
+        if self._q is None:          # shuffle_data not called yet (smoke use)
+            batches = [self._data.next_train_batch(count - self.window + 1 + j)
+                       for j in range(self.window)]
+            return self._stage(_stack_host(batches))
+        item = self._q.get()
+        if isinstance(item, BaseException):
+            raise item
+        window, cursor = item
+        # commit only after the window is in hand (same contract as the
+        # per-batch path); the cursor is AT WINDOW GRANULARITY — as of
+        # after this window's k-th batch was drawn
+        self._consumed_cursor = cursor
+        return window
+
     def next_val_batch(self, count: int):
         # Validation is per-epoch and cheap relative to training — served
         # synchronously (the reference's loader also only covered train).
@@ -156,6 +230,9 @@ class PrefetchLoader:
         # swaps self._q/_stop, and a slow old producer must neither feed the
         # new queue nor be revived by the new (cleared) event
         try:
+            if self.window > 1:
+                self._producer_windows(n_batches, q, stop)
+                return
             if self.n_workers > 1 and hasattr(self._data,
                                               "plan_train_batch"):
                 self._producer_pooled(n_batches, q, stop)
@@ -198,6 +275,49 @@ class PrefetchLoader:
                 if stop.is_set():
                     return
                 q.put((fut, cursor))   # bounded: blocks at depth+n_workers
+
+    def _producer_windows(self, n_batches: int, q: queue.Queue,
+                          stop: threading.Event) -> None:
+        """Window-granular producer: k sequential draws, one host stack,
+        one mesh staging per window — all OFF the consumer thread, so
+        ``train_iter`` dequeues a mesh-resident window and dispatches
+        immediately.  Leftover batches < k roll to the next epoch's
+        shuffle (the worker loop's ``n_batch_train // spc`` drop-last
+        convention).  When the wrapped data exposes the plan/materialize
+        split and ``n_workers > 1``, a window's k batches materialize
+        concurrently in the pool (plans stay sequential — the batch
+        stream is bit-identical to the serial path)."""
+        from concurrent.futures import ThreadPoolExecutor
+        k = self.window
+        pooled = self.n_workers > 1 and hasattr(self._data,
+                                                "plan_train_batch")
+        pool = ThreadPoolExecutor(self.n_workers) if pooled else None
+        try:
+            for w in range(n_batches // k):
+                if stop.is_set():
+                    return
+                if pooled:
+                    plans = [self._data.plan_train_batch(w * k + j + 1)
+                             for j in range(k)]
+                    futs = [pool.submit(self._data.materialize, p)
+                            for p in plans]
+                    batches = [f.result() for f in futs]  # re-raises, ordered
+                else:
+                    batches = [self._data.next_train_batch(w * k + j + 1)
+                               for j in range(k)]
+                cursor = self._data.get_cursor() \
+                    if hasattr(self._data, "get_cursor") else {}
+                window = self._stage(_stack_host(batches))
+                if stop.is_set():     # restart raced the stage: drop
+                    return
+                q.put((window, cursor))
+        finally:
+            if pool is not None:
+                pool.shutdown(wait=False)
+
+    def _stage(self, window):
+        return self._stage_window_fn(window) if self._stage_window_fn \
+            else window
 
     def _maybe_put(self, batch):
         return self._device_put_fn(batch) if self._device_put_fn else batch

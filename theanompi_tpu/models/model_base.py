@@ -80,9 +80,10 @@ class ModelBase:
             # via CUDA IPC: train_iter consumes device-resident batches and
             # the host→device copy overlaps compute.
             from .data.prefetch import PrefetchLoader
-            # steps_per_call > 1 stacks k batches per dispatch — stage the
-            # stack once there instead of per-batch in the producer (avoids
-            # a stage-then-restack double copy)
+            # steps_per_call > 1 goes WINDOW-granular instead of staging
+            # per batch: compile_iter_fns wires set_window so the producer
+            # stacks+stages whole spc windows off the hot path (avoids a
+            # stage-then-restack double copy; docs/design.md §9)
             put = None if int(self.steps_per_call) > 1 \
                 else (lambda b: steps.put_batch(self.mesh, b,
                                                 self.batch_spec()))
@@ -366,6 +367,25 @@ class ModelBase:
                     f"steps_per_call={spc} exceeds n_batch_train="
                     f"{self.data.n_batch_train}: every epoch would train "
                     f"zero steps")
+        if hasattr(self.data, "set_window"):
+            # para_load + steps_per_call > 1: window-granular staging —
+            # the PrefetchLoader producer assembles whole spc windows (k
+            # sequential draws, host stack, steps.stage_window) so
+            # train_iter dequeues mesh-resident dispatch inputs and the
+            # recorder's `stage` bucket goes to ~0.  Re-wired on every
+            # compile so a recompile back to spc=1 reverts to per-batch
+            # production (a stale window setting would wedge the queue
+            # granularity).  para_load_window=false opts out (A/B).
+            # The fresh stage_fn closure makes set_window restart a live
+            # producer every recompile — deliberate: the closure may bind
+            # a new mesh/spec, and queued windows staged under the old
+            # one must not survive (the loader rewinds, nothing is lost).
+            if spc > 1 and self.config.get("para_load_window", True):
+                self.data.set_window(
+                    spc, lambda w: steps.stage_window(self.mesh, w,
+                                                      self.batch_spec()))
+            else:
+                self.data.set_window(0)
         self.train_fn = steps.build_train_step(self.mesh, self,
                                                self.exchanger, n_steps=spc)
         self.val_fn = steps.build_val_step(self.mesh, self)
@@ -375,12 +395,22 @@ class ModelBase:
 
     def train_iter(self, count: int, recorder=None) -> None:
         """One dispatch: one training step, or ``steps_per_call`` of them
-        (``count`` then names the LAST step of the call)."""
+        (``count`` then names the LAST step of the call).
+
+        Recorder buckets: ``load`` = waiting on the data source (pure
+        dequeue wait under para_load), ``stage`` = consumer-thread host
+        stack + ``device_put`` (~0 in window mode, where the producer
+        staged the window already), ``train`` = the dispatch itself."""
         k = int(self.steps_per_call)
+        # window mode (compile_iter_fns wired set_window): the loader
+        # dequeues a whole mesh-resident [k, ...] window
+        use_window = k > 1 and getattr(self.data, "window", 0) == k
         if recorder:
             recorder.start()
         if k == 1:
             batch = self.data.next_train_batch(count)
+        elif use_window:
+            batch = self.data.next_train_window(count)
         else:
             batches = [self.data.next_train_batch(count - k + 1 + j)
                        for j in range(k)]
@@ -392,8 +422,13 @@ class ModelBase:
             dev_batch = batch if steps.is_device_batch(batch) \
                 else steps.put_batch(self.mesh, batch, self.batch_spec())
         else:
-            dev_batch = steps.put_batch_stack(self.mesh, batches,
-                                              self.batch_spec())
+            # put_batch_stack passes a pre-staged device window through
+            dev_batch = steps.put_batch_stack(
+                self.mesh, batch if use_window else batches,
+                self.batch_spec())
+        if recorder:
+            recorder.end("stage")
+            recorder.start()
         self.step_state, cost, err = self.train_fn(
             self.step_state, dev_batch, jnp.float32(self.current_lr),
             self._step_rng, jnp.int32(count))
@@ -414,8 +449,12 @@ class ModelBase:
         # cadence, keeping dispatch asynchronous (device queue stays full).
         if recorder:
             # local rows, consistently: a device-resident (para_load-staged)
-            # batch has the GLOBAL shape, a host batch the per-host shape
-            n_images = int(batch["y"].shape[0]) * k
+            # batch has the GLOBAL shape, a host batch the per-host shape;
+            # a device window's leaves are [k, global_rows, ...]
+            if use_window:
+                n_images = int(batch["y"].shape[0]) * int(batch["y"].shape[1])
+            else:
+                n_images = int(batch["y"].shape[0]) * k
             if steps.is_device_batch(batch):
                 n_images //= jax.process_count()
             recorder.train_error(count, cost, err, n_images)

@@ -507,6 +507,50 @@ def is_device_batch(batch) -> bool:
     return bool(leaves) and isinstance(leaves[0], jax.Array)
 
 
+def is_device_window(window) -> bool:
+    """True if ``window`` is an already-staged ``[k, ...]`` stack: leaves
+    are mesh-resident ``jax.Array``s whose sharding leads with the
+    replicated scan axis (the ``P(None, *base)`` layout ``stage_window``
+    produces).  ``train_iter`` / ``put_batch_stack`` then dispatch without
+    touching the host — the parallel loader's window producer staged it."""
+    leaves = jax.tree_util.tree_leaves(window)
+    if not leaves or not isinstance(leaves[0], jax.Array):
+        return False
+    spec = getattr(leaves[0].sharding, "spec", None)
+    return spec is not None and len(spec) > 0 and spec[0] is None
+
+
+def stack_host(batches):
+    """Host-side ``[k, ...]`` stack of k per-step batches — THE window
+    layout ``stage_window`` ships to the mesh.  One definition, shared by
+    the consumer path (``put_batch_stack``) and the PrefetchLoader window
+    producer, so a layout tweak can't silently fork the two streams."""
+    return jax.tree.map(
+        lambda *xs: np.stack([np.asarray(x) for x in xs]), *batches)
+
+
+def stage_window(mesh: Mesh, window, spec=None):
+    """Place a ``[k, ...]``-leaved window pytree onto the mesh, sharded
+    ``P(None, *base)`` — the scan dim replicated, each step's slice split
+    per ``spec`` (default ``P(workers)`` row split).  THE staging
+    primitive for multi-step dispatch inputs: the PrefetchLoader's window
+    producer calls it off the hot path (the queue then holds
+    device-resident windows), and ``put_batch_stack`` routes its
+    consumer-thread stacking through it, so the sharding algebra lives in
+    exactly one place.
+
+    Multi-host: ``window`` is this host's LOCAL ``[k, local_rows, ...]``
+    stack; the global array is stitched from per-process shards without
+    cross-host copies (same contract as ``put_batch``)."""
+    base = tuple(spec) if spec is not None else (WORKER_AXIS,)
+    sh = NamedSharding(mesh, P(None, *base))
+    if jax.process_count() > 1:
+        from .mesh import make_per_host_array
+        return make_per_host_array(mesh, jax.tree.map(np.asarray, window),
+                                   sharding=sh)
+    return jax.tree.map(lambda x: jax.device_put(x, sh), window)
+
+
 def put_batch_stack(mesh: Mesh, batches, spec=None):
     """Stack k per-step batches into ``[k, ...]`` leaves for a
     ``steps_per_call`` multi-step dispatch, sharded ``P(None, *base)``
@@ -514,22 +558,23 @@ def put_batch_stack(mesh: Mesh, batches, spec=None):
     default ``P(workers)`` row split, sequence-parallel models also cut
     the time dim).
 
-    Multi-host (round-4): each host stacks its k LOCAL batches and the
-    global ``[k, global_rows, ...]`` array is stitched from per-process
-    shards without cross-host copies (same contract as ``put_batch``)."""
-    base = tuple(spec) if spec is not None else (WORKER_AXIS,)
-    sh = NamedSharding(mesh, P(None, *base))
-    if jax.process_count() > 1:
-        from .mesh import make_per_host_array
-        local = jax.tree.map(
-            lambda *xs: np.stack([np.asarray(x) for x in xs]), *batches)
-        return make_per_host_array(mesh, local, sharding=sh)
-    if all(is_device_batch(b) for b in batches):
-        return jax.tree.map(
-            lambda *xs: jax.device_put(jnp.stack(xs), sh), *batches)
-    return jax.tree.map(
-        lambda *xs: jax.device_put(np.stack([np.asarray(x) for x in xs]), sh),
-        *batches)
+    Fast path: a single pre-staged window (the para_load window
+    producer's output, ``is_device_window``) passes straight through —
+    zero consumer-thread work.  Otherwise the stack routes through
+    ``stage_window``; per-step batches already staged on device
+    (para_load at spc=1 granularity) stack with ``jnp.stack`` so the
+    reshard stays a device-side copy."""
+    if not isinstance(batches, (list, tuple)):
+        # one whole [k, ...] window, not a list of per-step batches: a
+        # pre-staged device window passes straight through; a host window
+        # (set_window with stage_fn=None) stages here
+        return batches if is_device_window(batches) \
+            else stage_window(mesh, batches, spec)
+    if jax.process_count() == 1 and all(is_device_batch(b) for b in batches):
+        window = jax.tree.map(lambda *xs: jnp.stack(xs), *batches)
+    else:
+        window = stack_host(batches)
+    return stage_window(mesh, window, spec)
 
 
 def put_batch(mesh: Mesh, batch, spec=None):

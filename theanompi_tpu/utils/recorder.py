@@ -25,7 +25,11 @@ import numpy as np
 # The reference reports "time per 5120 images" (40 batches of 128).
 IMAGES_PER_REPORT = 5120
 
-SECTIONS = ("train", "comm", "wait", "load", "val")
+# `load` = waiting on the data source (pure dequeue wait under para_load);
+# `stage` = consumer-thread host stack + device_put (≈0 when the parallel
+# loader's window producer stages dispatch inputs off the hot path) — the
+# split makes the producer/consumer overlap win visible in records
+SECTIONS = ("train", "comm", "wait", "load", "stage", "val")
 
 
 class Recorder:
@@ -125,6 +129,7 @@ class Recorder:
             "t_comm": self.t_sec["comm"],
             "t_wait": self.t_sec["wait"],
             "t_load": self.t_sec["load"],
+            "t_stage": self.t_sec["stage"],
             "images_per_sec": self.images_per_sec(),
             "images_per_sec_per_chip": self.images_per_sec() / max(self.size, 1),
             "time_per_5120": self.time_per_5120(),
@@ -135,7 +140,8 @@ class Recorder:
             print(
                 f"iter {count}: cost {cost:.4f} err {err:.4f} | "
                 f"train {rec['t_train']:.3f}s comm {rec['t_comm']:.3f}s "
-                f"wait {rec['t_wait']:.3f}s load {rec['t_load']:.3f}s | "
+                f"wait {rec['t_wait']:.3f}s load {rec['t_load']:.3f}s "
+                f"stage {rec['t_stage']:.3f}s | "
                 f"{rec['images_per_sec']:.1f} img/s "
                 f"({rec['images_per_sec_per_chip']:.1f}/chip, "
                 f"{rec['time_per_5120']:.2f}s per 5120)",

@@ -53,7 +53,13 @@ class Worker(MeshProcess):
                 if self.verbose:
                     print(f"resumed from epoch {restored}", flush=True)
 
-        count = start_epoch * model.data.n_batch_train
+        # steps_per_call > 1: each train_iter dispatch covers several
+        # steps; an epoch advances count by spc·(n_batch_train // spc)
+        # (drop-last windows), NOT n_batch_train — the resume count must
+        # replay the strided stream or the per-step rng fold desyncs from
+        # the uninterrupted run when spc doesn't divide n_batch_train
+        spc = max(1, int(getattr(model, "steps_per_call", 1)))
+        count = start_epoch * ((model.data.n_batch_train // spc) * spc)
         epochs = config.get("epochs", model.epochs)
         # Timeline tracing (beyond the reference's wall-clock buckets,
         # SURVEY.md §5): trace_dir enables a jax.profiler capture of
@@ -75,14 +81,12 @@ class Worker(MeshProcess):
                 print(f"profiler trace saved to {trace_dir}", flush=True)
 
         t0 = time.time()
-        # steps_per_call > 1: each train_iter dispatch covers several steps
-        # (count strides accordingly; leftover batches < spc roll to the
-        # next epoch's shuffle, like the reference's drop-last batching).
-        # When compile_iter_fns fused the rule's exchange cadence into the
+        # count strides by spc; leftover batches < spc roll to the next
+        # epoch's shuffle, like the reference's drop-last batching.  When
+        # compile_iter_fns fused the rule's exchange cadence into the
         # scanned dispatch (exchanger.fused), the Python exchange hook is
         # skipped outright — one XLA dispatch per k-step window covers the
         # steps AND their cadenced exchanges.
-        spc = max(1, int(getattr(model, "steps_per_call", 1)))
         fused = bool(getattr(self.exchanger, "fused", False))
         # failure detection (SURVEY §5): stall_timeout seconds without an
         # iteration completing → off-thread diagnostic (hung collectives /
@@ -118,12 +122,18 @@ class Worker(MeshProcess):
                             import jax
                             jax.profiler.start_trace(trace_dir)
                             trace_pending = False
-                            trace_stop_at = count + trace_iters
+                            # clamp the window to the dispatch stride:
+                            # count advances by spc per iteration, so the
+                            # old `count + 1 >= stop` check overshot by up
+                            # to spc-1 iterations — round trace_iters up
+                            # to whole windows instead
+                            trace_stop_at = count + max(
+                                1, (trace_iters + spc - 1) // spc) * spc
                         model.train_iter(count, self.recorder)
                         if not fused:
                             self.exchanger.exchange(self.recorder, count)
                         watchdog.beat(f"epoch {epoch} iter {count}")
-                        if trace_stop_at is not None and count + 1 >= trace_stop_at:
+                        if trace_stop_at is not None and count + spc >= trace_stop_at:
                             _stop_trace()
                         self.recorder.print_train_info(count, stride=spc)
 
