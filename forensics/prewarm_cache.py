@@ -1,188 +1,30 @@
 #!/usr/bin/env python
-"""Pre-compile the heavy matrix-row programs for TPU v5e WITHOUT the tunnel.
+"""PROMOTED to ``scripts/prewarm_cache.py`` (round 8) — this shim forwards.
 
-Builds each staged model's real train-step program over a topology-AOT
-v5e mesh (local libtpu; see forensics/aot_compile_probe.py for the
-engine proof) and compiles it with the bench's persistent compile cache
-dir configured.  IF the runtime's cache key for the same program matches
-(same platform 'tpu', same serialized HLO, same jax version — the open
-variable is the terminal's libtpu/platform_version string), the first
-healthy tunnel window skips straight past the wedge-correlated compiles
-to the measurements.  If the keys don't match, the extra cache entries
-are simply ignored — the experiment cannot make anything worse.
+The round-5 forensic experiment this file held (compile the staged matrix
+rows for v5e off-line, hope the opaque XLA persistent-cache key matches in
+the hardware window) is superseded: the promoted script serializes the
+compiled executables through ``theanompi_tpu/utils/compile_cache.py`` —
+the same content-addressed store ``compile_iter_fns`` and ``bench.py``
+read — under a key the repo controls, and its row list comes from
+``scripts/rows.py`` (shared with the matrix scripts) instead of a
+hand-synced CONFIGS copy.  The round-5 measurements (all seven staged
+programs compiled on the 1-vCPU host, 26–270 s each, tunnel wedged
+throughout) are recorded in WEDGE.md and in the promoted script's
+docstring.
 
-Measured 2026-07-31 (tunnel wedged throughout): all seven staged
-programs compiled for v5e on this 1-vCPU host — alexnet-b128[-spc4]
-~47 s each, alexnet-b256-spc4 57-67 s, vgg16-b32 119 s, resnet50-b32
-186 s, googlenet-b32 247-270 s, cifar10-b128 26 s — and cache entries
-were written (/tmp/jax_bench_cache 3 -> 18 files).  Caveat, observed:
-a topology-AOT RE-run recompiles at full cost with the entry count
-stable, i.e. this venue's own cache READ path does not hit; whether the
-axon runtime's compile reads these entries is unresolved until a
-healthy window (runtime->runtime caching is the r4-proven path).  Risk
-either way: none.
-
-Run under a killable timeout (repo probe convention — a stray backend
-touch on the wedged tunnel hangs forever; faulthandler armed):
+Historical invocation still works and now prewarms the REAL store:
 
     timeout -s KILL 3000 python -u forensics/prewarm_cache.py
-
-Writes one status line per config; a per-config failure skips to the
-next (shapes/dtypes must mirror bench.py's call exactly for a key to be
-useful, but a mismatch only wastes the entry).
 """
 
-import faulthandler
 import os
+import runpy
 import sys
-import time
-
-os.environ.setdefault("TPU_SKIP_MDS_QUERY", "1")
-faulthandler.enable()
-faulthandler.dump_traceback_later(600, repeat=True, file=sys.stderr)
-
-sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
-
-import numpy as np                                   # noqa: E402
-import jax                                           # noqa: E402
-
-# host-side array work (param init, synthetic batches) must run on the
-# CPU backend — the axon default would hang on the wedged tunnel
-jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_compilation_cache_dir",
-                  os.environ.get("BENCH_COMPILE_CACHE",
-                                 "/tmp/jax_bench_cache"))
-jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
-jax.config.update("jax_default_prng_impl", "rbg")   # bench default
-
-import jax.numpy as jnp                              # noqa: E402
-from jax.experimental import topologies              # noqa: E402
-from jax.sharding import Mesh                        # noqa: E402
-
-from theanompi_tpu.models.registry import MODELS     # noqa: E402
-from theanompi_tpu.parallel import steps             # noqa: E402
-from theanompi_tpu.parallel.exchanger import get_exchanger  # noqa: E402
-from theanompi_tpu.parallel.mesh import WORKER_AXIS  # noqa: E402
-
-# (label, model, batch override, steps_per_call, extra config) — the
-# wedge-correlated heavy compiles first (they are what a short window
-# cannot afford).  Mirrors scripts/perf_matrix_r5.sh: spc8 rows carry
-# synthetic_batches=8 (BENCH_SYNTH_BATCHES=8 there), the bnbf16 rows the
-# bn_norm_dtype lever; the spc=1 b256 entry exists for bench.py's
-# spc>1 MFU flop-count compile (a cache hit only if the spc=1 program
-# for the same batch is already compiled).
-CONFIGS = [
-    ("alexnet-b128-spc4", "alexnet", None, 4, {}),
-    ("alexnet-b128", "alexnet", None, 1, {}),
-    ("vgg16-b32", "vgg16", None, 1, {}),
-    ("resnet50-b32", "resnet50", None, 1, {}),
-    ("googlenet-b32", "googlenet", None, 1, {}),
-    ("alexnet-b256-spc4", "alexnet", 256, 4, {}),
-    ("alexnet-b256", "alexnet", 256, 1, {}),
-    ("cifar10-b128", "cifar10", None, 1, {}),
-    # spc8 scan bodies — the biggest programs per model
-    ("alexnet-b128-spc8", "alexnet", None, 8, {"synthetic_batches": 8}),
-    ("googlenet-b32-spc8", "googlenet", None, 8, {"synthetic_batches": 8}),
-    ("resnet50-b32-spc8", "resnet50", None, 8, {"synthetic_batches": 8}),
-    ("resnet50-b32-spc8-bnbf16", "resnet50", None, 8,
-     {"synthetic_batches": 8, "bn_norm_dtype": "bfloat16"}),
-    # bf16-BN lever + batch-headroom rows
-    ("resnet50-b32-bnbf16", "resnet50", None, 1,
-     {"bn_norm_dtype": "bfloat16"}),
-    ("resnet50-b64", "resnet50", 64, 1, {}),
-    ("resnet50-b128", "resnet50", 128, 1, {}),
-    ("resnet50-b128-bnbf16", "resnet50", 128, 1,
-     {"bn_norm_dtype": "bfloat16"}),
-    ("resnet50-b128-spc4", "resnet50", 128, 4, {}),
-    ("googlenet-b128", "googlenet", 128, 1, {}),
-    ("googlenet-b128-spc4", "googlenet", 128, 4, {}),
-    ("vgg16-b64", "vgg16", 64, 1, {}),
-    ("vgg16-b32-spc4", "vgg16", None, 4, {}),
-]
-
-
-def sds(tree):
-    return jax.tree.map(
-        lambda x: jax.ShapeDtypeStruct(np.shape(x), np.asarray(x).dtype)
-        if not hasattr(x, "aval") else
-        jax.ShapeDtypeStruct(x.shape, x.dtype), tree)
-
-
-def prewarm(label, model_name, batch, spc, topo_mesh, cfg_extra) -> str:
-    import importlib
-    modelfile, modelclass, extra = MODELS[model_name]
-    config = {"mesh": topo_mesh, "size": 1, "rank": 0, "verbose": False,
-              **extra, **cfg_extra}
-    if batch:
-        config["batch_size"] = batch
-    if spc > 1:
-        config["steps_per_call"] = spc
-    model = getattr(importlib.import_module(modelfile), modelclass)(config)
-    exchanger = get_exchanger("bsp", config)
-    exchanger.prepare(topo_mesh, model)
-
-    # mirror compile_iter_fns' state WITHOUT device placement (topology
-    # devices are not addressable): abstract avals shaped like the boxed
-    # [n_workers=1, ...] state
-    unboxed = {"params": model.params,
-               "opt_state": model.opt.init(model.params),
-               "bn_state": model.bn_state,
-               "extra": exchanger.extra_state_template()}
-    state_sds = {k: jax.tree.map(
-        lambda x: jax.ShapeDtypeStruct((1,) + tuple(np.shape(x)),
-                                       np.asarray(x).dtype), v)
-        for k, v in unboxed.items()}
-
-    if spc > 1:
-        batches = [model.data.next_train_batch(j) for j in range(spc)]
-        host = {k: np.stack([np.asarray(b[k]) for b in batches])
-                for k in batches[0]}
-    else:
-        host = {k: np.asarray(v)
-                for k, v in model.data.next_train_batch(0).items()}
-    batch_sds = sds(host)
-
-    train_fn = steps.build_train_step(topo_mesh, model, exchanger,
-                                      n_steps=spc)
-    rng_aval = jax.ShapeDtypeStruct((), jax.random.key(0).dtype)
-    t0 = time.time()
-    lowered = train_fn.lower(state_sds, batch_sds,
-                             jax.ShapeDtypeStruct((), jnp.float32),
-                             rng_aval, jax.ShapeDtypeStruct((), jnp.int32))
-    t_l = time.time() - t0
-    t0 = time.time()
-    lowered.compile()
-    return (f"{label}: lowered {t_l:.1f}s, compiled for v5e in "
-            f"{time.time() - t0:.1f}s")
-
-
-def main() -> int:
-    topo = topologies.get_topology_desc(platform="tpu",
-                                        topology_name="v5e:2x2x1")
-    topo_mesh = Mesh(np.array(topo.devices[:1]), (WORKER_AXIS,))
-    # the topology-AOT venue re-pays full compiles on re-run (its cache
-    # read path does not hit — see docstring), so completed labels are
-    # tracked in a sidecar and skipped; delete the file to force redo
-    done_file = "/tmp/prewarm_done.txt"
-    done = set(open(done_file).read().split()) \
-        if os.path.exists(done_file) else set()
-    for label, model_name, batch, spc, cfg_extra in CONFIGS:
-        if label in done:
-            print(f"{label}: already prewarmed — skip", flush=True)
-            continue
-        try:
-            print(prewarm(label, model_name, batch, spc, topo_mesh,
-                          cfg_extra), flush=True)
-            with open(done_file, "a") as f:
-                f.write(label + "\n")
-        except Exception as e:
-            print(f"{label}: FAILED {type(e).__name__}: {str(e)[:300]}",
-                  flush=True)
-    cache = jax.config.jax_compilation_cache_dir
-    n = len(os.listdir(cache)) if os.path.isdir(cache) else 0
-    print("cache entries now:", n, "in", cache, flush=True)
-    return 0
-
 
 if __name__ == "__main__":
-    raise SystemExit(main())
+    sys.argv = [sys.argv[0], "--rows", "heavy",
+                "--platform", "topology:v5e:2x2x1"] + sys.argv[1:]
+    runpy.run_path(os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "scripts", "prewarm_cache.py"),
+        run_name="__main__")

@@ -1,0 +1,66 @@
+#!/usr/bin/env bash
+# Round-8 perf matrix — the executable-cache round (ISSUE 3 tentpole):
+# compile once off-line, hit instantly in the hardware window.
+#
+# Order of operations is the whole point:
+#   1. prewarm: scripts/prewarm_cache.py compiles every staged row's
+#      program into the AOT executable store (content-addressed,
+#      utils/compile_cache.py) — safe to run BEFORE the window, with the
+#      tunnel wedged, on this 1-vCPU host (topology venue).
+#   2. canary: one cheap row must report `cache: hit` — if it doesn't,
+#      the key composition drifted and every big row would pay its full
+#      compile on the clock, so the pass ABORTS loudly instead of
+#      silently burning the window (the round-5 failure mode).
+#   3. the scans: every row JSON now carries compile_secs + cache, the
+#      evidence the round-5 verdict asked the next window to produce.
+# Rows come from scripts/rows.py (the same manifest prewarm consumed —
+# shapes can never drift between prewarm and measurement).
+# Rows already measured in the out-file are skipped (re-runnable after a
+# wedge, same convention as perf_matrix_r6/r7.sh).
+#   ./scripts/perf_matrix_r8.sh [out_file]
+set -u -o pipefail
+OUT="${1:-perf_matrix_r8.jsonl}"
+cd "$(dirname "$0")/.."
+. scripts/_bench_row.sh
+
+CACHE="${BENCH_COMPILE_CACHE:-/tmp/jax_bench_cache}"
+
+# 1. prewarm (idempotent: cached rows skip in ~ms).  On the TPU host the
+# live backend venue is the strongest guarantee; fall back to the v5e
+# topology venue when the tunnel can't answer.
+echo "== prewarm -> $CACHE" >&2
+timeout -s KILL 3000 python -u scripts/prewarm_cache.py --rows r8 \
+    --cache "$CACHE" --platform tpu >&2 \
+  || timeout -s KILL 3000 python -u scripts/prewarm_cache.py --rows r8 \
+    --cache "$CACHE" --platform topology:v5e:2x2x1 >&2 \
+  || echo "== prewarm failed (rows will compile on the clock)" >&2
+
+# 2. canary: the cheapest staged row must be a cache hit before the big
+# scans are attempted.  || exit — a miss here means every heavy row
+# would recompile on the clock; stop and investigate instead.
+echo "== canary: cifar10-b128-spc4 must report cache: hit" >&2
+canary=$(env BENCH_SKIP_PROBE="${BENCH_SKIP_PROBE:-1}" \
+             BENCH_MODEL=cifar10 BENCH_SPC=4 BENCH_ITERS=5 \
+             BENCH_COMPILE_CACHE="$CACHE" python bench.py 2>>"${OUT%.jsonl}.err" | tail -1)
+echo "$canary" | python -c '
+import json, sys
+row = json.loads(sys.stdin.read())
+cache = row.get("cache")
+assert cache == "hit", (
+    f"canary row is cache: {cache!r}, not \"hit\" — the prewarm key does "
+    f"not match what compile_iter_fns requests (row: {row}); aborting "
+    f"before the heavy rows burn the window on compiles")
+print("== canary hit (compile %ss)" % row.get("compile_secs"), file=sys.stderr)
+' || exit 1
+# recorded under its own label: the canary is a degraded measurement
+# (5 iters, no MFU) — the REAL cifar10-b128-spc4 row must still run in
+# step 3, and _bench_row.sh's resume-skip matches on the config label
+echo "{\"config\": \"cifar10-b128-spc4-canary\", \"result\": $canary}" >> "$OUT"
+
+# 3. the staged rows, straight from the shared manifest
+while read -r line; do
+  eval "run $line"
+done < <(python scripts/rows.py --round r8 --sh)
+
+python scripts/merge_matrix.py "$OUT"
+cat "$OUT"

@@ -1,0 +1,145 @@
+#!/usr/bin/env python
+"""The matrix-row manifest: ONE definition of every staged bench config.
+
+Before round 8, each ``perf_matrix_r*.sh`` embedded its row definitions as
+inline env assignments and ``forensics/prewarm_cache.py`` carried its own
+parallel CONFIGS list — two hand-synced copies of (model, batch, rule, spc,
+flags).  A drift between them silently forfeits the executable-cache hit
+the prewarm exists to guarantee (the program key is content-addressed: a
+shape that merely LOOKS the same misses).  This module is the single
+source both sides consume:
+
+* ``scripts/perf_matrix_r8.sh`` (and later rounds) iterate
+  ``python scripts/rows.py --round 8 --sh`` — one ``label ENV=V ...`` line
+  per row, fed straight to ``_bench_row.sh``'s ``run``;
+* ``scripts/prewarm_cache.py`` builds each row's program through
+  ``bench.bench_row_config(row.env)`` — the SAME env→config assembly the
+  bench inner uses — and compiles it into the executable cache.
+
+Row labels follow the ``_cfg_matches`` conventions in bench.py
+(model[-bN][-rule][-strategy][-spcK][-realdata][-winload][-...]) so
+``last_good`` fallbacks and resume-skip logic keep working unchanged.
+"""
+
+from __future__ import annotations
+
+import argparse
+import shlex
+import sys
+from typing import Dict, List, NamedTuple, Tuple
+
+
+class Row(NamedTuple):
+    label: str
+    env: Dict[str, str]          # BENCH_* settings that shape the row
+    rounds: Tuple[str, ...]      # matrix rounds / groups this row belongs to
+
+
+def _r(label: str, rounds: str, **env) -> Row:
+    return Row(label, {k: str(v) for k, v in env.items()},
+               tuple(rounds.split()))
+
+
+# "heavy" = the wedge-correlated long compiles (26–270 s each measured in
+# round 5, forensics/prewarm_cache.py docstring) — the prewarm default: what
+# a short hardware window cannot afford to compile on the clock.
+ROWS: List[Row] = [
+    # -- round-8 canary + acceptance rows (executable-cache proof) --------
+    _r("cifar10-b128-spc4", "r8 heavy", BENCH_MODEL="cifar10", BENCH_SPC=4),
+    _r("alexnet-b128-spc4", "r8 heavy", BENCH_MODEL="alexnet", BENCH_SPC=4),
+    _r("alexnet-b128", "r8 heavy", BENCH_MODEL="alexnet"),
+    _r("vgg16-b32", "r8 heavy", BENCH_MODEL="vgg16"),
+    _r("resnet50-b32", "r8 heavy", BENCH_MODEL="resnet50"),
+    _r("googlenet-b32", "r8 heavy", BENCH_MODEL="googlenet"),
+    _r("cifar10-b128", "r8 heavy", BENCH_MODEL="cifar10"),
+    # -- batch-headroom + dtype-lever rows (round-5 staging) -------------
+    _r("alexnet-b256-spc4", "heavy", BENCH_MODEL="alexnet", BENCH_BATCH=256,
+       BENCH_SPC=4),
+    _r("alexnet-b256", "heavy", BENCH_MODEL="alexnet", BENCH_BATCH=256),
+    _r("resnet50-b32-bnbf16", "heavy", BENCH_MODEL="resnet50",
+       BENCH_BN_DTYPE="bfloat16"),
+    _r("resnet50-b64", "heavy", BENCH_MODEL="resnet50", BENCH_BATCH=64),
+    _r("resnet50-b128", "heavy", BENCH_MODEL="resnet50", BENCH_BATCH=128),
+    _r("resnet50-b128-bnbf16", "heavy", BENCH_MODEL="resnet50",
+       BENCH_BATCH=128, BENCH_BN_DTYPE="bfloat16"),
+    _r("resnet50-b128-spc4", "heavy", BENCH_MODEL="resnet50",
+       BENCH_BATCH=128, BENCH_SPC=4),
+    _r("googlenet-b128", "heavy", BENCH_MODEL="googlenet", BENCH_BATCH=128),
+    _r("googlenet-b128-spc4", "heavy", BENCH_MODEL="googlenet",
+       BENCH_BATCH=128, BENCH_SPC=4),
+    _r("vgg16-b64", "heavy", BENCH_MODEL="vgg16", BENCH_BATCH=64),
+    _r("vgg16-b32-spc4", "heavy", BENCH_MODEL="vgg16", BENCH_SPC=4),
+    # -- spc8 scan bodies: the biggest programs per model (round 5/6) ----
+    _r("alexnet-b128-spc8", "heavy", BENCH_MODEL="alexnet", BENCH_SPC=8,
+       BENCH_SYNTH_BATCHES=8),
+    _r("googlenet-b32-spc8", "heavy", BENCH_MODEL="googlenet", BENCH_SPC=8,
+       BENCH_SYNTH_BATCHES=8),
+    _r("resnet50-b32-spc8", "heavy", BENCH_MODEL="resnet50", BENCH_SPC=8,
+       BENCH_SYNTH_BATCHES=8),
+    _r("resnet50-b32-spc8-bnbf16", "heavy", BENCH_MODEL="resnet50",
+       BENCH_SPC=8, BENCH_SYNTH_BATCHES=8, BENCH_BN_DTYPE="bfloat16"),
+    # -- round-6 fused-cadence rows --------------------------------------
+    _r("alexnet-b128-easgd-spc8", "r8 heavy", BENCH_MODEL="alexnet",
+       BENCH_RULE="easgd", BENCH_SPC=8, BENCH_SYNTH_BATCHES=8),
+    _r("vgg16-b32-easgd-spc8", "r8 heavy", BENCH_MODEL="vgg16",
+       BENCH_RULE="easgd", BENCH_SPC=8, BENCH_SYNTH_BATCHES=8),
+    _r("alexnet-b128-gosgd-spc8", "heavy", BENCH_MODEL="alexnet",
+       BENCH_RULE="gosgd", BENCH_SPC=8, BENCH_SYNTH_BATCHES=8),
+    # -- round-7 window-staging rows (same programs as their plain-spc
+    #    siblings — the executable cache dedups them by content) ---------
+    _r("cifar10-b128-spc4-winload", "r7", BENCH_MODEL="cifar10",
+       BENCH_SPC=4, BENCH_WINLOAD=1),
+    _r("alexnet-b128-spc4-winload", "r7 r8", BENCH_MODEL="alexnet",
+       BENCH_SPC=4, BENCH_WINLOAD=1),
+    _r("vgg16-b32-easgd-spc8-winload", "r7 r8", BENCH_MODEL="vgg16",
+       BENCH_RULE="easgd", BENCH_SPC=8, BENCH_WINLOAD=1,
+       BENCH_SYNTH_BATCHES=8),
+    _r("alexnet-b128-realdata-spc4-winload", "r7 r8", BENCH_MODEL="alexnet",
+       BENCH_SPC=4, BENCH_REAL_DATA=1, BENCH_WINLOAD=1),
+]
+
+
+def rows(selector: str = "all") -> List[Row]:
+    """Rows for a selector: ``all``, a group/round tag (``r8``, ``heavy``),
+    or a comma-separated list of exact labels."""
+    if selector == "all":
+        return list(ROWS)
+    by_label = {r.label: r for r in ROWS}
+    if "," in selector or selector in by_label:
+        out = []
+        for lab in selector.split(","):
+            if lab not in by_label:
+                raise SystemExit(f"rows.py: unknown row label {lab!r}")
+            out.append(by_label[lab])
+        return out
+    picked = [r for r in ROWS if selector in r.rounds]
+    if not picked:
+        raise SystemExit(f"rows.py: selector {selector!r} matches nothing "
+                         f"(groups: {sorted(set(sum((list(r.rounds) for r in ROWS), [])))})")
+    return picked
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--round", default="all", metavar="SEL",
+                   help="group tag (r7/r8/heavy), 'all', or label[,label...]")
+    p.add_argument("--sh", action="store_true",
+                   help="emit one shell line per row: label ENV=V ... "
+                        "(for `run` in scripts/_bench_row.sh)")
+    p.add_argument("--labels", action="store_true",
+                   help="emit labels only")
+    args = p.parse_args(argv)
+    for r in rows(args.round):
+        if args.labels:
+            print(r.label)
+        elif args.sh:
+            print(" ".join([shlex.quote(r.label)] +
+                           [f"{k}={shlex.quote(v)}"
+                            for k, v in sorted(r.env.items())]))
+        else:
+            print(f"{r.label:40s} {r.env}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

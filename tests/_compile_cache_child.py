@@ -1,0 +1,116 @@
+#!/usr/bin/env python
+"""Standalone child for the executable-cache round-trip test.
+
+A FRESH process per invocation — the cache's whole claim is surviving
+process death, so the test must cross a process boundary (same pattern as
+``twoproc_helper.py``).  Builds the conftest TinyModel shape (defined
+inline: conftest is pytest-session state), compiles through
+``compile_iter_fns`` with the given cache dir, runs a few deterministic
+train dispatches + one val pass, and dumps outputs + compile metadata for
+the parent to compare bit-for-bit across cold (fresh XLA compile) and warm
+(deserialize) runs.
+
+    python tests/_compile_cache_child.py <cache_dir|off> <out.npz> <rule> <spc>
+"""
+
+import json
+import os
+import sys
+import time
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax                                              # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import jax.numpy as jnp                                 # noqa: E402
+import numpy as np                                      # noqa: E402
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(
+    __file__))))
+
+from theanompi_tpu.models import layers as L            # noqa: E402
+from theanompi_tpu.models.data import DataBase          # noqa: E402
+from theanompi_tpu.models.model_base import ModelBase   # noqa: E402
+from theanompi_tpu.parallel.exchanger import get_exchanger  # noqa: E402
+from theanompi_tpu.utils import helper_funcs            # noqa: E402
+
+
+class ChildData(DataBase):
+    DIM = 16
+
+    def __init__(self, config=None, batch_size=8):
+        super().__init__(config, batch_size)
+        rng = np.random.RandomState(7)
+        w = rng.randn(self.DIM)
+
+        def make(n, seed):
+            r = np.random.RandomState(seed)
+            x = r.randn(n, self.DIM).astype(np.float32)
+            return x, (x @ w > 0).astype(np.int32)
+
+        self.x_train, self.y_train = make(256, 11)
+        self.x_val, self.y_val = make(64, 22)
+        self._finalize()
+
+
+class ChildModel(ModelBase):
+    batch_size = 8
+    epochs = 1
+    learning_rate = 0.05
+    momentum = 0.9
+    weight_decay = 0.0
+    seed = 3
+
+    def build_model(self):
+        dim = ChildData.DIM
+        self.seq = L.Sequential([
+            L.FC(dim, 32, w_init="he", name="fc1"),
+            L.FC(32, 2, w_init=("normal", 0.01), activation=None,
+                 name="out"),
+        ])
+        self.data = ChildData(self.config, self.batch_size)
+
+
+def main() -> int:
+    cache_dir, out_path, rule, spc = sys.argv[1:5]
+    spc = int(spc)
+    config = {"verbose": False, "steps_per_call": spc,
+              "compile_cache": "" if cache_dir == "off" else cache_dir}
+    model = ChildModel(config)
+    exchanger = get_exchanger(rule, model.config)
+    t0 = time.time()
+    model.compile_iter_fns(exchanger)
+    compile_wall = time.time() - t0
+
+    model.data.shuffle_data(0)
+    costs = []
+    count = 0
+    for _ in range(3):
+        count += spc
+        model.train_iter(count)
+        if not getattr(exchanger, "fused", False):
+            exchanger.exchange(None, count)
+        costs.append(float(model.current_info["cost"]))
+    model.begin_val()
+    model.val_iter(count)
+    model.end_val()
+    params = model.canonical_host_params()
+    flat = np.concatenate([np.asarray(x, np.float32).ravel()
+                           for x in jax.tree_util.tree_leaves(params)])
+    np.savez(out_path, params=flat, costs=np.asarray(costs, np.float64),
+             compile_wall=compile_wall,
+             info=json.dumps(model.compile_info, default=str))
+    print(json.dumps({"train_cache": model.compile_info["train"]["cache"],
+                      "compile_wall": round(compile_wall, 3),
+                      "compile_secs":
+                      model.compile_info["total_compile_secs"]}))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -143,6 +143,10 @@ def test_wrapper_cpu_success_end_to_end():
     assert rc == 0, out
     assert set(out) >= {"metric", "value", "unit", "vs_baseline"}
     assert out["value"] > 0 and "cpu" in out["metric"]
+    # executable-cache evidence rides every row (ISSUE 3): where the train
+    # program came from and what its compile cost — a fresh tmp cache dir,
+    # so this cold row must be an honest miss with a real compile time
+    assert out["cache"] == "miss" and out["compile_secs"] > 0, out
 
 
 def test_wrapper_timeout_kills_and_reports():

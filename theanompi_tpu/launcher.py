@@ -69,12 +69,23 @@ def main(argv=None) -> int:
                    help="crash-loop guard: a nonzero exit within SEC "
                         "seconds is treated as unrecoverable (config/usage "
                         "error) and is NOT retried; 0 = always retry")
+    p.add_argument("--compile-cache", default=None, metavar="DIR",
+                   help="persistent AOT executable cache dir "
+                        "(utils/compile_cache): compile_iter_fns "
+                        "deserializes pre-built executables instead of "
+                        "recompiling — pre-populate off-line with "
+                        "scripts/prewarm_cache.py; supervised restarts and "
+                        "checkpoint resumes then skip the XLA compile "
+                        "(defaults to $THEANOMPI_COMPILE_CACHE if set)")
     p.add_argument("config", nargs="*", help="key=value model/worker config")
     args = p.parse_args(argv)
 
     kv = list(args.config)
     if args.n_workers:
         kv.append(f"n_workers={args.n_workers}")
+    if args.compile_cache and \
+            not any(c.startswith("compile_cache=") for c in kv):
+        kv.append(f"compile_cache={args.compile_cache}")
 
     if args.num_hosts > 1:
         cmds = [compose_worker_cmd(args.rule, args.modelfile, args.modelclass,

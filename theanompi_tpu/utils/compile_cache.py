@@ -1,0 +1,424 @@
+"""Persistent AOT executable cache: compile once, deserialize forever.
+
+Theano-MPI paid one Theano compile per worker at session start and amortized
+it over the whole run; this rebuild pays the equivalent XLA compile on EVERY
+process start — and round-5 forensics (WEDGE.md) measured 26–270 s per
+program over the tunnel, with a mid-pass wedge discarding the warm
+executables along with the process.  The XLA *compilation* cache
+(``jax_compilation_cache_dir``) was supposed to absorb this, but its key is
+opaque to us and the round-5 experiment showed the topology-AOT venue's
+read path simply not hitting.  This module sidesteps the question by
+serializing the compiled executables OURSELVES
+(``jax.experimental.serialize_executable``) under a key WE control.
+
+**The key** (content-addressed, sha256 over a canonical JSON):
+
+* the StableHLO hash of the lowered program — shapes, dtypes, shardings,
+  the whole traced computation;
+* ``jax.__version__`` / ``jaxlib.__version__`` (an executable must never
+  be loaded into a different runtime than compiled it);
+* platform + device kind of the target mesh (``tpu``/``cpu``,
+  ``TPU v5 lite``/...) — deliberately NOT ``platform_version``: that was
+  the opaque variable suspected of breaking the round-5 XLA-cache
+  experiment, and PJRT executables are compatible across patch builds of
+  the same device kind (a genuinely incompatible blob still fails loudly
+  at deserialize and falls back to a fresh compile);
+* mesh axis names + shape;
+* the donation signature (which flat args are donated);
+* the PRNG impl (``rbg`` vs ``threefry2x32`` change the key dtype AND the
+  lowered program, but belt-and-braces);
+* caller extras (fn name, rule signature, steps_per_call, ...).
+
+**The fallback ladder** (``get_or_compile``): hit (deserialize, ~ms) →
+deserialize-fallback (corrupt blob / version drift → fresh compile,
+counter incremented, entry rewritten) → fresh compile + serialize →
+serialize-unsupported (backend can't export → fresh compile result is
+still returned; only persistence is lost).  The cache can never make a
+run fail: every cache-side error degrades to the plain compile.
+
+Entry format, one file per key (``<key>.jexec``): a one-line JSON header
+(versions, label, platform — checked BEFORE unpickling) followed by the
+pickled ``(payload, in_tree, out_tree)`` triple from
+``serialize_executable.serialize``.  A ``manifest.json`` sidecar holds
+human-readable metadata per key for ``scripts/prewarm_cache.py`` and
+post-mortems.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import pickle
+import sys
+import time
+from typing import Any, Dict, Optional, Tuple
+
+ENV_CACHE_DIR = "THEANOMPI_COMPILE_CACHE"
+
+_FORMAT = 1
+_MAGIC = "theanompi-aot"
+
+# one shared instance per directory, so hit counters aggregate across every
+# compile surface of the process (model, bench, prewarm)
+_INSTANCES: Dict[str, "CompileCache"] = {}
+
+
+class _EntryMismatch(Exception):
+    """Header/runtime disagreement (version drift, truncation) — triggers
+    the deserialize-fallback rung, never an error."""
+
+
+def _versions() -> Tuple[str, str]:
+    import jax
+    import jaxlib
+    return jax.__version__, jaxlib.__version__
+
+
+def _mesh_device(mesh):
+    """First device of the target mesh — works for runtime meshes AND
+    topology-AOT meshes (non-addressable devices still report platform and
+    device_kind, which is all the key reads)."""
+    if mesh is None:
+        import jax
+        return jax.devices()[0]
+    return next(iter(mesh.devices.flat))
+
+
+def _donation_signature(lowered) -> Tuple:
+    """Which flat args are donated, from the Lowered's args_info (best
+    effort — absent attributes degrade to an empty signature rather than
+    blocking the cache)."""
+    try:
+        import jax
+        return tuple(bool(getattr(a, "donated", False))
+                     for a in jax.tree_util.tree_leaves(lowered.args_info))
+    except Exception:
+        return ()
+
+
+def program_key(lowered, mesh=None, extra: Optional[dict] = None) -> str:
+    """Content-addressed key for one lowered program on one target."""
+    import jax
+    dev = _mesh_device(mesh)
+    jax_v, jaxlib_v = _versions()
+    parts = {
+        "stablehlo": hashlib.sha256(
+            lowered.as_text().encode("utf-8")).hexdigest(),
+        "jax": jax_v,
+        "jaxlib": jaxlib_v,
+        "platform": getattr(dev, "platform", "?"),
+        "device_kind": getattr(dev, "device_kind", "?"),
+        "mesh": None if mesh is None else
+        {"axes": list(mesh.axis_names),
+         "shape": [int(mesh.shape[a]) for a in mesh.axis_names]},
+        "donate": list(_donation_signature(lowered)),
+        "prng": str(jax.config.jax_default_prng_impl),
+        "extra": extra or {},
+    }
+    blob = json.dumps(parts, sort_keys=True, default=str)
+    return hashlib.sha256(blob.encode("utf-8")).hexdigest()[:40]
+
+
+def donated_load_safe(mesh=None) -> bool:
+    """Whether this backend is trusted to EXECUTE deserialized executables
+    whose inputs are donated (input-output aliased).
+
+    On the CPU backend of this jaxlib (0.4.36), repeatedly executing a
+    DESERIALIZED donated SPMD executable corrupts the heap (glibc
+    "corrupted double-linked list" after 2–3 calls; reproduced with a raw
+    8-device shard_map momentum step, aliasing metadata and donated flags
+    intact across the round-trip — the same fragile serialization layer
+    whose cache-write path segfaulted test_3d_mesh in round 6,
+    tests/conftest.py NOTE).  Donation-FREE deserialized executables are
+    stable (50-call soak).  So on non-TPU platforms the AOT cache compiles
+    and loads donation-free variants of the donated programs — identical
+    math, transiently higher memory, and a distinct cache key (the
+    donation signature is part of the key, so the two variants can share
+    a directory).  ``THEANOMPI_AOT_DONATE=1|0`` overrides the platform
+    default (e.g. to re-test a fixed jaxlib)."""
+    env = os.environ.get("THEANOMPI_AOT_DONATE")
+    if env is not None:
+        return env == "1"
+    return getattr(_mesh_device(mesh), "platform", "") == "tpu"
+
+
+def key_extra(fn: str, model=None, exchanger=None,
+              spc: Optional[int] = None) -> dict:
+    """The caller-extras dict EVERY compile surface must build the same way
+    (model_base, bench.py, scripts/prewarm_cache.py) — a drifted extras
+    dict silently forfeits the prewarm hit, so the composition lives here.
+
+    The rule signature is belt-and-braces over the HLO hash: two rules
+    that happened to lower identically must still never share an entry.
+    ``spc`` is stamped only when the caller passes it (the train surface):
+    spc-independent programs (val, the standalone exchange, zero-shadow,
+    fsdp-val) are byte-identical across spc variants of a row, and keying
+    them per-spc would compile and store one redundant twin per variant.
+    """
+    extra: Dict[str, Any] = {"fn": str(fn)}
+    if model is not None:
+        extra["model"] = type(model).__name__
+        extra["n_subb"] = int(getattr(model, "n_subb", 1))
+    if spc is not None:
+        extra["spc"] = int(spc)
+    if exchanger is not None:
+        strat = getattr(exchanger, "strategy", None)
+        extra["rule"] = ":".join(
+            str(x) for x in (type(exchanger).__name__,
+                             getattr(exchanger, "mode", ""),
+                             getattr(strat, "name", ""),
+                             getattr(exchanger, "exchange_freq", 1)))
+    return extra
+
+
+class CompileCache:
+    """One cache directory: content-addressed ``.jexec`` entries + manifest.
+
+    ``enabled=False`` builds the inert no-op instance — ``get_or_compile``
+    then just compiles and reports ``cache: 'off'`` (the pre-cache
+    behavior, bit for bit).
+    """
+
+    def __init__(self, cache_dir: Optional[str], enabled: bool = True):
+        self.cache_dir = cache_dir
+        self.enabled = bool(enabled and cache_dir)
+        self.counters = {"hits": 0, "misses": 0, "deserialize_fallbacks": 0,
+                         "serialize_unsupported": 0}
+        if self.enabled:
+            try:
+                os.makedirs(self.cache_dir, exist_ok=True)
+            except OSError as e:
+                # an uncreatable dir (read-only mount, a file in the way)
+                # must degrade to the plain compile, not crash the run —
+                # the module contract: every cache-side error is non-fatal
+                print(f"compile_cache: cannot create {self.cache_dir} "
+                      f"({e}) — cache disabled", file=sys.stderr)
+                self.enabled = False
+
+    # -- entry IO ----------------------------------------------------------
+
+    def _path(self, key: str) -> str:
+        return os.path.join(self.cache_dir, key + ".jexec")
+
+    def has(self, key: str) -> bool:
+        return self.enabled and os.path.exists(self._path(key))
+
+    def _write_entry(self, key: str, label: str, payload: bytes,
+                     in_tree, out_tree, device) -> None:
+        jax_v, jaxlib_v = _versions()
+        header = {"magic": _MAGIC, "format": _FORMAT,
+                  "jax": jax_v, "jaxlib": jaxlib_v,
+                  "platform": getattr(device, "platform", "?"),
+                  "device_kind": getattr(device, "device_kind", "?"),
+                  "label": label, "created": time.time()}
+        tmp = self._path(key) + f".tmp.{os.getpid()}"
+        with open(tmp, "wb") as f:
+            f.write(json.dumps(header).encode("utf-8") + b"\n")
+            f.write(pickle.dumps((payload, in_tree, out_tree)))
+        os.replace(tmp, self._path(key))     # atomic: readers never see half
+
+    def _parse_header(self, head: bytes) -> dict:
+        """Validate one entry's header line.  Raises ``_EntryMismatch`` on
+        format/version drift or structural damage."""
+        try:
+            header = json.loads(head.decode("utf-8"))
+        except ValueError as e:
+            raise _EntryMismatch(f"unparseable header: {e}") from e
+        if header.get("magic") != _MAGIC:
+            raise _EntryMismatch("bad magic")
+        if header.get("format") != _FORMAT:
+            raise _EntryMismatch(
+                f"entry format {header.get('format')!r}, reader speaks "
+                f"{_FORMAT}")
+        jax_v, jaxlib_v = _versions()
+        if (header.get("jax"), header.get("jaxlib")) != (jax_v, jaxlib_v):
+            raise _EntryMismatch(
+                f"built on jax {header.get('jax')}/jaxlib "
+                f"{header.get('jaxlib')}, runtime is {jax_v}/{jaxlib_v}")
+        return header
+
+    def check_header(self, key: str) -> None:
+        """Header-only validation (one readline, no unpickle) — the
+        ``load=False`` prewarm rung, so a damaged or version-drifted entry
+        is recompiled OFF-line instead of surfacing as a
+        deserialize-fallback paying the full compile in the hardware
+        window."""
+        with open(self._path(key), "rb") as f:
+            self._parse_header(f.readline())
+
+    def _read_entry(self, key: str):
+        """Header-checked read.  Raises ``_EntryMismatch`` on version drift
+        or structural damage — the caller's deserialize-fallback rung."""
+        with open(self._path(key), "rb") as f:
+            header = self._parse_header(f.readline())
+            try:
+                payload, in_tree, out_tree = pickle.loads(f.read())
+            except Exception as e:
+                raise _EntryMismatch(f"corrupt body: {e!r}") from e
+        return header, payload, in_tree, out_tree
+
+    # -- the ladder --------------------------------------------------------
+
+    def get_or_compile(self, lowered, label: str = "", mesh=None,
+                       extra: Optional[dict] = None, load: bool = True):
+        """Return ``(compiled, info)`` for one lowered program.
+
+        ``info``: ``cache`` ∈ {hit, miss, deserialize_fallback, off},
+        ``compile_secs`` (wall time of whichever path ran — the
+        deserialize for a hit, the XLA compile otherwise), ``key``,
+        ``serialized`` (did the entry land on disk).
+
+        ``load=False`` (prewarm): a present entry is trusted from its
+        header and NOT deserialized — the off-line venue has no runtime
+        client to load into; returns ``(None, info)`` on a hit.
+        """
+        t0 = time.time()
+        if not self.enabled:
+            compiled = lowered.compile()
+            return compiled, {"cache": "off", "key": None, "label": label,
+                              "compile_secs": round(time.time() - t0, 3),
+                              "serialized": False}
+        key = program_key(lowered, mesh=mesh, extra=extra)
+        info: Dict[str, Any] = {"cache": "miss", "key": key, "label": label,
+                                "serialized": False}
+        if self.has(key):
+            if not load:
+                try:
+                    self.check_header(key)
+                except Exception as e:
+                    # a damaged/drifted entry found OFF-line: recompile it
+                    # now, not in the hardware window
+                    self.counters["deserialize_fallbacks"] += 1
+                    info["cache"] = "deserialize_fallback"
+                    info["fallback_reason"] = str(e)[:300]
+                    print(f"compile_cache: entry {key[:12]} unusable "
+                          f"({str(e)[:200]}) — re-prewarming",
+                          file=sys.stderr)
+                else:
+                    self.counters["hits"] += 1
+                    self._bump_manifest(key, label)
+                    info.update(cache="hit",
+                                compile_secs=round(time.time() - t0, 3))
+                    return None, info
+            else:
+                try:
+                    from jax.experimental import serialize_executable as se
+                    _, payload, in_tree, out_tree = self._read_entry(key)
+                    backend = getattr(_mesh_device(mesh), "client", None)
+                    compiled = se.deserialize_and_load(
+                        payload, in_tree, out_tree, backend=backend)
+                    self.counters["hits"] += 1
+                    self._bump_manifest(key, label)
+                    info.update(cache="hit",
+                                compile_secs=round(time.time() - t0, 3))
+                    return compiled, info
+                except Exception as e:
+                    # corrupt blob, version drift, backend refusal — rung 2:
+                    # count it, recompile fresh, rewrite the entry below
+                    self.counters["deserialize_fallbacks"] += 1
+                    info["cache"] = "deserialize_fallback"
+                    info["fallback_reason"] = str(e)[:300]
+                    print(f"compile_cache: entry {key[:12]} unusable "
+                          f"({str(e)[:200]}) — recompiling", file=sys.stderr)
+        if info["cache"] == "miss":
+            self.counters["misses"] += 1
+        t0 = time.time()
+        compiled = lowered.compile()
+        compile_secs = time.time() - t0
+        info["compile_secs"] = round(compile_secs, 3)
+        try:
+            from jax.experimental import serialize_executable as se
+            payload, in_tree, out_tree = se.serialize(compiled)
+            self._write_entry(key, label, payload, in_tree, out_tree,
+                              _mesh_device(mesh))
+            self._record_manifest(key, label, compile_secs, len(payload),
+                                  mesh)
+            info["serialized"] = True
+        except Exception as e:
+            # rung 4: the backend (or this program shape) can't serialize —
+            # the fresh compile is still perfectly usable, only persistence
+            # is lost.  Harmless by design.
+            self.counters["serialize_unsupported"] += 1
+            info["serialize_error"] = str(e)[:300]
+            print(f"compile_cache: cannot serialize {label or key[:12]} "
+                  f"({str(e)[:200]}) — running uncached", file=sys.stderr)
+        return compiled, info
+
+    # -- manifest ----------------------------------------------------------
+
+    def _manifest_path(self) -> str:
+        return os.path.join(self.cache_dir, "manifest.json")
+
+    def _load_manifest(self) -> dict:
+        try:
+            with open(self._manifest_path()) as f:
+                m = json.load(f)
+            return m if isinstance(m, dict) else {}
+        except (OSError, ValueError):
+            return {}
+
+    def _save_manifest(self, m: dict) -> None:
+        tmp = self._manifest_path() + f".tmp.{os.getpid()}"
+        try:
+            with open(tmp, "w") as f:
+                json.dump(m, f, indent=1, sort_keys=True)
+            os.replace(tmp, self._manifest_path())
+        except OSError:
+            pass                              # metadata only — never fatal
+
+    def _record_manifest(self, key, label, compile_secs, nbytes, mesh):
+        jax_v, jaxlib_v = _versions()
+        dev = _mesh_device(mesh)
+        m = self._load_manifest()
+        m[key] = {"label": label, "compile_secs": round(compile_secs, 2),
+                  "bytes": int(nbytes), "jax": jax_v, "jaxlib": jaxlib_v,
+                  "platform": getattr(dev, "platform", "?"),
+                  "device_kind": getattr(dev, "device_kind", "?"),
+                  "created": time.time(), "hits": 0}
+        self._save_manifest(m)
+
+    def _bump_manifest(self, key, label):
+        m = self._load_manifest()
+        if key in m:
+            m[key]["hits"] = int(m[key].get("hits", 0)) + 1
+            m[key]["last_hit"] = time.time()
+            self._save_manifest(m)
+
+    # -- reporting ---------------------------------------------------------
+
+    def describe(self) -> str:
+        c = self.counters
+        return (f"{c['hits']} hit / {c['misses']} miss / "
+                f"{c['deserialize_fallbacks']} deserialize-fallback / "
+                f"{c['serialize_unsupported']} unserializable "
+                f"(dir={self.cache_dir})")
+
+
+_DISABLED = CompileCache(None, enabled=False)
+
+
+def get(cache_dir: Optional[str]) -> CompileCache:
+    """Shared per-directory instance (process-wide counters)."""
+    if not cache_dir:
+        return _DISABLED
+    cache_dir = os.path.abspath(cache_dir)
+    inst = _INSTANCES.get(cache_dir)
+    if inst is None:
+        inst = _INSTANCES[cache_dir] = CompileCache(cache_dir)
+    return inst
+
+
+def resolve(config: Optional[dict] = None) -> CompileCache:
+    """The one resolution rule every entry point shares: the model/worker
+    config key ``compile_cache`` (a path enables, ``False``/``""`` force-
+    disables), else the ``THEANOMPI_COMPILE_CACHE`` env var, else off.
+    ``aot_cache=False`` in the config force-disables regardless (escape
+    hatch: keep lazy first-call jit even with a cache dir configured)."""
+    config = config or {}
+    if config.get("aot_cache", True) is False:
+        return _DISABLED
+    if "compile_cache" in config:
+        d = config["compile_cache"]
+        return get(str(d)) if d else _DISABLED
+    return get(os.environ.get(ENV_CACHE_DIR) or None)

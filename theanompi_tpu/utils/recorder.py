@@ -28,8 +28,12 @@ IMAGES_PER_REPORT = 5120
 # `load` = waiting on the data source (pure dequeue wait under para_load);
 # `stage` = consumer-thread host stack + device_put (≈0 when the parallel
 # loader's window producer stages dispatch inputs off the hot path) — the
-# split makes the producer/consumer overlap win visible in records
-SECTIONS = ("train", "comm", "wait", "load", "stage", "val")
+# split makes the producer/consumer overlap win visible in records.
+# `compile` = building the iteration functions (worker.py brackets
+# compile_iter_fns): the XLA compile on a cold start, the executable-cache
+# deserialize (~seconds) on a warm one — the bucket makes the AOT cache's
+# win (and a resume recompiling from scratch) visible per run
+SECTIONS = ("compile", "train", "comm", "wait", "load", "stage", "val")
 
 
 class Recorder:
@@ -130,6 +134,7 @@ class Recorder:
             "t_wait": self.t_sec["wait"],
             "t_load": self.t_sec["load"],
             "t_stage": self.t_sec["stage"],
+            "t_compile": self.t_sec["compile"],
             "images_per_sec": self.images_per_sec(),
             "images_per_sec_per_chip": self.images_per_sec() / max(self.size, 1),
             "time_per_5120": self.time_per_5120(),
@@ -141,7 +146,9 @@ class Recorder:
                 f"iter {count}: cost {cost:.4f} err {err:.4f} | "
                 f"train {rec['t_train']:.3f}s comm {rec['t_comm']:.3f}s "
                 f"wait {rec['t_wait']:.3f}s load {rec['t_load']:.3f}s "
-                f"stage {rec['t_stage']:.3f}s | "
+                f"stage {rec['t_stage']:.3f}s"
+                + (f" compile {rec['t_compile']:.3f}s"
+                   if rec['t_compile'] > 0 else "") + " | "
                 f"{rec['images_per_sec']:.1f} img/s "
                 f"({rec['images_per_sec_per_chip']:.1f}/chip, "
                 f"{rec['time_per_5120']:.2f}s per 5120)",
@@ -161,6 +168,8 @@ class Recorder:
                 float(np.mean(self._val_error_top5)) if self._val_error_top5 else float("nan")
             ),
             "t_val": self.t_sec_total["val"],
+            # cumulative: shows compile going to ~0 on a cache-hit resume
+            "t_compile": self.t_sec_total["compile"],
         }
         self.epoch_records.append(rec)
         if self.verbose and self.rank == 0:

@@ -40,7 +40,13 @@ class Worker(MeshProcess):
     def run(self, model) -> Recorder:
         """The reference's ``run(model)`` epoch/batch loop (SURVEY.md §3.1)."""
         config = self.config
+        # the compile recorder bucket: XLA compile on a cold start, the
+        # executable-cache deserialize (~seconds) on a warm one — per-epoch
+        # records then show compile going to ~0 on a cache-hit resume
+        self.recorder.start()
         model.compile_iter_fns(self.exchanger)
+        self.recorder.end("compile")
+        self._log_compile_cache(model)
         if config.get("scale_lr", True) and self.size > 1:
             model.scale_lr(self.size)
 
@@ -171,6 +177,26 @@ class Worker(MeshProcess):
             print(f"training finished in {time.time() - t0:.1f}s "
                   f"({epochs - start_epoch} epochs)", flush=True)
         return self.recorder
+
+
+    def _log_compile_cache(self, model) -> None:
+        """Startup line for the AOT executable cache (utils/compile_cache):
+        per-program hit/miss + wall time, and the process counters — the
+        at-a-glance evidence that a wedge-recovery restart or checkpoint
+        resume deserialized instead of recompiling."""
+        if not self.verbose:
+            return
+        cache = getattr(model, "compile_cache", None)
+        info = getattr(model, "compile_info", None) or {}
+        if cache is None or not cache.enabled:
+            return
+        parts = [f"{k}: {v['cache']}"
+                 + (f" ({v['compile_secs']:.1f}s)"
+                    if v.get("compile_secs") is not None else "")
+                 for k, v in info.items()
+                 if isinstance(v, dict) and "cache" in v]
+        print(f"compile cache [{cache.describe()}] " + " | ".join(parts),
+              flush=True)
 
 
 class BSP_Worker(Worker):
