@@ -43,6 +43,14 @@ measures the donation-free twin of the train program — see
 ``compile_cache.donated_load_safe``); ``BENCH_EXEC_CACHE=0`` disables just
 the executable store, or names a different dir for it).
 
+Every row JSON also folds in a telemetry summary (utils/telemetry, run
+in-memory for the row): ``p50_step_secs``/``p95_step_secs`` (per-iteration
+wall inside the timed loop — tail evidence the mean hides),
+``peak_hbm_bytes`` (device ``memory_stats()`` after the run), and
+``min_queue_depth`` (streaming rows: the lowest prefetch queue depth the
+consumer saw) — so ``scripts/merge_matrix.py`` artifacts can be ranked on
+tails, not just means.
+
 The reference's published numbers are not retrievable this session
 (``BASELINE.md``): ``vs_baseline`` is computed against an ESTIMATED 1×K80
 AlexNet figure from the Theano-MPI era (~128 images/sec for batch-128
@@ -580,6 +588,13 @@ def main() -> int:
     prng = canonical_prng_impl(flags["prng"])
     if prng:
         jax.config.update("jax_default_prng_impl", prng)
+    # in-memory telemetry (utils/telemetry — no stream): collects the
+    # prefetch queue-depth histogram and compile-cache counters during the
+    # row so the row JSON can carry tail/health evidence (p95 step time,
+    # peak HBM, min queue depth) — merge_matrix then ranks on tails, not
+    # just the mean the `value` field is
+    from theanompi_tpu.utils import telemetry
+    telem = telemetry.init({"telemetry": True})
 
     from theanompi_tpu.parallel.exchanger import get_exchanger
     from theanompi_tpu.parallel.mesh import WORKER_AXIS
@@ -732,9 +747,12 @@ def main() -> int:
             step(i)
         drain()
         load_wait[0] = 0.0            # only the timed window counts
-        t0 = time.time()
-        for i in range(iters):
+        step_secs = []                # per-iteration wall inside the timed
+        t0 = time.time()              # loop: host dispatch (+ dequeue wait
+        for i in range(iters):        # on streaming rows) — its p95 is the
+            ts = time.time()          # row's tail-latency evidence
             step(warmup + i)
+            step_secs.append(time.time() - ts)
         drain()
         dt = time.time() - t0
 
@@ -777,12 +795,12 @@ def main() -> int:
                 print(f"mfu for spc>1 unavailable (single-step flop "
                       f"count failed: {e!r})", file=sys.stderr)
         return (model, spc, n_images, dt, compiled, load_wait[0],
-                spc1_flops)
+                spc1_flops, step_secs)
 
     retry = False
     try:
-        model, spc, n_images, dt, compiled, load_wait, spc1_flops = \
-            measure(config)
+        model, spc, n_images, dt, compiled, load_wait, spc1_flops, \
+            step_secs = measure(config)
     except Exception as e:
         if int(config.get("steps_per_call", 1)) <= 1:
             raise
@@ -794,8 +812,12 @@ def main() -> int:
         # would otherwise keep its device buffers rooted while the fallback
         # allocates a second full model
         config["steps_per_call"] = 1
-        model, spc, n_images, dt, compiled, load_wait, spc1_flops = \
-            measure(config)
+        # fresh registry: the failed attempt's queue-depth/histogram
+        # samples must not leak into the fallback row's telemetry fields
+        # (peak_hbm_bytes stays a process-wide monotone peak — see below)
+        telem = telemetry.init({"telemetry": True})
+        model, spc, n_images, dt, compiled, load_wait, spc1_flops, \
+            step_secs = measure(config)
 
     ips = n_images * iters / dt
     ips_chip = ips / n_chips
@@ -857,6 +879,28 @@ def main() -> int:
         # speeds"): the share of the timed window the consumer spent
         # BLOCKED waiting for the loader; ~0 = the producer kept up
         out["load_wait_share"] = round(load_wait / dt, 4)
+    # telemetry fold-in: tails and health, not just the mean.  p95 of the
+    # per-iteration wall inside the timed loop (host dispatch + dequeue
+    # wait on streaming rows — a straggling loader or a periodic stall
+    # shows here while the mean hides it), device peak HBM after the run,
+    # and the minimum prefetch queue depth the consumer ever saw.
+    if step_secs:
+        h = telemetry.Histogram()     # the ONE percentile definition
+        for v in step_secs:
+            h.observe(v)
+        out["p50_step_secs"] = round(h.percentile(50), 5)
+        out["p95_step_secs"] = round(h.percentile(95), 5)
+    try:
+        # NOTE: a process-wide monotone peak — on the rare spc-fallback
+        # retry it includes the failed first attempt's high-water mark
+        ms = jax.local_devices()[0].memory_stats() or {}
+        if "peak_bytes_in_use" in ms:
+            out["peak_hbm_bytes"] = int(ms["peak_bytes_in_use"])
+    except Exception:
+        pass                          # CPU sim: no memory_stats
+    qd = telem.hists.get("prefetch.queue_depth")
+    if qd is not None and qd.count:
+        out["min_queue_depth"] = qd.min
     print(json.dumps(out))
     return 0
 

@@ -4,28 +4,63 @@
 Theano-MPI dumped per-rank ``inforec`` record files for offline plotting of
 cost/error/throughput curves (SURVEY.md §2.10, §5 'Metrics/observability');
 this reads this framework's ``inforec_rank*.jsonl`` (or ``.npy``) dumps from
-a record dir and writes PNG curves.
+a record dir — or one record file directly — and writes PNG curves:
+cost, error, throughput, and the per-section time breakdown (every bucket
+in ``recorder.SECTIONS``, including the round-7/8 ``stage`` and ``compile``
+additions — the list is imported, so new buckets plot automatically).
 
-Usage: python scripts/plot_records.py <record_dir> [out.png]
+Usage: python scripts/plot_records.py <record_dir_or_file> [out.png]
 """
 
+import importlib.util
 import json
 import os
 import sys
 
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
-def load_records(record_dir):
+
+def _phases():
+    """The canonical section list from utils/telemetry.py, loaded by FILE
+    path: the module itself is stdlib-only, but importing it through the
+    package would drag jax in via theanompi_tpu/__init__ — this script
+    must keep running on jax-less plotting machines (numpy + matplotlib
+    only, as before)."""
+    path = os.path.join(_REPO, "theanompi_tpu", "utils", "telemetry.py")
+    spec = importlib.util.spec_from_file_location("_tmpi_telemetry", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod.PHASES
+
+
+PHASES = _phases()
+
+
+def _load_jsonl(path):
+    with open(path) as f:
+        return [json.loads(line) for line in f if line.strip()]
+
+
+def _load_npy(path):
+    import numpy as np
+    return np.load(path, allow_pickle=True).tolist()
+
+
+def load_records(path):
+    """Records from a directory of per-rank dumps, or from one ``.jsonl`` /
+    ``.npy`` file directly.  JSONL wins in a directory (it carries the
+    epoch/validation records too); ``.npy`` is the fallback."""
+    if os.path.isfile(path):
+        return _load_npy(path) if path.endswith(".npy") \
+            else _load_jsonl(path)
     recs = []
-    for name in sorted(os.listdir(record_dir)):
+    for name in sorted(os.listdir(path)):
         if name.startswith("inforec_rank") and name.endswith(".jsonl"):
-            with open(os.path.join(record_dir, name)) as f:
-                recs.extend(json.loads(line) for line in f if line.strip())
+            recs.extend(_load_jsonl(os.path.join(path, name)))
     if not recs:
-        import numpy as np
-        for name in sorted(os.listdir(record_dir)):
+        for name in sorted(os.listdir(path)):
             if name.startswith("inforec_rank") and name.endswith(".npy"):
-                recs.extend(np.load(os.path.join(record_dir, name),
-                                    allow_pickle=True).tolist())
+                recs.extend(_load_npy(os.path.join(path, name)))
     return recs
 
 
@@ -34,27 +69,36 @@ def main(argv=None):
     if not argv:
         print(__doc__)
         return 1
-    record_dir = argv[0]
-    out = argv[1] if len(argv) > 1 else os.path.join(record_dir, "curves.png")
+    src = argv[0]
+    out_dir = src if os.path.isdir(src) else os.path.dirname(src) or "."
+    out = argv[1] if len(argv) > 1 else os.path.join(out_dir, "curves.png")
 
-    recs = load_records(record_dir)
+    recs = load_records(src)
     train = [r for r in recs if "cost" in r]
     val = [r for r in recs if "val_cost" in r]
     if not train and not val:
-        print(f"no records found in {record_dir}")
+        print(f"no records found in {src}")
         return 1
 
     import matplotlib
     matplotlib.use("Agg")
     import matplotlib.pyplot as plt
 
-    fig, axes = plt.subplots(1, 3, figsize=(15, 4))
+    fig, axes = plt.subplots(1, 4, figsize=(20, 4))
     if train:
         it = [r["iter"] for r in train]
         axes[0].plot(it, [r["cost"] for r in train], label="train cost")
         axes[1].plot(it, [r["error"] for r in train], label="train err")
         axes[2].plot(it, [r.get("images_per_sec", 0) for r in train],
                      label="img/s")
+        # per-section time breakdown: every recorder bucket with signal
+        # (the canonical section list — stage/compile included — comes
+        # from telemetry.PHASES, the one source of truth)
+        for s in PHASES:
+            key = "t_" + s
+            ys = [r.get(key, 0.0) for r in train]
+            if any(y > 0 for y in ys):
+                axes[3].plot(it, ys, label=key)
     if val:
         it = [r["iter"] for r in val]
         axes[0].plot(it, [r["val_cost"] for r in val], "o-", label="val cost")
@@ -62,10 +106,12 @@ def main(argv=None):
                      label="val top-1 err")
         axes[1].plot(it, [r["val_error_top5"] for r in val], "s--",
                      label="val top-5 err")
-    for ax, title in zip(axes, ("cost", "error", "throughput")):
+    for ax, title in zip(axes, ("cost", "error", "throughput",
+                                "time breakdown (s per print window)")):
         ax.set_xlabel("iteration")
         ax.set_title(title)
-        ax.legend()
+        if ax.get_legend_handles_labels()[0]:
+            ax.legend()
         ax.grid(True, alpha=0.3)
     fig.tight_layout()
     fig.savefig(out, dpi=120)

@@ -1,0 +1,291 @@
+#!/usr/bin/env python
+"""Merge per-rank telemetry streams into one run report.
+
+Reads a record/telemetry directory produced by a run with ``record_dir``
+set (see ``theanompi_tpu/utils/telemetry.py`` and docs/design.md §11):
+
+* ``telemetry_rank{r}.jsonl``        — the per-rank event streams
+* ``telemetry_summary_rank{r}.json`` — counters/gauges/histograms at close
+* ``flight_rank{r}.jsonl`` / ``crash_*/flight_rank{r}.jsonl`` — crash dumps
+
+and emits the cross-worker run report the bucket sums can't answer:
+
+* **phase breakdown** — per recorder section (train/comm/load/...), event
+  count, total seconds, mean and p50/p95/p99 tail percentiles;
+* **per-rank throughput timeline** — images/sec over wall time from the
+  periodic ``train_record`` events;
+* **straggler ranking** — wall time is cut into windows (``--window``,
+  default 10 s); each window's slowest rank (highest mean ``phase.train``
+  dt) is charged one straggle; ranks sorted by windows-straggled and mean
+  step time;
+* **health flags** — prefetch queue starvation (starved dequeues / min
+  queue depth) and HBM headroom (peak bytes vs limit from ``gauges``
+  events), plus any flight recordings found (a crash/stall happened).
+
+Usage:
+    python scripts/telemetry_report.py <record_dir> [--window SEC]
+                                       [--json out.json]
+
+Stdlib only — runnable on a machine with no jax installed.
+"""
+
+import argparse
+import glob
+import json
+import os
+import sys
+from collections import defaultdict
+
+
+def percentile(values, q):
+    # same nearest-rank formula as telemetry.Histogram.percentile — kept
+    # local so this script stays stdlib-only (importing the package would
+    # drag jax in via theanompi_tpu/__init__)
+    if not values:
+        return None
+    s = sorted(values)
+    idx = min(len(s) - 1, max(0, int(round(q / 100.0 * (len(s) - 1)))))
+    return s[idx]
+
+
+def load_events(record_dir):
+    """All events from every per-rank stream, sorted by timestamp."""
+    events = []
+    for path in sorted(glob.glob(
+            os.path.join(record_dir, "telemetry_rank*.jsonl"))):
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    ev = json.loads(line)
+                except ValueError:
+                    continue          # a crash can truncate the last line
+                if isinstance(ev, dict) and "ev" in ev:
+                    events.append(ev)
+    events.sort(key=lambda e: e.get("ts", 0))
+    return events
+
+
+def load_summaries(record_dir):
+    out = {}
+    for path in sorted(glob.glob(
+            os.path.join(record_dir, "telemetry_summary_rank*.json"))):
+        try:
+            with open(path) as f:
+                s = json.load(f)
+            out[int(s.get("rank", 0))] = s
+        except (ValueError, OSError):
+            continue
+    return out
+
+
+def find_flight_dumps(record_dir):
+    return sorted(
+        glob.glob(os.path.join(record_dir, "flight_rank*.jsonl")) +
+        glob.glob(os.path.join(record_dir, "crash_*", "flight_rank*.jsonl")))
+
+
+def phase_breakdown(events):
+    """Per-section dt distribution from the ``phase`` events."""
+    dts = defaultdict(list)
+    for ev in events:
+        if ev["ev"] == "phase":
+            dts[ev.get("sec", "?")].append(float(ev.get("dt", 0.0)))
+    out = {}
+    for sec, vals in sorted(dts.items()):
+        out[sec] = {"count": len(vals), "total": round(sum(vals), 4),
+                    "mean": round(sum(vals) / len(vals), 6),
+                    "p50": percentile(vals, 50), "p95": percentile(vals, 95),
+                    "p99": percentile(vals, 99)}
+    return out
+
+
+def throughput_timeline(events):
+    """Per-rank [(t_rel, images_per_sec), ...] from train_record events."""
+    t0 = events[0]["ts"] if events else 0.0
+    tl = defaultdict(list)
+    for ev in events:
+        if ev["ev"] == "train_record" and "images_per_sec" in ev:
+            tl[int(ev.get("rank", 0))].append(
+                (round(ev["ts"] - t0, 1), round(ev["images_per_sec"], 1)))
+    return dict(tl)
+
+
+def straggler_ranking(events, window_s):
+    """Charge each wall-clock window to its slowest rank (highest mean
+    ``phase.train`` dt).  Single-rank runs trivially 'win' every window —
+    the mean/p95 columns are the useful part there."""
+    train = [(ev["ts"], int(ev.get("rank", 0)), float(ev.get("dt", 0.0)))
+             for ev in events
+             if ev["ev"] == "phase" and ev.get("sec") == "train"]
+    if not train:
+        return []
+    t0 = train[0][0]
+    per_window = defaultdict(lambda: defaultdict(list))
+    per_rank = defaultdict(list)
+    for ts, rank, dt in train:
+        per_window[int((ts - t0) / window_s)][rank].append(dt)
+        per_rank[rank].append(dt)
+    straggles = defaultdict(int)
+    for w, by_rank in per_window.items():
+        if len(by_rank) < 1:
+            continue
+        slowest = max(by_rank,
+                      key=lambda r: sum(by_rank[r]) / len(by_rank[r]))
+        straggles[slowest] += 1
+    ranking = []
+    for rank in sorted(per_rank):
+        vals = per_rank[rank]
+        ranking.append({
+            "rank": rank, "windows_straggled": straggles.get(rank, 0),
+            "dispatches": len(vals),
+            "mean_train_secs": round(sum(vals) / len(vals), 6),
+            "p95_train_secs": percentile(vals, 95)})
+    ranking.sort(key=lambda r: (-r["windows_straggled"],
+                                -(r["mean_train_secs"] or 0)))
+    return ranking
+
+
+def health_flags(events, summaries):
+    """Queue-starvation and HBM-headroom verdicts, per rank where known."""
+    flags = {}
+    # prefetch starvation: counters + queue-depth histogram from summaries
+    starve = {}
+    for rank, s in summaries.items():
+        c = s.get("counters", {})
+        deq = c.get("prefetch.dequeues", 0)
+        if deq:
+            h = s.get("hist", {}).get("prefetch.queue_depth", {})
+            share = c.get("prefetch.starved_dequeues", 0) / deq
+            starve[rank] = {
+                "dequeues": int(deq), "starved_share": round(share, 4),
+                "min_queue_depth": h.get("min"),
+                "p50_queue_depth": h.get("p50"),
+                "starving": share > 0.05}
+    if starve:
+        flags["prefetch"] = starve
+    # HBM headroom: the LAST gauges event per rank
+    hbm = {}
+    for ev in events:
+        if ev["ev"] == "gauges" and "hbm_peak_bytes" in ev:
+            rank = int(ev.get("rank", 0))
+            peak, limit = ev["hbm_peak_bytes"], ev.get("hbm_bytes_limit")
+            hbm[rank] = {"peak_bytes": int(peak),
+                         "limit_bytes": int(limit) if limit else None,
+                         "peak_share": round(peak / limit, 4) if limit
+                         else None,
+                         "near_oom": bool(limit) and peak / limit > 0.9}
+    if hbm:
+        flags["hbm"] = hbm
+    return flags
+
+
+def build_report(record_dir, window_s=10.0):
+    events = load_events(record_dir)
+    summaries = load_summaries(record_dir)
+    dumps = find_flight_dumps(record_dir)
+    runs = sorted({ev.get("run") for ev in events if ev.get("run")})
+    ranks = sorted({int(ev.get("rank", 0)) for ev in events})
+    crashes = [ev for ev in events if ev["ev"] in ("crash", "stall",
+                                                   "fatal_signal")]
+    return {
+        "record_dir": os.path.abspath(record_dir),
+        "runs": runs, "ranks": ranks, "events": len(events),
+        "phases": phase_breakdown(events),
+        "throughput_timeline": throughput_timeline(events),
+        "straggler_ranking": straggler_ranking(events, window_s),
+        "flags": health_flags(events, summaries),
+        "counters": {r: s.get("counters", {}) for r, s in summaries.items()},
+        "crash_events": crashes,
+        "flight_dumps": dumps,
+    }
+
+
+def print_report(rep):
+    print(f"telemetry report — {rep['record_dir']}")
+    print(f"  runs: {', '.join(rep['runs']) or '(none)'}   "
+          f"ranks: {rep['ranks']}   events: {rep['events']}")
+    if rep["phases"]:
+        print("\nphase breakdown (seconds per dispatch):")
+        print(f"  {'phase':<9}{'count':>7}{'total':>10}{'mean':>10}"
+              f"{'p50':>10}{'p95':>10}{'p99':>10}")
+        for sec, p in rep["phases"].items():
+            print(f"  {sec:<9}{p['count']:>7}{p['total']:>10.3f}"
+                  f"{p['mean']:>10.5f}{p['p50']:>10.5f}{p['p95']:>10.5f}"
+                  f"{p['p99']:>10.5f}")
+    if rep["straggler_ranking"]:
+        print("\nstraggler ranking (slowest rank per "
+              "window, slowest first):")
+        for r in rep["straggler_ranking"]:
+            print(f"  rank {r['rank']}: straggled {r['windows_straggled']} "
+                  f"window(s), mean train {r['mean_train_secs'] * 1e3:.2f} ms"
+                  f", p95 {r['p95_train_secs'] * 1e3:.2f} ms "
+                  f"over {r['dispatches']} dispatches")
+    for rank, tl in sorted(rep["throughput_timeline"].items()):
+        pts = " ".join(f"{t}s:{ips}" for t, ips in tl[-8:])
+        print(f"\nrank {rank} throughput timeline (img/s, last 8): {pts}")
+    pf = rep["flags"].get("prefetch")
+    if pf:
+        print("\nprefetch queue:")
+        for rank, f in sorted(pf.items()):
+            verdict = "STARVING" if f["starving"] else "healthy"
+            print(f"  rank {rank}: {verdict} — starved share "
+                  f"{f['starved_share']:.1%} of {f['dequeues']} dequeues, "
+                  f"min depth {f['min_queue_depth']}, "
+                  f"p50 depth {f['p50_queue_depth']}")
+    hb = rep["flags"].get("hbm")
+    if hb:
+        print("\nHBM headroom:")
+        for rank, f in sorted(hb.items()):
+            share = (f"{f['peak_share']:.1%} of limit"
+                     if f["peak_share"] is not None else "limit unknown")
+            verdict = " — NEAR OOM" if f["near_oom"] else ""
+            print(f"  rank {rank}: peak {f['peak_bytes'] / 2**30:.2f} GiB "
+                  f"({share}){verdict}")
+    if rep["crash_events"]:
+        print("\ncrash/stall events:")
+        for ev in rep["crash_events"][-5:]:
+            detail = ev.get("error") or ev.get("label") or \
+                ev.get("signum", "")
+            print(f"  rank {ev.get('rank', 0)} {ev['ev']}: {detail}")
+    if rep["flight_dumps"]:
+        print("\nflight recordings (crash/stall trails):")
+        for p in rep["flight_dumps"]:
+            print(f"  {p}")
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("record_dir")
+    ap.add_argument("--window", type=float, default=10.0,
+                    help="straggler window seconds (default 10)")
+    ap.add_argument("--json", default=None, metavar="OUT",
+                    help="also write the machine-readable report here "
+                         "('-' for stdout)")
+    args = ap.parse_args(argv)
+    if not os.path.isdir(args.record_dir):
+        print(f"no such directory: {args.record_dir}", file=sys.stderr)
+        return 2
+    rep = build_report(args.record_dir, args.window)
+    if not rep["events"]:
+        print(f"no telemetry_rank*.jsonl events under {args.record_dir} — "
+              "run with record_dir set (telemetry streams there)",
+              file=sys.stderr)
+        return 1
+    print_report(rep)
+    if args.json == "-":
+        print(json.dumps(rep))
+    elif args.json:
+        with open(args.json, "w") as f:
+            json.dump(rep, f, indent=1, sort_keys=True)
+        print(f"\nwrote {args.json}")
+    return 0
+
+
+if __name__ == "__main__":
+    try:
+        raise SystemExit(main())
+    except BrokenPipeError:
+        os._exit(0)          # downstream `head`/pager closed the pipe

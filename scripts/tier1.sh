@@ -4,5 +4,11 @@
 # timeout, and the DOTS_PASSED accounting in sync).
 #   scripts/tier1.sh
 # Exits with pytest's return code; prints DOTS_PASSED=<n> as the last line.
+#
+# Preceded by the schema drift guard (scripts/check_schema_drift.py):
+# recorder.SECTIONS, the print_train_info record keys, and the telemetry
+# phase-event names must all derive from telemetry.PHASES — a bucket added
+# to one but not the others fails the gate here, before pytest runs.
 cd "$(dirname "$0")/.."
+python scripts/check_schema_drift.py || { echo "tier1: schema drift guard FAILED" >&2; exit 9; }
 set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=${PIPESTATUS[0]}; echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c); exit $rc

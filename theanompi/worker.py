@@ -5,4 +5,6 @@ from theanompi_tpu.worker import *            # noqa: F401,F403
 from theanompi_tpu.worker import WORKERS, main  # noqa: F401
 
 if __name__ == "__main__":
+    from theanompi_tpu.utils import telemetry
+    telemetry.install_signal_hooks()     # same contract as the real entry
     raise SystemExit(main())

@@ -77,6 +77,12 @@ def main(argv=None) -> int:
                         "scripts/prewarm_cache.py; supervised restarts and "
                         "checkpoint resumes then skip the XLA compile "
                         "(defaults to $THEANOMPI_COMPILE_CACHE if set)")
+    p.add_argument("--record-dir", default=None, metavar="DIR",
+                   help="record/telemetry directory (same as the "
+                        "record_dir=DIR config key): recorder dumps, the "
+                        "per-rank telemetry_rank*.jsonl event streams, and "
+                        "crash flight recordings all land here — report "
+                        "with scripts/telemetry_report.py DIR")
     p.add_argument("config", nargs="*", help="key=value model/worker config")
     args = p.parse_args(argv)
 
@@ -86,6 +92,17 @@ def main(argv=None) -> int:
     if args.compile_cache and \
             not any(c.startswith("compile_cache=") for c in kv):
         kv.append(f"compile_cache={args.compile_cache}")
+    if args.record_dir and \
+            not any(c.startswith("record_dir=") for c in kv):
+        kv.append(f"record_dir={args.record_dir}")
+    record_dir = next((c.partition("=")[2] for c in kv
+                       if c.startswith("record_dir=")), None)
+    if record_dir and not any(c.startswith("run_id=") for c in kv):
+        # one run id for every host/restart of this launch: per-rank
+        # telemetry streams (utils/telemetry) then correlate into one run
+        # for scripts/telemetry_report.py
+        import time as _t
+        kv.append(f"run_id=run{int(_t.time())}")
 
     if args.num_hosts > 1:
         cmds = [compose_worker_cmd(args.rule, args.modelfile, args.modelclass,
@@ -110,6 +127,20 @@ def main(argv=None) -> int:
         base = compose_worker_cmd(args.rule, args.modelfile, args.modelclass,
                                   kv)
         import time as _time
+
+        def sweep(attempt: int, rc: int) -> None:
+            # a dead worker's flight recordings (utils/telemetry dumps
+            # flight_rank*.jsonl into record_dir on crash/stall-exit) are
+            # moved aside per attempt, so the restart's own eventual dumps
+            # can't overwrite the trail that explains THIS death
+            if not record_dir:
+                return
+            from .utils.telemetry import sweep_flight_dumps
+            dest = sweep_flight_dumps(record_dir,
+                                      f"attempt{attempt}_rc{rc}")
+            if dest:
+                print(f"swept flight recordings to {dest}", file=sys.stderr)
+
         rc = 1
         for attempt in range(args.supervise + 1):
             cmd = base if attempt == 0 else base + ["resume=true"]
@@ -117,6 +148,7 @@ def main(argv=None) -> int:
             rc = subprocess.call(cmd)
             if rc == 0:
                 return 0
+            sweep(attempt, rc)
             uptime = _time.monotonic() - t0
             if args.min_uptime and uptime < args.min_uptime:
                 print(f"worker exited rc={rc} after only {uptime:.1f}s "

@@ -23,7 +23,11 @@ from __future__ import annotations
 
 import queue
 import threading
+import time
 from typing import Optional
+
+from ...utils import telemetry
+
 
 def _stack_host(batches):
     """Host-side ``[k, ...]`` stack of k per-step batches — delegates to
@@ -187,7 +191,7 @@ class PrefetchLoader:
                 "next_train_window (or set_window(0) first)")
         if self._q is None:          # shuffle_data not called yet (smoke use)
             return self._maybe_put(self._data.next_train_batch(count))
-        item = self._q.get()
+        item = self._dequeue()
         if isinstance(item, BaseException):
             raise item
         batch, cursor = item
@@ -208,7 +212,7 @@ class PrefetchLoader:
             batches = [self._data.next_train_batch(count - self.window + 1 + j)
                        for j in range(self.window)]
             return self._stage(_stack_host(batches))
-        item = self._q.get()
+        item = self._dequeue()
         if isinstance(item, BaseException):
             raise item
         window, cursor = item
@@ -222,6 +226,21 @@ class PrefetchLoader:
         # Validation is per-epoch and cheap relative to training — served
         # synchronously (the reference's loader also only covered train).
         return self._maybe_put(self._data.next_val_batch(count))
+
+    def _dequeue(self):
+        """One queue pop, instrumented: queue depth at dequeue (min/p50 in
+        the report — 0 means the consumer is about to starve) and a
+        starved-dequeue counter.  Disabled telemetry ≡ one attribute
+        check."""
+        tm = telemetry.active()
+        if tm.enabled:
+            depth = self._q.qsize()
+            tm.gauge("prefetch.queue_depth", depth)
+            tm.observe("prefetch.queue_depth", depth)
+            tm.counter("prefetch.dequeues")
+            if depth == 0:
+                tm.counter("prefetch.starved_dequeues")
+        return self._q.get()
 
     # producer -------------------------------------------------------------
     def _producer(self, n_batches: int, q: queue.Queue,
@@ -237,15 +256,28 @@ class PrefetchLoader:
                                               "plan_train_batch"):
                 self._producer_pooled(n_batches, q, stop)
                 return
+            tm = telemetry.active()
             for i in range(n_batches):
                 if stop.is_set():
                     return
+                t0 = time.time()
                 batch = self._maybe_put(self._data.next_train_batch(i + 1))
                 cursor = self._data.get_cursor() \
                     if hasattr(self._data, "get_cursor") else {}
+                if tm.enabled:
+                    # produce time up (relative to the consumer's step
+                    # time) = the producer becoming the bottleneck
+                    tm.observe("prefetch.produce_secs", time.time() - t0)
                 if stop.is_set():     # restart raced the load: drop, don't put
                     return
+                t0 = time.time()
                 q.put((batch, cursor))
+                if tm.enabled:
+                    # blocked on a full queue = the producer is AHEAD
+                    # (healthy overlap); ~0 everywhere + starved dequeues
+                    # = the producer can't keep up
+                    tm.observe("prefetch.producer_blocked_secs",
+                               time.time() - t0)
         except BaseException as e:    # surface loader errors in the consumer
             q.put(e)
 
@@ -292,10 +324,12 @@ class PrefetchLoader:
         pooled = self.n_workers > 1 and hasattr(self._data,
                                                 "plan_train_batch")
         pool = ThreadPoolExecutor(self.n_workers) if pooled else None
+        tm = telemetry.active()
         try:
             for w in range(n_batches // k):
                 if stop.is_set():
                     return
+                t0 = time.time()
                 if pooled:
                     plans = [self._data.plan_train_batch(w * k + j + 1)
                              for j in range(k)]
@@ -308,9 +342,15 @@ class PrefetchLoader:
                 cursor = self._data.get_cursor() \
                     if hasattr(self._data, "get_cursor") else {}
                 window = self._stage(_stack_host(batches))
+                if tm.enabled:
+                    tm.observe("prefetch.produce_secs", time.time() - t0)
                 if stop.is_set():     # restart raced the stage: drop
                     return
+                t0 = time.time()
                 q.put((window, cursor))
+                if tm.enabled:
+                    tm.observe("prefetch.producer_blocked_secs",
+                               time.time() - t0)
         finally:
             if pool is not None:
                 pool.shutdown(wait=False)

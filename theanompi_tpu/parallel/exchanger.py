@@ -46,6 +46,7 @@ See docs/design.md §8 for the GoSGD traced-RNG contract.
 from __future__ import annotations
 
 import functools
+import time
 from typing import Any, Dict, Optional
 
 import jax
@@ -56,6 +57,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from . import steps
 from ..jax_compat import shard_map
+from ..utils import telemetry
 from .mesh import WORKER_AXIS
 from .strategies import Strategy, get_strategy
 
@@ -230,11 +232,22 @@ class Exchanger:
         if self.fused or not self.due(count):
             # fused: the cadence already ran inside the multi-step dispatch
             return
+        tm = telemetry.active()
         if recorder:
             recorder.start()
+        t0 = time.time() if tm.enabled else 0.0
         self.model.step_state = self._exchange_fn(
             self.model.step_state, self.model.next_exchange_key(), count)
+        if tm.enabled:
+            # PER-EXCHANGE histograms, not bare sums: host dispatch cost
+            # here; the device-side comm time lands via recorder.end('comm')
+            # → phase.comm below (full distribution, p95/p99 in the report)
+            tm.observe("exchange.dispatch_secs", time.time() - t0)
+            tm.counter("exchange.count")
+            tm.counter(f"exchange.count.{self.name}")
         if recorder:
+            # blocking only when a recorder asks for honest comm buckets —
+            # bench's recorder-less loop stays fully asynchronous
             jax.block_until_ready(self.model.step_state["params"])
             recorder.end("comm")
 

@@ -196,6 +196,16 @@ class CompileCache:
                       f"({e}) — cache disabled", file=sys.stderr)
                 self.enabled = False
 
+    def _tick(self, kind: str) -> None:
+        """Bump a ladder counter, mirrored into the process telemetry
+        registry (``compile_cache.<kind>``) so run reports and the flight
+        recorder see where executables came from."""
+        self.counters[kind] += 1
+        from . import telemetry
+        tm = telemetry.active()
+        if tm.enabled:
+            tm.counter("compile_cache." + kind)
+
     # -- entry IO ----------------------------------------------------------
 
     def _path(self, key: str) -> str:
@@ -289,14 +299,14 @@ class CompileCache:
                 except Exception as e:
                     # a damaged/drifted entry found OFF-line: recompile it
                     # now, not in the hardware window
-                    self.counters["deserialize_fallbacks"] += 1
+                    self._tick("deserialize_fallbacks")
                     info["cache"] = "deserialize_fallback"
                     info["fallback_reason"] = str(e)[:300]
                     print(f"compile_cache: entry {key[:12]} unusable "
                           f"({str(e)[:200]}) — re-prewarming",
                           file=sys.stderr)
                 else:
-                    self.counters["hits"] += 1
+                    self._tick("hits")
                     self._bump_manifest(key, label)
                     info.update(cache="hit",
                                 compile_secs=round(time.time() - t0, 3))
@@ -308,7 +318,7 @@ class CompileCache:
                     backend = getattr(_mesh_device(mesh), "client", None)
                     compiled = se.deserialize_and_load(
                         payload, in_tree, out_tree, backend=backend)
-                    self.counters["hits"] += 1
+                    self._tick("hits")
                     self._bump_manifest(key, label)
                     info.update(cache="hit",
                                 compile_secs=round(time.time() - t0, 3))
@@ -316,13 +326,13 @@ class CompileCache:
                 except Exception as e:
                     # corrupt blob, version drift, backend refusal — rung 2:
                     # count it, recompile fresh, rewrite the entry below
-                    self.counters["deserialize_fallbacks"] += 1
+                    self._tick("deserialize_fallbacks")
                     info["cache"] = "deserialize_fallback"
                     info["fallback_reason"] = str(e)[:300]
                     print(f"compile_cache: entry {key[:12]} unusable "
                           f"({str(e)[:200]}) — recompiling", file=sys.stderr)
         if info["cache"] == "miss":
-            self.counters["misses"] += 1
+            self._tick("misses")
         t0 = time.time()
         compiled = lowered.compile()
         compile_secs = time.time() - t0
@@ -339,7 +349,7 @@ class CompileCache:
             # rung 4: the backend (or this program shape) can't serialize —
             # the fresh compile is still perfectly usable, only persistence
             # is lost.  Harmless by design.
-            self.counters["serialize_unsupported"] += 1
+            self._tick("serialize_unsupported")
             info["serialize_error"] = str(e)[:300]
             print(f"compile_cache: cannot serialize {label or key[:12]} "
                   f"({str(e)[:200]}) — running uncached", file=sys.stderr)

@@ -22,6 +22,8 @@ from typing import Dict, List, Optional
 
 import numpy as np
 
+from . import telemetry
+
 # The reference reports "time per 5120 images" (40 batches of 128).
 IMAGES_PER_REPORT = 5120
 
@@ -32,8 +34,15 @@ IMAGES_PER_REPORT = 5120
 # `compile` = building the iteration functions (worker.py brackets
 # compile_iter_fns): the XLA compile on a cold start, the executable-cache
 # deserialize (~seconds) on a warm one — the bucket makes the AOT cache's
-# win (and a resume recompiling from scratch) visible per run
-SECTIONS = ("compile", "train", "comm", "wait", "load", "stage", "val")
+# win (and a resume recompiling from scratch) visible per run.
+# The list itself lives in telemetry.PHASES — ONE source of truth for the
+# recorder buckets, the t_<section> record keys below, and the telemetry
+# phase-event names (scripts/check_schema_drift.py guards the sync).
+SECTIONS = telemetry.PHASES
+
+# the per-print record carries every section except `val` (val time is
+# reported cumulatively by print_val_info) — derived, so it cannot drift
+RECORD_KEYS = tuple("t_" + s for s in SECTIONS if s != "val")
 
 
 class Recorder:
@@ -44,6 +53,11 @@ class Recorder:
     ``train_error`` / ``val_error``, and prints every ``printFreq`` iterations
     with ``print_train_info(count)``.
     """
+
+    # the process-wide telemetry registry (worker.py re-points this at the
+    # live instance); the class default is the inert no-op, so recorders
+    # built outside a Worker cost one attribute check per bracket
+    telemetry = telemetry.DISABLED
 
     def __init__(self, config: Optional[dict] = None):
         config = config or {}
@@ -81,6 +95,12 @@ class Recorder:
         self.t_sec[section] += dt
         self.t_sec_total[section] += dt
         self._t0 = None
+        # per-dispatch phase events: one histogram sample + one stream
+        # event per bracket — the raw material for telemetry_report's
+        # tail percentiles and straggler ranking.  Disabled ≡ one
+        # attribute check.
+        if self.telemetry.enabled:
+            self.telemetry.phase(section, dt)
         return dt
 
     # -- metric accumulation ----------------------------------------------
@@ -112,35 +132,36 @@ class Recorder:
         ips = self.images_per_sec()
         return IMAGES_PER_REPORT / ips if ips > 0 else float("inf")
 
-    def print_train_info(self, count: int, stride: int = 1) -> None:
+    def print_train_info(self, count: int, stride: int = 1) -> Optional[dict]:
         """``stride`` = steps per train_iter dispatch (``steps_per_call``):
-        count then only visits multiples of it, so the print gate fires once
-        per printFreq window and the averaging slice counts DISPATCH entries,
-        not steps."""
-        if count % self.printFreq >= stride:
-            return
-        k = max(1, self.printFreq // stride)
+        count then only visits multiples of it.  The gate fires once every
+        ``ceil(printFreq / stride)`` dispatches — at least printFreq steps
+        apart even when stride does not divide printFreq (the old
+        ``count % printFreq < stride`` residue test double-fired inside one
+        window in that case) — and the averaging slice counts DISPATCH
+        entries, not steps.  Returns the emitted record (the worker keys
+        its periodic gauge snapshots off it), or None when gated."""
+        k = max(1, -(-self.printFreq // stride))      # ceil division
+        if (count // stride) % k != 0:
+            return None
         # materializing device scalars happens HERE, once per printFreq iters
         cost = float(np.mean([np.asarray(c) for c in self._train_cost[-k:]])) \
             if self._train_cost else float("nan")
         err = float(np.mean([np.asarray(e) for e in self._train_error[-k:]])) \
             if self._train_error else float("nan")
-        rec = {
-            "iter": count,
-            "cost": cost,
-            "error": err,
-            "t_train": self.t_sec["train"],
-            "t_comm": self.t_sec["comm"],
-            "t_wait": self.t_sec["wait"],
-            "t_load": self.t_sec["load"],
-            "t_stage": self.t_sec["stage"],
-            "t_compile": self.t_sec["compile"],
-            "images_per_sec": self.images_per_sec(),
-            "images_per_sec_per_chip": self.images_per_sec() / max(self.size, 1),
-            "time_per_5120": self.time_per_5120(),
-            "wall": time.time() - self._wall_start,
-        }
+        rec = {"iter": count, "cost": cost, "error": err}
+        for key, s in zip(RECORD_KEYS, (s for s in SECTIONS if s != "val")):
+            rec[key] = self.t_sec[s]
+        rec.update(
+            images_per_sec=self.images_per_sec(),
+            images_per_sec_per_chip=self.images_per_sec() / max(self.size, 1),
+            time_per_5120=self.time_per_5120(),
+            wall=time.time() - self._wall_start,
+        )
         self._all_records.append(rec)
+        if self.telemetry.enabled:
+            # the per-rank throughput timeline telemetry_report draws
+            self.telemetry.event("train_record", **rec)
         if self.verbose and self.rank == 0:
             print(
                 f"iter {count}: cost {cost:.4f} err {err:.4f} | "
@@ -158,6 +179,7 @@ class Recorder:
             self.t_sec[s] = 0.0
         self.n_images = 0
         self._last_print_wall = time.time()
+        return rec
 
     def print_val_info(self, count: int) -> dict:
         rec = {
@@ -172,6 +194,8 @@ class Recorder:
             "t_compile": self.t_sec_total["compile"],
         }
         self.epoch_records.append(rec)
+        if self.telemetry.enabled:
+            self.telemetry.event("val_record", **rec)
         if self.verbose and self.rank == 0:
             print(
                 f"validation @ iter {count}: cost {rec['val_cost']:.4f} "
@@ -198,7 +222,35 @@ class Recorder:
                 f.write(json.dumps(rec) + "\n")
 
     def load(self, record_dir: Optional[str] = None) -> None:
+        """Restore BOTH record lists, preferring the JSONL (the only dump
+        that holds the epoch/validation records — the ``.npy`` carries the
+        train records alone).  A resumed run's next ``save()`` then
+        rewrites the JSONL with the pre-resume epoch lines intact:
+        save → load → save is lossless (json float round-trips are exact).
+
+        Epoch records are recognized by their ``val_cost`` key — the field
+        ``print_val_info`` always writes and ``print_train_info`` never
+        does."""
         d = record_dir or self.record_dir
+        jl = os.path.join(d, f"inforec_rank{self.rank}.jsonl")
+        if os.path.exists(jl):
+            train: List[dict] = []
+            epoch: List[dict] = []
+            with open(jl) as f:
+                for line in f:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        rec = json.loads(line)
+                    except ValueError:
+                        # a worker killed mid-save leaves a truncated last
+                        # line; a resume must shrug it off, not crash-loop
+                        # the supervisor on every retry
+                        continue
+                    (epoch if "val_cost" in rec else train).append(rec)
+            self._all_records, self.epoch_records = train, epoch
+            return
         path = os.path.join(d, f"inforec_rank{self.rank}.npy")
         if os.path.exists(path):
             self._all_records = list(np.load(path, allow_pickle=True))

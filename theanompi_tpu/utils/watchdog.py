@@ -24,6 +24,8 @@ import threading
 import time
 from typing import Callable, Optional
 
+from . import telemetry
+
 
 class StallWatchdog:
     """Daemon heartbeat monitor.
@@ -62,6 +64,12 @@ class StallWatchdog:
             self._last_label = label
         self._beaten = True
         self._fired = False          # re-arm after recovery
+        # heartbeats feed the telemetry flight ring (ring-only: the stream
+        # would drown in them) — the dump then shows exactly what the rank
+        # was doing in the window before a stall/crash
+        tm = telemetry.active()
+        if tm.enabled:
+            tm.event("beat", ring_only=True, label=label)
 
     # -- lifecycle ----------------------------------------------------------
 
@@ -103,3 +111,15 @@ class StallWatchdog:
               f"(timeout {self.timeout_s:.1f}s); last heartbeat: {label}. "
               f"Dumping all thread stacks:", file=sys.stderr, flush=True)
         faulthandler.dump_traceback(file=sys.stderr)
+        # the last few flight-recorder events inline: what the rank was
+        # doing when it hung — a tunnel-window stall is then diagnosable
+        # from the console log alone, no record_dir needed
+        tail = telemetry.active().tail(8)
+        if tail:
+            print("WATCHDOG: last telemetry events before the stall:",
+                  file=sys.stderr)
+            for ev in tail:
+                bits = " ".join(f"{k}={v}" for k, v in ev.items()
+                                if k not in ("run", "rank"))
+                print(f"  {bits}", file=sys.stderr)
+            sys.stderr.flush()

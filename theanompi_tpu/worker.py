@@ -20,6 +20,7 @@ from typing import Optional
 
 from .base import MeshProcess
 from .parallel.exchanger import get_exchanger
+from .utils import telemetry
 from .utils.recorder import Recorder
 from .utils.watchdog import StallWatchdog
 
@@ -33,7 +34,12 @@ class Worker(MeshProcess):
         super().__init__(config)
         self.get_internode_comm()
         self.init_device()
+        # process-wide telemetry (utils/telemetry): on when record_dir is
+        # set (or telemetry=true for in-memory metrics), else the inert
+        # no-op; every component reads telemetry.active() lazily
+        self.telemetry = telemetry.init(self.config)
         self.recorder = Recorder(self.config)
+        self.recorder.telemetry = self.telemetry
         self.exchanger = get_exchanger(self.config.get("rule", self.rule),
                                        self.config)
 
@@ -56,6 +62,13 @@ class Worker(MeshProcess):
             restored = model.load(ckpt_dir)
             if restored is not None:
                 start_epoch = restored + 1
+                if config.get("record_dir"):
+                    # restore BOTH record lists (train + epoch) so the next
+                    # save() rewrites the JSONL with the pre-resume lines
+                    # intact — without this, Recorder.load()'s lossless
+                    # round-trip never runs on the supervised-restart path
+                    # it exists for
+                    self.recorder.load(config["record_dir"])
                 if self.verbose:
                     print(f"resumed from epoch {restored}", flush=True)
 
@@ -107,16 +120,32 @@ class Worker(MeshProcess):
             f"unknown stall_action {stall_action!r}: use 'trace' "
             f"(diagnostic dump only) or 'exit' (kill for supervisor restart)")
 
+        telem = self.telemetry
+
         def on_stall(elapsed, label):
             StallWatchdog._default_handler(watchdog, elapsed, label)
+            if telem.enabled:
+                # the flight ring holds the beats/phases leading into the
+                # hang — dump it whether or not we are about to die
+                telem.event("stall", elapsed=round(elapsed, 1), label=label,
+                            action=stall_action)
+                telem.dump_flight(reason=f"watchdog stall {elapsed:.0f}s "
+                                         f"at {label}")
             if stall_action == "exit":
                 import os
+                if telem.enabled:
+                    telem.close()
                 print("WATCHDOG: stall_action=exit — terminating for "
                       "supervisor restart", flush=True)
                 os._exit(42)
 
         watchdog = StallWatchdog(float(config.get("stall_timeout", 0)),
                                  on_stall=on_stall)
+        if telem.enabled:
+            telem.event("train_begin", rule=self.config.get("rule", self.rule),
+                        model=type(model).__name__, spc=spc,
+                        start_epoch=start_epoch, epochs=epochs,
+                        size=self.size)
         try:
             with watchdog:
                 for epoch in range(start_epoch, epochs):
@@ -141,7 +170,16 @@ class Worker(MeshProcess):
                         watchdog.beat(f"epoch {epoch} iter {count}")
                         if trace_stop_at is not None and count + spc >= trace_stop_at:
                             _stop_trace()
-                        self.recorder.print_train_info(count, stride=spc)
+                        rec = self.recorder.print_train_info(count,
+                                                             stride=spc)
+                        if rec and telem.enabled:
+                            # periodic gauge snapshot at print cadence:
+                            # device HBM in-use/peak, host RSS, iteration
+                            # rate — the HBM-headroom and throughput
+                            # timelines in telemetry_report
+                            telem.system_snapshot(
+                                iter=count, epoch=epoch,
+                                images_per_sec=rec["images_per_sec"])
 
                     model.begin_val()
                     for _ in range(model.data.n_batch_val):
@@ -155,6 +193,15 @@ class Worker(MeshProcess):
                     if config.get("record_dir"):
                         self.recorder.save(config["record_dir"])
                     watchdog.beat(f"epoch {epoch} end (ckpt/records saved)")
+        except BaseException as e:
+            # crash: leave the flight-recorder trail (last N events — beats,
+            # phase brackets, gauges) next to the records, then re-raise;
+            # launcher --supervise sweeps the dumps aside before restarting
+            if telem.enabled:
+                telem.event("crash", error=repr(e)[:300])
+                telem.dump_flight(reason=repr(e)[:200])
+                telem.close()
+            raise
         finally:
             # async_ckpt: a completed epoch's in-flight write must land even
             # when an exception (or Ctrl-C) unwinds the loop — the daemon
@@ -173,6 +220,10 @@ class Worker(MeshProcess):
                           f"{ckpt_exc!r}", file=_sys.stderr, flush=True)
         if trace_stop_at is not None:   # window outlived training: flush it
             _stop_trace()
+        if telem.enabled:
+            telem.event("train_end", secs=round(time.time() - t0, 3),
+                        epochs=epochs - start_epoch)
+            telem.close()       # flush the stream + write the summary sidecar
         if self.verbose:
             print(f"training finished in {time.time() - t0:.1f}s "
                   f"({epochs - start_epoch} epochs)", flush=True)
@@ -252,4 +303,10 @@ def main(argv=None):
 
 
 if __name__ == "__main__":
+    # a CLI worker owns its process: a fatal signal (supervisor kill,
+    # scheduler preemption) dumps the flight recorder before dying.  The
+    # in-process session API never installs these — host applications and
+    # tests own their handlers (the hooks are no-ops while telemetry is
+    # disabled, so installing before config parsing is safe).
+    telemetry.install_signal_hooks()
     raise SystemExit(main())
