@@ -1,84 +1,23 @@
 #!/usr/bin/env python
-"""Schema drift guard — run by scripts/tier1.sh before the pytest gate.
+"""DEPRECATED shim — the schema drift guard is now a tpulint checker.
 
-Three consumers must agree on the phase/section vocabulary, with
-``telemetry.PHASES`` as the ONE source of truth:
-
-1. ``recorder.SECTIONS`` — the wall-clock buckets the worker loop brackets;
-2. the ``print_train_info`` record keys — the ``t_<section>`` fields every
-   inforec JSONL line (and plot_records panel) reads;
-3. the telemetry phase-event names — the ``phase`` events' ``sec`` field
-   and ``phase.<section>`` histograms that ``telemetry_report.py`` merges.
-
-A new bucket added to one place but not the others silently drops that
-phase from records, plots, or reports; this guard fails the tier-1 gate
-instead.  Checks run against LIVE objects (a Recorder driven through one
-print, a Telemetry instance fed one bracket per phase), not just the
-declarations, so a hand-rolled record dict drifting from the list is
-caught too.
-
-Exit 0 = in sync; nonzero = drift (details on stderr).
+The live-object checks (recorder.SECTIONS / print_train_info record
+keys / telemetry phase-event names all deriving from telemetry.PHASES)
+moved to ``theanompi_tpu/analysis/checkers/schema_drift.py`` so
+``scripts/tier1.sh`` has exactly ONE analysis entry point
+(``scripts/lint.py``).  This script execs that CLI restricted to the
+schema-drift checker, preserving the old exit-code contract (0 = in
+sync, nonzero = drift) for anything still invoking it directly.
 """
 
 import os
 import sys
 
-sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
-
-
-def main() -> int:
-    from theanompi_tpu.utils import recorder, telemetry
-
-    errors = []
-
-    # 1. recorder.SECTIONS must BE the canonical list (same object or equal)
-    if tuple(recorder.SECTIONS) != tuple(telemetry.PHASES):
-        errors.append(
-            f"recorder.SECTIONS {recorder.SECTIONS!r} != telemetry.PHASES "
-            f"{telemetry.PHASES!r}")
-
-    # 2. the record keys a live print_train_info actually emits
-    r = recorder.Recorder({"verbose": False, "printFreq": 1})
-    r.start()
-    r.end("train")
-    r.train_error(1, 1.0, 0.5, 8)
-    if not r.print_train_info(1):
-        errors.append("print_train_info(1) did not fire at printFreq=1")
-    else:
-        got = {k for k in r._all_records[-1] if k.startswith("t_")}
-        want = {"t_" + s for s in telemetry.PHASES if s != "val"}
-        if got != want:
-            errors.append(
-                f"print_train_info record keys {sorted(got)} != "
-                f"t_<PHASES except val> {sorted(want)}")
-    if tuple(recorder.RECORD_KEYS) != tuple(
-            "t_" + s for s in telemetry.PHASES if s != "val"):
-        errors.append(f"recorder.RECORD_KEYS {recorder.RECORD_KEYS!r} "
-                      "drifted from telemetry.PHASES")
-
-    # 3. the phase-event names a live registry emits for each section
-    tm = telemetry.Telemetry(rank=0, run_id="drift-check")
-    for s in telemetry.PHASES:
-        tm.phase(s, 0.0)
-    evs = [e for e in tm.tail(len(telemetry.PHASES) + 1)
-           if e["ev"] == "phase"]
-    got_secs = {e.get("sec") for e in evs}
-    if got_secs != set(telemetry.PHASES):
-        errors.append(f"telemetry phase-event names {sorted(got_secs)} != "
-                      f"PHASES {sorted(telemetry.PHASES)}")
-    got_hists = {k for k in tm.hists if k.startswith("phase.")}
-    if got_hists != {"phase." + s for s in telemetry.PHASES}:
-        errors.append(f"telemetry phase histograms {sorted(got_hists)} "
-                      "drifted from PHASES")
-
-    if errors:
-        for e in errors:
-            print(f"SCHEMA DRIFT: {e}", file=sys.stderr)
-        return 1
-    print(f"schema in sync: {len(telemetry.PHASES)} phases "
-          f"({', '.join(telemetry.PHASES)})")
-    return 0
-
-
 if __name__ == "__main__":
-    raise SystemExit(main())
+    print("check_schema_drift.py is deprecated: running "
+          "`scripts/lint.py --only schema-drift` (the tpulint suite is "
+          "the one analysis entry point)", file=sys.stderr)
+    lint = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "lint.py")
+    os.execv(sys.executable, [sys.executable, lint, "--only",
+                              "schema-drift"])

@@ -1,0 +1,57 @@
+#!/usr/bin/env python
+"""tpulint launcher — THE analysis entry point ``scripts/tier1.sh`` runs.
+
+    python scripts/lint.py                   # repo-wide, human output
+    python scripts/lint.py --check-baseline  # tier-1 gate mode
+    python scripts/lint.py --update-baseline # regenerate the baseline
+    python scripts/lint.py --list-checks
+
+The suite lives in ``theanompi_tpu/analysis/`` — but importing
+``theanompi_tpu`` executes its package ``__init__`` which drags jax in
+(seconds of import, a backend in a lint process).  This launcher
+registers a SYNTHETIC ``theanompi_tpu`` parent package whose
+``__path__`` points at the source tree without executing
+``__init__.py``: submodule imports (``theanompi_tpu.analysis``, the
+schema-drift checker's ``theanompi_tpu.utils.recorder`` live probe)
+resolve normally, and jax never loads — the whole run stays under the
+10-second budget on this container.
+
+``TPULINT_ASSERT_NO_JAX=1`` makes the process fail if jax sneaks into
+``sys.modules`` anyway (used by tests/test_lint.py to pin the
+contract).
+"""
+
+import importlib.machinery
+import os
+import sys
+import types
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _bootstrap_package() -> None:
+    if "theanompi_tpu" in sys.modules:      # a real import beat us to it
+        return
+    sys.path.insert(0, ROOT)
+    pkg_dir = os.path.join(ROOT, "theanompi_tpu")
+    pkg = types.ModuleType("theanompi_tpu")
+    pkg.__path__ = [pkg_dir]
+    pkg.__spec__ = importlib.machinery.ModuleSpec(
+        "theanompi_tpu", loader=None, is_package=True)
+    pkg.__spec__.submodule_search_locations = [pkg_dir]
+    sys.modules["theanompi_tpu"] = pkg
+
+
+def main(argv=None) -> int:
+    _bootstrap_package()
+    from theanompi_tpu.analysis import cli
+    rc = cli.main(sys.argv[1:] if argv is None else argv)
+    if os.environ.get("TPULINT_ASSERT_NO_JAX") and "jax" in sys.modules:
+        print("tpulint: jax was imported during the lint run — the "
+              "no-backend contract is broken", file=sys.stderr)
+        return 3
+    return rc
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

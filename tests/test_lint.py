@@ -1,0 +1,743 @@
+"""tpulint suite: paired good/bad fixtures per checker + repo smoke.
+
+Contract (ISSUE 5 / docs/design.md §12): every checker has a failing
+fixture producing EXACTLY its expected finding and a passing fixture
+producing zero; the whole-repo run matches the committed baseline
+exactly (no stale entries, no new findings); the CLI enforces the gate
+semantics tier1.sh relies on — and does it all without importing jax.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from theanompi_tpu.analysis import core
+from theanompi_tpu.analysis.checkers import schema_drift as sd
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+LINT = os.path.join(REPO, "scripts", "lint.py")
+
+
+def lint_snippet(tmp_path, name, code, only):
+    (tmp_path / name).write_text(code)
+    return core.run_lint(str(tmp_path), paths=[name], only=[only])
+
+
+# ---------------------------------------------------------------------------
+# trace-purity
+# ---------------------------------------------------------------------------
+
+TRACE_BAD = """
+import time
+import numpy as np
+import jax
+from jax import lax
+
+def build(model):
+    def body(carry, x):
+        t = time.time()
+        carry = carry + np.random.rand()
+        print("mid-trace")
+        if carry:
+            carry = carry + x.item()
+        return carry, jax.device_get(x)
+    return lax.scan(body, 0.0, model)
+"""
+
+TRACE_GOOD = """
+import time
+import numpy as np
+import jax
+from jax import lax
+
+def host_loop(model):
+    # host side: clocks / numpy RNG / print are all fine here
+    t = time.time()
+    noise = np.random.rand()
+    print("host", t)
+
+    def body(carry, x):
+        return carry + x, x
+    out, _ = lax.scan(body, noise, model)
+    return out, time.time() - t
+"""
+
+
+def test_trace_purity_bad_fixture(tmp_path):
+    found = lint_snippet(tmp_path, "bad.py", TRACE_BAD, "trace-purity")
+    msgs = [f.message for f in found]
+    assert len(found) == 6, msgs
+    assert any("time.time" in m for m in msgs)
+    assert any("numpy.random" in m for m in msgs)
+    assert any("print" in m for m in msgs)
+    assert any("tracer-typed name `carry`" in m for m in msgs)
+    assert any(".item()" in m for m in msgs)
+    assert any("jax.device_get" in m for m in msgs)
+    assert all(f.check == "trace-purity" for f in found)
+
+
+def test_trace_purity_good_fixture(tmp_path):
+    assert lint_snippet(tmp_path, "good.py", TRACE_GOOD,
+                        "trace-purity") == []
+
+
+def test_trace_purity_keyword_passed_body(tmp_path):
+    """A scan body passed by keyword (`lax.scan(f=body, ...)`) is traced
+    all the same."""
+    code = (
+        "import time\n"
+        "from jax import lax\n"
+        "def build(xs):\n"
+        "    def body(carry, x):\n"
+        "        t = time.time()\n"
+        "        return carry, x\n"
+        "    return lax.scan(f=body, init=0.0, xs=xs)\n")
+    found = lint_snippet(tmp_path, "x.py", code, "trace-purity")
+    assert len(found) == 1 and "time.time" in found[0].message
+
+
+def test_trace_purity_decorator_jit(tmp_path):
+    """@jax.jit / @functools.partial(jax.jit, ...) trace the decorated
+    function — the repo's pallas kernels use exactly this shape."""
+    code = (
+        "import functools\n"
+        "import time\n"
+        "import jax\n"
+        "@jax.jit\n"
+        "def f(x):\n"
+        "    t = time.time()\n"
+        "    return x\n"
+        "@functools.partial(jax.jit, static_argnums=(1,))\n"
+        "def g(x, n):\n"
+        "    print(n)\n"
+        "    return x\n")
+    found = lint_snippet(tmp_path, "x.py", code, "trace-purity")
+    msgs = [f.message for f in found]
+    assert len(found) == 2, msgs
+    assert any("time.time" in m for m in msgs)
+    assert any("print" in m for m in msgs)
+
+
+def test_trace_purity_catches_injection_into_real_steps(tmp_path):
+    """The acceptance scenario: a time.time() injected into the repo's
+    actual microbatch scan body must fail the gate."""
+    src = open(os.path.join(REPO, "theanompi_tpu", "parallel",
+                            "steps.py")).read()
+    bad = src.replace(
+        "    def body(carry, mb):\n"
+        "        acc_g, acc_c, acc_e, bn, key = carry",
+        "    def body(carry, mb):\n"
+        "        t0 = time.time()\n"
+        "        acc_g, acc_c, acc_e, bn, key = carry").replace(
+        "import functools", "import functools\nimport time")
+    assert bad != src, "steps.py scan body changed shape; update fixture"
+    # keep the repo-relative package shape so the resolver sees the
+    # same relative imports steps.py really uses
+    pkg = tmp_path / "theanompi_tpu" / "parallel"
+    pkg.mkdir(parents=True)
+    (pkg / "steps.py").write_text(bad)
+    found = core.run_lint(str(tmp_path),
+                          paths=["theanompi_tpu/parallel/steps.py"],
+                          only=["trace-purity"])
+    assert len(found) == 1 and "time.time" in found[0].message
+
+
+# ---------------------------------------------------------------------------
+# rng-discipline
+# ---------------------------------------------------------------------------
+
+RNG_BAD = """
+import jax
+
+def draw(key):
+    a = jax.random.normal(key, (4,))
+    b = jax.random.uniform(key, (4,))
+    return a + b
+"""
+
+RNG_GOOD = """
+import jax
+
+def draw(key, count):
+    key, sub = jax.random.split(key)
+    a = jax.random.normal(sub, (4,))
+    # fold_in with distinct data is the sanctioned multi-stream pattern
+    b = jax.random.uniform(jax.random.fold_in(key, 1), (4,))
+    c = jax.random.normal(jax.random.fold_in(key, 2), (4,))
+    for i in range(3):
+        step = jax.random.fold_in(key, count + i)
+        a = a + jax.random.normal(step, (4,))
+    return a + b + c
+"""
+
+RNG_BAD_LOOP = """
+import jax
+
+def draw(key, n):
+    out = 0.0
+    for i in range(n):
+        out = out + jax.random.normal(key, ())
+    return out
+"""
+
+
+def test_rng_discipline_bad_fixture(tmp_path):
+    found = lint_snippet(tmp_path, "bad.py", RNG_BAD, "rng-discipline")
+    assert len(found) == 1
+    assert "key `key` consumed again" in found[0].message
+    assert found[0].check == "rng-discipline"
+
+
+def test_rng_discipline_loop_fixture(tmp_path):
+    found = lint_snippet(tmp_path, "badloop.py", RNG_BAD_LOOP,
+                         "rng-discipline")
+    assert len(found) == 1
+    assert "inside a loop" in found[0].message
+
+
+def test_rng_discipline_good_fixture(tmp_path):
+    assert lint_snippet(tmp_path, "good.py", RNG_GOOD,
+                        "rng-discipline") == []
+
+
+def test_rng_discipline_exclusive_arms_are_not_reuse(tmp_path):
+    """Only one arm of a conditional expression (or a short-circuit
+    chain) ever runs — a draw in each is not key reuse."""
+    code = (
+        "import jax\n"
+        "def draw(key, c, d):\n"
+        "    a = jax.random.normal(key) if c else jax.random.uniform(key)\n"
+        "    b = d or jax.random.normal(key)\n"
+        "    return a, b\n")
+    # NOTE: `key` genuinely IS consumed on both lines 3 and 4 here —
+    # but each consumption is inside an exclusive/conditional position,
+    # so neither pairing is provably reached twice
+    assert lint_snippet(tmp_path, "x.py", code, "rng-discipline") == []
+
+
+def test_rng_discipline_nested_def_in_loop_is_own_scope(tmp_path):
+    """A helper defined inside a loop gets fresh key parameters per
+    call — its draws are not 'consumed inside a loop'."""
+    code = (
+        "import jax\n"
+        "def outer(n):\n"
+        "    fns = []\n"
+        "    for i in range(n):\n"
+        "        if i:\n"
+        "            def inner(k2):\n"
+        "                return jax.random.normal(k2)\n"
+        "            fns.append(inner)\n"
+        "    return fns\n")
+    assert lint_snippet(tmp_path, "x.py", code, "rng-discipline") == []
+
+
+def test_rng_discipline_both_arms_then_reuse_is_flagged(tmp_path):
+    """A key consumed in BOTH arms of a conditional IS definitely
+    consumed — a later unconditional draw is reuse."""
+    code = (
+        "import jax\n"
+        "def draw(key, c):\n"
+        "    a = jax.random.normal(key) if c else jax.random.uniform(key)\n"
+        "    b = jax.random.normal(key)\n"
+        "    return a, b\n")
+    found = lint_snippet(tmp_path, "x.py", code, "rng-discipline")
+    assert len(found) == 1 and found[0].line == 4
+
+
+# ---------------------------------------------------------------------------
+# donation-safety
+# ---------------------------------------------------------------------------
+
+DONATION_BAD = """
+import jax
+
+def run(state, batch):
+    step = jax.jit(lambda s, b: s, donate_argnums=(0,))
+    new_state = step(state, batch)
+    return new_state, state["params"]
+"""
+
+DONATION_GOOD = """
+import jax
+
+def run(state, batch):
+    step = jax.jit(lambda s, b: s, donate_argnums=(0,))
+    # the sanctioned shape: consume and rebind in one statement
+    state = step(state, batch)
+    return state, state["params"]
+"""
+
+
+def test_donation_safety_bad_fixture(tmp_path):
+    found = lint_snippet(tmp_path, "bad.py", DONATION_BAD,
+                         "donation-safety")
+    assert len(found) == 1
+    assert "`state` read after being donated" in found[0].message
+
+
+def test_donation_safety_good_fixture(tmp_path):
+    assert lint_snippet(tmp_path, "good.py", DONATION_GOOD,
+                        "donation-safety") == []
+
+
+def test_donation_safety_argnames_maps_through_lambda(tmp_path):
+    """donate_argnames against an inline lambda maps names to slots —
+    the donated arg is flagged, the non-donated one is not."""
+    code = (
+        "import jax\n"
+        "def run(state, batch):\n"
+        "    step = jax.jit(lambda b, s: s, donate_argnames='s')\n"
+        "    out = step(batch, state)\n"
+        "    return out, batch.shape, state['params']\n")
+    found = lint_snippet(tmp_path, "x.py", code, "donation-safety")
+    assert len(found) == 1
+    assert "`state` read after being donated" in found[0].message
+
+
+def test_donation_safety_module_level_jit_seen_in_functions(tmp_path):
+    """`f = jax.jit(g, donate_argnums=0)` at module level, called inside
+    a function — the common layout — must still flag read-after-donate."""
+    code = (
+        "import jax\n"
+        "def g(s):\n"
+        "    return s\n"
+        "f = jax.jit(g, donate_argnums=0)\n"
+        "def h(state):\n"
+        "    out = f(state)\n"
+        "    return out, state['params']\n")
+    found = lint_snippet(tmp_path, "x.py", code, "donation-safety")
+    assert len(found) == 1
+    assert "`state` read after being donated" in found[0].message
+
+
+def test_donation_safety_unresolvable_spec_is_skipped(tmp_path):
+    """A donation spec the checker cannot resolve statically (argnames
+    against an opaque callee, non-literal argnums) must not guess an
+    index — guessing flags the WRONG argument."""
+    code = (
+        "import jax\n"
+        "def run(f, state, batch, idx):\n"
+        "    step = jax.jit(f, donate_argnames='s')\n"
+        "    step2 = jax.jit(f, donate_argnums=idx)\n"
+        "    out = step(batch, state)\n"
+        "    out2 = step2(batch, state)\n"
+        "    return out, out2, batch.shape\n")
+    assert lint_snippet(tmp_path, "x.py", code, "donation-safety") == []
+
+
+# ---------------------------------------------------------------------------
+# compat-boundary
+# ---------------------------------------------------------------------------
+
+COMPAT_BAD = """
+import jax
+from jax import lax
+from jax.experimental.shard_map import shard_map
+
+def build(f, mesh):
+    g = jax.shard_map
+    h = lax.pvary
+    return shard_map, g, h
+"""
+
+COMPAT_GOOD = """
+import jax
+from theanompi_tpu.jax_compat import shard_map
+
+def build(f, mesh, specs):
+    return jax.jit(shard_map(f, mesh=mesh, in_specs=specs,
+                             out_specs=specs))
+"""
+
+
+def test_compat_boundary_bad_fixture(tmp_path):
+    found = lint_snippet(tmp_path, "bad.py", COMPAT_BAD, "compat-boundary")
+    msgs = [f.message for f in found]
+    assert len(found) == 3, msgs
+    assert any("jax.experimental.shard_map" in m for m in msgs)
+    assert any("jax.shard_map" in m for m in msgs)
+    assert any("jax.lax.pvary" in m for m in msgs)
+
+
+def test_compat_boundary_good_fixture(tmp_path):
+    assert lint_snippet(tmp_path, "good.py", COMPAT_GOOD,
+                        "compat-boundary") == []
+
+
+def test_compat_boundary_exempts_the_shim(tmp_path):
+    found = lint_snippet(tmp_path, "jax_compat.py", COMPAT_BAD,
+                         "compat-boundary")
+    assert found == []
+
+
+def test_compat_boundary_catches_from_import_of_banned_name(tmp_path):
+    """`from jax import shard_map` binds the banned name with no
+    Attribute node — the import itself must be the finding."""
+    code = ("from jax import shard_map\n"
+            "from jax.lax import pvary\n"
+            "from jax import lax\n")        # `lax` itself is fine
+    found = lint_snippet(tmp_path, "x.py", code, "compat-boundary")
+    msgs = [f.message for f in found]
+    assert len(found) == 2, msgs
+    assert any("jax.shard_map" in m for m in msgs)
+    assert any("jax.lax.pvary" in m for m in msgs)
+
+
+# ---------------------------------------------------------------------------
+# telemetry-hot-path
+# ---------------------------------------------------------------------------
+
+TELEMETRY_BAD = """
+from theanompi_tpu.utils import telemetry
+
+def hot_loop(n):
+    tm = telemetry.active()
+    for i in range(n):
+        tm.counter("iters")
+"""
+
+TELEMETRY_GOOD = """
+from theanompi_tpu.utils import telemetry
+
+def hot_loop(n, rec=None):
+    tm = telemetry.active()
+    for i in range(n):
+        if tm.enabled:
+            tm.counter("iters")
+        if rec and tm.enabled:
+            tm.observe("loop.i", i)
+"""
+
+
+def test_telemetry_hot_path_bad_fixture(tmp_path):
+    # checker keys on hot-path basenames — name the fixture worker.py
+    found = lint_snippet(tmp_path, "worker.py", TELEMETRY_BAD,
+                         "telemetry-hot-path")
+    assert len(found) == 1
+    assert "unguarded telemetry call `tm.counter" in found[0].message
+
+
+def test_telemetry_hot_path_good_fixture(tmp_path):
+    assert lint_snippet(tmp_path, "worker.py", TELEMETRY_GOOD,
+                        "telemetry-hot-path") == []
+
+
+def test_telemetry_hot_path_only_applies_to_hot_files(tmp_path):
+    # the same unguarded call in a non-hot-path file is NOT a finding
+    assert lint_snippet(tmp_path, "report_tool.py", TELEMETRY_BAD,
+                        "telemetry-hot-path") == []
+
+
+def test_telemetry_hot_path_early_return_guard(tmp_path):
+    """`if not tm.enabled: return` dominates the rest of the block —
+    the most common Python guard shape must not be flagged."""
+    code = (
+        "from theanompi_tpu.utils import telemetry\n"
+        "def hot_loop(n):\n"
+        "    tm = telemetry.active()\n"
+        "    if not tm.enabled:\n"
+        "        return\n"
+        "    tm.counter('iters')\n"
+        "    tm.observe('n', n)\n")
+    assert lint_snippet(tmp_path, "worker.py", code,
+                        "telemetry-hot-path") == []
+
+
+def test_telemetry_hot_path_elif_guard(tmp_path):
+    """An `elif tm.enabled:` arm guards its own body."""
+    code = (
+        "from theanompi_tpu.utils import telemetry\n"
+        "def hot_loop(rec):\n"
+        "    tm = telemetry.active()\n"
+        "    if rec:\n"
+        "        pass\n"
+        "    elif tm.enabled:\n"
+        "        tm.counter('iters')\n")
+    assert lint_snippet(tmp_path, "worker.py", code,
+                        "telemetry-hot-path") == []
+
+
+def test_telemetry_hot_path_or_guard_is_not_dominance(tmp_path):
+    """`if other or tm.enabled:` reaches its body with telemetry off —
+    mentioning `.enabled` somewhere is not domination."""
+    code = (
+        "from theanompi_tpu.utils import telemetry\n"
+        "def hot_loop(other):\n"
+        "    tm = telemetry.active()\n"
+        "    if other or tm.enabled:\n"
+        "        tm.counter('iters')\n"
+        "    if tm.enabled or other.enabled:\n"
+        "        tm.gauge('x', 1)\n")    # every alternative guards: ok
+    found = lint_snippet(tmp_path, "worker.py", code,
+                         "telemetry-hot-path")
+    assert len(found) == 1 and "tm.counter" in found[0].message
+
+
+def test_telemetry_hot_path_early_return_without_exit_still_flags(tmp_path):
+    """A negated-enabled If whose body does NOT end control flow must
+    not guard what follows."""
+    code = (
+        "from theanompi_tpu.utils import telemetry\n"
+        "def hot_loop(n):\n"
+        "    tm = telemetry.active()\n"
+        "    if not tm.enabled:\n"
+        "        n = 0\n"
+        "    tm.counter('iters')\n")
+    found = lint_snippet(tmp_path, "worker.py", code,
+                         "telemetry-hot-path")
+    assert len(found) == 1
+
+
+# ---------------------------------------------------------------------------
+# schema-drift
+# ---------------------------------------------------------------------------
+
+def test_schema_drift_good_live_modules():
+    """The real modules must be in sync (this IS the absorbed guard)."""
+    from theanompi_tpu.utils import recorder, telemetry
+    assert sd.live_drift_errors(recorder, telemetry) == []
+
+
+def test_schema_drift_bad_fixture(monkeypatch):
+    """A drifted SECTIONS list must produce a finding."""
+    from theanompi_tpu.utils import recorder, telemetry
+
+    class FakeRecorder:
+        SECTIONS = tuple(telemetry.PHASES) + ("rogue",)
+        RECORD_KEYS = recorder.RECORD_KEYS
+        Recorder = recorder.Recorder
+
+    errors = sd.live_drift_errors(FakeRecorder, telemetry)
+    assert any("SECTIONS" in msg for _, msg in errors)
+
+
+# ---------------------------------------------------------------------------
+# framework behaviors: suppression, baseline, runner
+# ---------------------------------------------------------------------------
+
+def test_inline_suppression(tmp_path):
+    code = RNG_BAD.replace(
+        "    b = jax.random.uniform(key, (4,))",
+        "    b = jax.random.uniform(key, (4,))"
+        "  # tpulint: disable=rng-discipline")
+    assert lint_snippet(tmp_path, "bad.py", code, "rng-discipline") == []
+
+
+def test_previous_line_suppression(tmp_path):
+    code = RNG_BAD.replace(
+        "    b = jax.random.uniform(key, (4,))",
+        "    # tpulint: disable=rng-discipline\n"
+        "    b = jax.random.uniform(key, (4,))")
+    assert lint_snippet(tmp_path, "bad.py", code, "rng-discipline") == []
+
+
+def test_suppression_is_check_specific(tmp_path):
+    code = RNG_BAD.replace(
+        "    b = jax.random.uniform(key, (4,))",
+        "    b = jax.random.uniform(key, (4,))"
+        "  # tpulint: disable=trace-purity")
+    assert len(lint_snippet(tmp_path, "bad.py", code,
+                            "rng-discipline")) == 1
+
+
+def test_baseline_roundtrip_deterministic(tmp_path):
+    (tmp_path / "bad.py").write_text(RNG_BAD)
+    findings = core.run_lint(str(tmp_path), paths=["bad.py"],
+                             only=["rng-discipline"])
+    bl = tmp_path / "baseline.json"
+    core.save_baseline(str(bl), findings)
+    first = bl.read_text()
+    entries = core.load_baseline(str(bl))
+    assert entries[0]["justification"] == "TODO: justify"
+    # justification edits survive a regeneration; output is byte-stable
+    entries[0]["justification"] = "grandfathered: fixture"
+    core.save_baseline(str(bl), findings, entries)
+    again = core.load_baseline(str(bl))
+    assert again[0]["justification"] == "grandfathered: fixture"
+    core.save_baseline(str(bl), findings, again)
+    assert json.loads(bl.read_text())["entries"] == again
+    assert bl.read_text() != first  # only the justification changed
+    new, matched, stale = core.compare_baseline(findings, again)
+    assert new == [] and stale == [] and len(matched) == 1
+
+
+def test_baseline_matches_on_message_not_line(tmp_path):
+    (tmp_path / "bad.py").write_text(RNG_BAD)
+    findings = core.run_lint(str(tmp_path), paths=["bad.py"],
+                             only=["rng-discipline"])
+    moved = [dict(check=f.check, path=f.path, line=f.line + 40,
+                  message=f.message, justification="ok")
+             for f in findings]
+    new, matched, stale = core.compare_baseline(findings, moved)
+    assert new == [] and stale == []
+
+
+def test_parse_error_is_a_finding(tmp_path):
+    (tmp_path / "broken.py").write_text("def f(:\n")
+    found = core.run_lint(str(tmp_path), paths=["broken.py"],
+                          only=["rng-discipline"])
+    assert len(found) == 1 and found[0].check == "parse-error"
+
+
+# ---------------------------------------------------------------------------
+# whole-repo smoke + CLI gate semantics
+# ---------------------------------------------------------------------------
+
+def test_repo_matches_committed_baseline_exactly():
+    """The committed baseline is exact: no new findings, no stale
+    entries, and every entry carries a real justification."""
+    findings = core.run_lint(REPO)
+    entries = core.load_baseline(
+        os.path.join(REPO, core.BASELINE_NAME))
+    new, matched, stale = core.compare_baseline(findings, entries)
+    assert new == [], [f.render() for f in new]
+    assert stale == [], stale
+    assert all(not e["justification"].startswith("TODO")
+               for e in entries), "baseline entries need justifications"
+
+
+def test_cli_runs_clean_without_jax():
+    """scripts/lint.py on the repo: exit 0, and jax must never load
+    (the synthetic-parent bootstrap contract)."""
+    env = dict(os.environ, TPULINT_ASSERT_NO_JAX="1")
+    proc = subprocess.run(
+        [sys.executable, LINT, "--check-baseline"], cwd=REPO, env=env,
+        capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def test_cli_fails_on_new_finding(tmp_path):
+    (tmp_path / "steps.py").write_text(TRACE_BAD)
+    proc = subprocess.run(
+        [sys.executable, LINT, "--root", str(tmp_path), "steps.py"],
+        capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 1
+    assert "trace-purity" in proc.stdout
+
+
+def test_cli_check_baseline_fails_on_stale_entry(tmp_path):
+    (tmp_path / "clean.py").write_text("x = 1\n")
+    bl = tmp_path / core.BASELINE_NAME
+    bl.write_text(json.dumps({"version": 1, "entries": [{
+        "check": "rng-discipline", "path": "gone.py", "line": 1,
+        "message": "key `k` consumed again", "justification": "stale"}]}))
+    base = [sys.executable, LINT, "--root", str(tmp_path)]
+    # full-repo default mode: stale entry is a warning, not a failure
+    proc = subprocess.run(base, capture_output=True, text=True,
+                          timeout=120)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "stale" in proc.stderr
+    # tier-1 mode: the committed baseline must be exact
+    proc = subprocess.run(base + ["--check-baseline"],
+                          capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 1
+
+
+def test_cli_rejects_nonexistent_explicit_path(tmp_path):
+    """A typo'd path must error (exit 2), not report 'linted clean'."""
+    proc = subprocess.run(
+        [sys.executable, LINT, "--root", str(tmp_path), "no_such.py"],
+        capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 2
+    assert "no such path" in proc.stderr
+
+
+def test_cli_nags_on_todo_justification(tmp_path):
+    """A TODO-justified baseline entry nags on every run, not only on
+    the --update-baseline that wrote it."""
+    (tmp_path / "bad.py").write_text(RNG_BAD)
+    findings = core.run_lint(str(tmp_path), paths=["bad.py"],
+                             only=["rng-discipline"])
+    core.save_baseline(str(tmp_path / core.BASELINE_NAME), findings)
+    proc = subprocess.run(
+        [sys.executable, LINT, "--root", str(tmp_path), "bad.py",
+         "--only", "rng-discipline"],
+        capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "needs a justification" in proc.stderr
+
+
+def test_cli_json_output(tmp_path):
+    (tmp_path / "bad.py").write_text(RNG_BAD)
+    proc = subprocess.run(
+        [sys.executable, LINT, "--root", str(tmp_path), "bad.py",
+         "--json"], capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 1
+    out = json.loads(proc.stdout)
+    assert out["new"] and out["new"][0]["check"] == "rng-discipline"
+
+
+def test_cli_list_checks():
+    proc = subprocess.run(
+        [sys.executable, LINT, "--list-checks"], capture_output=True,
+        text=True, timeout=120)
+    assert proc.returncode == 0
+    for name in ("trace-purity", "rng-discipline", "donation-safety",
+                 "compat-boundary", "telemetry-hot-path", "schema-drift"):
+        assert name in proc.stdout
+
+
+def test_cli_update_baseline_refuses_partial_run(tmp_path):
+    """A partial run sees a slice of the findings; writing the baseline
+    from it would silently drop every entry outside the slice."""
+    (tmp_path / "bad.py").write_text(RNG_BAD)
+    proc = subprocess.run(
+        [sys.executable, LINT, "--root", str(tmp_path), "bad.py",
+         "--update-baseline"], capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 2
+    assert "full run" in proc.stderr
+    assert not (tmp_path / core.BASELINE_NAME).exists()
+
+
+def test_cli_unknown_checker_is_usage_error():
+    proc = subprocess.run(
+        [sys.executable, LINT, "--only", "no-such-check"],
+        capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 2
+
+
+def test_project_only_run_skips_repo_parse(tmp_path):
+    """`--only schema-drift` reads no files: an unrelated syntax error
+    must not turn the shim's in-sync exit 0 into a bogus failure."""
+    (tmp_path / "broken.py").write_text("x = (\n")
+    found = core.run_lint(str(tmp_path), paths=["broken.py"],
+                          only=["schema-drift"])
+    assert [f for f in found if f.check == "parse-error"] == []
+
+
+def test_project_level_findings_honor_suppression(tmp_path):
+    """The suppression contract covers check_project findings too."""
+
+    class _ProjProbe(core.Checker):
+        name = "proj-probe"
+        description = "test-only"
+        reads_files = True
+
+        def check_project(self, files):
+            return [core.Finding(self.name, "probe.py", 2, 0, "hit")]
+
+    core.CHECKERS[_ProjProbe.name] = _ProjProbe()
+    try:
+        (tmp_path / "probe.py").write_text(
+            "x = 1\ny = 2  # tpulint: disable=proj-probe\n")
+        assert core.run_lint(str(tmp_path), paths=["probe.py"],
+                             only=["proj-probe"]) == []
+        (tmp_path / "probe.py").write_text("x = 1\ny = 2\n")
+        found = core.run_lint(str(tmp_path), paths=["probe.py"],
+                              only=["proj-probe"])
+        assert len(found) == 1 and found[0].check == "proj-probe"
+    finally:
+        del core.CHECKERS[_ProjProbe.name]
+
+
+def test_shim_still_guards_schema(tmp_path):
+    """The deprecated check_schema_drift.py shim execs the lint CLI and
+    keeps the old exit-code contract."""
+    shim = os.path.join(REPO, "scripts", "check_schema_drift.py")
+    proc = subprocess.run([sys.executable, shim], capture_output=True,
+                          text=True, timeout=120)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "deprecated" in proc.stderr

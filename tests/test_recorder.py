@@ -136,9 +136,10 @@ def test_load_falls_back_to_npy(tmp_path):
 
 
 def test_sections_and_record_keys_single_source_of_truth():
-    """The drift-guard contract (scripts/check_schema_drift.py runs the
-    full version in tier1.sh): SECTIONS aliases telemetry.PHASES and the
-    record keys derive from it."""
+    """The drift-guard contract (the tpulint schema-drift checker runs
+    the full live-object version in tier1.sh via scripts/lint.py):
+    SECTIONS aliases telemetry.PHASES and the record keys derive from
+    it."""
     assert tuple(SECTIONS) == tuple(PHASES)
     assert RECORD_KEYS == tuple("t_" + s for s in PHASES if s != "val")
     r = Recorder({"verbose": False, "printFreq": 1})

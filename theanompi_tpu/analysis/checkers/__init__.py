@@ -1,0 +1,11 @@
+"""The project-specific checker suite — importing this package registers
+every checker with :data:`~..core.CHECKERS` (docs/design.md §12)."""
+
+from . import (  # noqa: F401
+    compat_boundary,
+    donation_safety,
+    rng_discipline,
+    schema_drift,
+    telemetry_hot_path,
+    trace_purity,
+)

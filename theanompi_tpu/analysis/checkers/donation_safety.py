@@ -1,0 +1,221 @@
+"""donation-safety: a donated buffer must not be read after the call.
+
+The invariant (docs/design.md §12, guarding the PR-3 AOT-cache rules):
+``jax.jit(..., donate_argnums=...)`` hands the argument's HBM to the
+callee — after the call the old array is invalid, and reading it is
+use-after-free that jax only sometimes catches (and a deserialized AOT
+executable on this container's CPU backend turns into heap corruption,
+which is why ``compile_cache.donated_load_safe`` exists at all).
+
+Per-scope analysis: the checker records names bound to
+``jax.jit(..., donate_argnums=...)`` with their donated positional
+indices (literal argnums, or argnames mapped through an inline
+lambda's signature; an unresolvable spec is skipped rather than
+guessed — a wrong guess would flag the wrong argument), then scans the
+scope linearly —
+a call through such a name marks the argument names/dotted paths at the
+donated positions as dead, a store revives them, and any later read is
+a finding.  The ``state = train_fn(state, ...)`` rebind idiom is
+recognized: consuming and rebinding in one statement is the sanctioned
+in-place-update shape.  Branch bodies scan against a state copy, so
+exclusive arms cannot poison each other.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set
+
+from ..core import Checker, Finding, ImportResolver, SourceFile, register
+
+_JIT_NAMES = {"jax.jit"}
+
+
+def _donated_indices(call: ast.Call) -> Optional[Set[int]]:
+    """Donated positional indices of a jax.jit call, or None when the
+    call donates nothing — or when the spec cannot be resolved
+    STATICALLY (non-literal argnums, argnames against an opaque
+    callee): guessing an index would flag the wrong argument while
+    waving the donated one through, so unresolvable specs are skipped.
+    ``donate_argnames`` resolves when the jitted callee is an inline
+    lambda/visible signature (names map to positional slots)."""
+    for kw in call.keywords:
+        if kw.arg == "donate_argnums":
+            v = kw.value
+            if isinstance(v, ast.Constant) and isinstance(v.value, int):
+                return {v.value}
+            if isinstance(v, (ast.Tuple, ast.List)):
+                idx = {e.value for e in v.elts
+                       if isinstance(e, ast.Constant)
+                       and isinstance(e.value, int)}
+                if idx:
+                    return idx
+            return None
+        if kw.arg == "donate_argnames":
+            names = _literal_names(kw.value)
+            params = _callee_params(call)
+            if names and params:
+                idx = {params.index(n) for n in names if n in params}
+                if idx:
+                    return idx
+            return None
+    return None
+
+
+def _literal_names(v: ast.AST) -> Set[str]:
+    if isinstance(v, ast.Constant) and isinstance(v.value, str):
+        return {v.value}
+    if isinstance(v, (ast.Tuple, ast.List)):
+        return {e.value for e in v.elts
+                if isinstance(e, ast.Constant)
+                and isinstance(e.value, str)}
+    return set()
+
+
+def _callee_params(call: ast.Call) -> Optional[list]:
+    """Positional parameter names of the jitted callee, when visible
+    (an inline lambda)."""
+    if call.args and isinstance(call.args[0], ast.Lambda):
+        a = call.args[0].args
+        return [p.arg for p in list(a.posonlyargs) + list(a.args)]
+    return None
+
+
+@register
+class DonationSafetyChecker(Checker):
+    name = "donation-safety"
+    description = ("a name passed through a donate_argnums call site and "
+                   "read afterwards in the same scope")
+
+    def check_file(self, sf: SourceFile):
+        findings: List[Finding] = []
+        # module-level donating names (`f = jax.jit(g, donate_argnums=0)`
+        # at top level) are visible from every function scope — merge
+        # them under each scope's own collection
+        module_fns = self._collect_donating_fns(sf, sf.tree)
+        scopes = [sf.tree] + [n for n in ast.walk(sf.tree)
+                              if isinstance(n, (ast.FunctionDef,
+                                                ast.AsyncFunctionDef))]
+        for scope in scopes:
+            donated_fns = dict(module_fns)
+            if scope is not sf.tree:
+                donated_fns.update(self._collect_donating_fns(sf, scope))
+            body = scope.body if isinstance(scope.body, list) else []
+            self._scan_block(sf, body, donated_fns, {}, findings)
+        return findings
+
+    # -- pass 1: which names are donating jitted callables -----------------
+
+    def _collect_donating_fns(self, sf: SourceFile, scope
+                              ) -> Dict[str, Set[int]]:
+        out: Dict[str, Set[int]] = {}
+        for st in self._shallow_stmts(scope):
+            if isinstance(st, ast.Assign) and isinstance(st.value, ast.Call):
+                resolved = sf.resolver.resolve(st.value.func)
+                if resolved in _JIT_NAMES:
+                    idx = _donated_indices(st.value)
+                    if idx:
+                        for t in st.targets:
+                            name = ImportResolver.dotted(t)
+                            if name:
+                                out[name] = idx
+        return out
+
+    @staticmethod
+    def _shallow_stmts(scope):
+        """Statements of this scope, not descending into nested defs."""
+        stack = list(scope.body) if isinstance(scope.body, list) else []
+        while stack:
+            st = stack.pop()
+            if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef,
+                               ast.ClassDef)):
+                continue
+            yield st
+            for fieldname in ("body", "orelse", "finalbody"):
+                stack.extend(getattr(st, fieldname, []) or [])
+            for h in getattr(st, "handlers", []):
+                stack.extend(h.body)
+
+    # -- pass 2: linear scan for read-after-donate -------------------------
+
+    def _scan_block(self, sf, stmts, donated_fns: Dict[str, Set[int]],
+                    dead: Dict[str, int], findings: List[Finding]) -> None:
+        for st in stmts:
+            if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef,
+                               ast.ClassDef)):
+                continue
+            if isinstance(st, (ast.If, ast.For, ast.AsyncFor, ast.While,
+                               ast.Try, ast.With, ast.AsyncWith)):
+                header = getattr(st, "test", None) or getattr(st, "iter",
+                                                              None)
+                if header is not None:
+                    self._scan_stmt(sf, header, donated_fns, dead, findings,
+                                    stores=())
+                for fieldname in ("body", "orelse", "finalbody"):
+                    sub = getattr(st, fieldname, None)
+                    if sub:
+                        self._scan_block(sf, sub, donated_fns, dict(dead),
+                                         findings)
+                for h in getattr(st, "handlers", []):
+                    self._scan_block(sf, h.body, donated_fns, dict(dead),
+                                     findings)
+                for n in self._stored_names(st):
+                    dead.pop(n, None)
+                continue
+            stores = tuple(self._stored_names(st))
+            self._scan_stmt(sf, st, donated_fns, dead, findings, stores)
+            for n in stores:
+                dead.pop(n, None)
+
+    def _scan_stmt(self, sf, node, donated_fns, dead, findings,
+                   stores) -> None:
+        """Reads first (a read of a dead name fires even when the same
+        statement rebinds it later — ``y = x + f(x_dead)``), then the
+        donations this statement performs."""
+        # 1. reads of dead names (a dead name in callee position is fine
+        #    — only a donated fn's DATA args die, not the callable)
+        call_funcs = {id(sub.func) for sub in ast.walk(node)
+                      if isinstance(sub, ast.Call)}
+        for sub in ast.walk(node):
+            if isinstance(sub, (ast.Name, ast.Attribute)) and \
+                    isinstance(getattr(sub, "ctx", None), ast.Load):
+                name = ImportResolver.dotted(sub)
+                if name in dead and id(sub) not in call_funcs:
+                    findings.append(Finding(
+                        self.name, sf.path, sub.lineno, sub.col_offset,
+                        f"`{name}` read after being donated on line "
+                        f"{dead[name]} (donate_argnums hands its buffer "
+                        "to the callee; rebind the result instead)"))
+                    dead.pop(name)      # report once per donation
+        # 2. donations performed by calls in this statement
+        for sub in ast.walk(node):
+            if not isinstance(sub, ast.Call):
+                continue
+            idx: Optional[Set[int]] = None
+            fname = ImportResolver.dotted(sub.func)
+            if fname and fname in donated_fns:
+                idx = donated_fns[fname]
+            elif isinstance(sub.func, ast.Call):
+                resolved = sf.resolver.resolve(sub.func.func)
+                if resolved in _JIT_NAMES:
+                    idx = _donated_indices(sub.func)
+            if not idx:
+                continue
+            for i in idx:
+                if i < len(sub.args):
+                    name = ImportResolver.dotted(sub.args[i])
+                    # rebind-in-place (`state = fn(state)`) is the
+                    # sanctioned donation shape — not dead afterwards
+                    if name and name not in stores:
+                        dead[name] = sub.lineno
+
+    @staticmethod
+    def _stored_names(node):
+        for sub in ast.walk(node):
+            if isinstance(sub, (ast.Name, ast.Attribute)) and \
+                    isinstance(getattr(sub, "ctx", None),
+                               (ast.Store, ast.Del)):
+                name = ImportResolver.dotted(sub)
+                if name:
+                    yield name
+

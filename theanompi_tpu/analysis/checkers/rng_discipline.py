@@ -1,0 +1,234 @@
+"""rng-discipline: a ``jax.random`` key must not feed two draws.
+
+The invariant (docs/design.md §12): consuming one key twice makes the
+two draws bit-identical — the exact bug class the fused GoSGD
+``fold_in(count)`` stream exists to prevent (docs/design.md §8: every
+gossip draw derives from ``fold_in(key, count)`` so the in-scan cadence
+draws like k standalone calls).  ``fold_in`` is therefore NOT counted
+as consumption — deriving several independent streams from one key with
+distinct fold data is the sanctioned pattern; ``split`` and every
+sampler are.
+
+Analysis is per-function and per-block: statements scan linearly; a
+name passed as the key argument to a sampler (or ``split``) is marked
+consumed, a store to the name clears it, and a second consumption
+without an interleaving rebinding is a finding.  Branch bodies analyze
+against a COPY of the state (an if/else where each arm draws once is
+fine), which trades a little recall for zero false positives on
+exclusive paths.  A loop body that consumes a key defined outside the
+loop without ever rebinding it is flagged too — the classic
+``for i: x = normal(key)`` freeze.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional
+
+from ..core import Checker, Finding, SourceFile, register
+
+# jax.random.<fn> that CONSUME their key argument.  split consumes (two
+# splits of one key collide); fold_in derives (distinct data → distinct
+# streams) and is deliberately absent.
+_SAMPLERS = {
+    "ball", "bernoulli", "beta", "binomial", "bits", "categorical",
+    "cauchy", "chisquare", "choice", "dirichlet", "double_sided_maxwell",
+    "exponential", "f", "gamma", "generalized_normal", "geometric",
+    "gumbel", "laplace", "loggamma", "logistic", "lognormal", "maxwell",
+    "multinomial", "multivariate_normal", "normal", "orthogonal",
+    "pareto", "permutation", "poisson", "rademacher", "randint",
+    "rayleigh", "split", "t", "triangular", "truncated_normal",
+    "uniform", "wald", "weibull_min",
+}
+
+_BLOCK_FIELDS = ("body", "orelse", "finalbody")
+
+
+@register
+class RngDisciplineChecker(Checker):
+    name = "rng-discipline"
+    description = ("a jax.random key consumed by two draws with no "
+                   "interleaving split/fold_in")
+
+    def check_file(self, sf: SourceFile):
+        findings: List[Finding] = []
+        for node in ast.walk(sf.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self._scan_block(sf, self._stmts(node), {}, findings)
+            elif isinstance(node, ast.Lambda):
+                self._scan_exprs(sf, node.body, {}, findings)
+        # one diagnostic per call site (the loop walk and the linear walk
+        # can both describe the same reuse)
+        seen, out = set(), []
+        for f in findings:
+            if (f.line, f.col) not in seen:
+                seen.add((f.line, f.col))
+                out.append(f)
+        return out
+
+    # -- helpers -----------------------------------------------------------
+
+    @staticmethod
+    def _stmts(fn):
+        body = getattr(fn, "body", None)
+        return body if isinstance(body, list) else []
+
+    def _key_name(self, sf: SourceFile, call: ast.Call) -> Optional[str]:
+        """The consumed key name of a ``jax.random.<sampler>`` call."""
+        resolved = sf.resolver.resolve(call.func)
+        if not resolved or not resolved.startswith("jax.random."):
+            return None
+        if resolved.rsplit(".", 1)[-1] not in _SAMPLERS:
+            return None
+        key_arg = None
+        if call.args:
+            key_arg = call.args[0]
+        for kw in call.keywords:
+            if kw.arg == "key":
+                key_arg = kw.value
+        if isinstance(key_arg, ast.Name):
+            return key_arg.id
+        return None
+
+    def _calls_in_order(self, node):
+        """Calls in (approximate) evaluation order within one statement,
+        not descending into lambdas or nested function defs (their
+        bodies run later, in their own scope with their own fresh
+        parameters — analyzed separately)."""
+        stack = [node]
+        while stack:
+            sub = stack.pop()
+            if isinstance(sub, (ast.Lambda, ast.FunctionDef,
+                                ast.AsyncFunctionDef)):
+                continue
+            if isinstance(sub, ast.Call):
+                yield sub
+            stack.extend(ast.iter_child_nodes(sub))
+
+    @staticmethod
+    def _stores(node):
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Name) and isinstance(sub.ctx,
+                                                        (ast.Store,
+                                                         ast.Del)):
+                yield sub.id
+
+    def _scan_block(self, sf: SourceFile, stmts, consumed: Dict[str, int],
+                    findings: List[Finding]) -> None:
+        """Linear scan; ``consumed`` maps key name → line it was spent."""
+        for st in stmts:
+            # nested function definitions analyze independently (their
+            # bodies run later, against their own keys)
+            if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if isinstance(st, (ast.For, ast.AsyncFor, ast.While)):
+                self._scan_loop(sf, st, dict(consumed), findings)
+                # conservatively clear names the loop stores
+                for n in self._stores(st):
+                    consumed.pop(n, None)
+                continue
+            if isinstance(st, (ast.If, ast.Try, ast.With, ast.AsyncWith)):
+                header = getattr(st, "test", None) or \
+                    getattr(st, "items", None)
+                if header is not None:
+                    items = header if isinstance(header, list) else [header]
+                    for h in items:
+                        h_node = getattr(h, "context_expr", h)
+                        self._scan_exprs(sf, h_node, consumed, findings)
+                for fieldname in _BLOCK_FIELDS:
+                    sub = getattr(st, fieldname, None)
+                    if sub:
+                        self._scan_block(sf, sub, dict(consumed), findings)
+                for h in getattr(st, "handlers", []):
+                    self._scan_block(sf, h.body, dict(consumed), findings)
+                # conservative: anything stored in any arm is fresh after
+                for n in self._stores(st):
+                    consumed.pop(n, None)
+                continue
+            # plain statement: consume keys in expression order, then
+            # apply stores (``key, sub = split(key)`` consumes THEN
+            # rebinds — correct and no finding)
+            self._scan_exprs(sf, st, consumed, findings)
+            for n in self._stores(st):
+                consumed.pop(n, None)
+
+    def _scan_exprs(self, sf, node, consumed, findings,
+                    soft=frozenset()) -> None:
+        """Expression scan, exclusive-path aware: the arms of an
+        ``a if c else b`` (and the short-circuited tail of and/or
+        chains) consume against a state COPY — only one arm runs, so a
+        draw in each is NOT reuse.  ``soft`` holds names whose prior
+        consumption happened OUTSIDE the current conditional position:
+        a first in-arm use of such a name is "maybe reuse" (the arm may
+        never run) and is not reported, but it re-arms the name so a
+        SECOND in-arm use still is.  Consumption in BOTH arms of an
+        IfExp merges back as definite.  Lambdas are their own scope."""
+        if node is None or isinstance(node, ast.Lambda):
+            return
+        if isinstance(node, ast.IfExp):
+            self._scan_exprs(sf, node.test, consumed, findings, soft)
+            arms = []
+            for arm in (node.body, node.orelse):
+                state = dict(consumed)
+                self._scan_exprs(sf, arm, state, findings,
+                                 soft=set(consumed))
+                arms.append(state)
+            # consumed in BOTH arms = definitely consumed (one arm runs)
+            for name in set(arms[0]) & set(arms[1]):
+                consumed.setdefault(name, min(arms[0][name],
+                                              arms[1][name]))
+            return
+        if isinstance(node, ast.BoolOp):
+            self._scan_exprs(sf, node.values[0], consumed, findings, soft)
+            for v in node.values[1:]:     # may be short-circuited away
+                self._scan_exprs(sf, v, dict(consumed), findings,
+                                 soft=set(consumed))
+            return
+        if isinstance(node, ast.Call):
+            # args evaluate before the outer call consumes its key; the
+            # soft set is SHARED down the whole arm (created mutable at
+            # branch entry) so a first soft use re-arms for siblings too
+            for sub in list(node.args) + [kw.value for kw in node.keywords]:
+                self._scan_exprs(sf, sub, consumed, findings, soft)
+            self._scan_exprs(sf, node.func, consumed, findings, soft)
+            name = self._key_name(sf, node)
+            if name is not None:
+                if name in consumed and name in soft:
+                    soft.discard(name)    # re-armed: next in-arm use reports
+                    consumed[name] = node.lineno
+                elif name in consumed:
+                    findings.append(Finding(
+                        self.name, sf.path, node.lineno, node.col_offset,
+                        f"key `{name}` consumed again (first spent on "
+                        f"line {consumed[name]}) with no interleaving "
+                        "split/fold_in — both draws are bit-identical"))
+                else:
+                    consumed[name] = node.lineno
+            return
+        for child in ast.iter_child_nodes(node):
+            self._scan_exprs(sf, child, consumed, findings, soft)
+
+    def _scan_loop(self, sf, loop, consumed, findings) -> None:
+        """Flag keys consumed inside a loop body that the body never
+        rebinds — every iteration replays the same draw."""
+        body_stores = set()
+        for st in loop.body + getattr(loop, "orelse", []):
+            body_stores.update(self._stores(st))
+        if isinstance(loop, (ast.For, ast.AsyncFor)):
+            body_stores.update(self._stores(loop.target))
+
+        for st in loop.body:
+            if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            for call in self._calls_in_order(st):
+                name = self._key_name(sf, call)
+                if name is None:
+                    continue
+                if name not in body_stores:
+                    findings.append(Finding(
+                        self.name, sf.path, call.lineno, call.col_offset,
+                        f"key `{name}` consumed inside a loop without "
+                        "re-split/fold_in — every iteration draws the "
+                        "same bits"))
+        # and the body itself scans linearly for straight-line reuse
+        self._scan_block(sf, loop.body, dict(consumed), findings)

@@ -1,0 +1,97 @@
+"""schema-drift: recorder/telemetry phase vocabulary stays in sync.
+
+Absorbs ``scripts/check_schema_drift.py`` (now a deprecation shim that
+execs the lint CLI): three consumers must agree on the phase/section
+vocabulary with ``telemetry.PHASES`` as the ONE source of truth —
+``recorder.SECTIONS``, the ``print_train_info`` record keys
+(``t_<section>``), and the telemetry phase-event names.  A bucket added
+to one but not the others silently drops that phase from records,
+plots, or reports.
+
+Unlike the AST checkers this is a PROJECT-level probe against LIVE
+objects (a Recorder driven through one print, a Telemetry instance fed
+one bracket per phase), so a hand-rolled record dict drifting from the
+declared list is caught too.  Both modules import without jax
+(``telemetry`` is stdlib-only by contract, ``recorder`` needs numpy),
+so the lint CLI stays backend-free.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+from ..core import Checker, Finding, register
+
+TELEMETRY_PATH = "theanompi_tpu/utils/telemetry.py"
+RECORDER_PATH = "theanompi_tpu/utils/recorder.py"
+
+
+def live_drift_errors(recorder, telemetry) -> List[tuple]:
+    """The live-object checks, parameterized on the two modules so tests
+    can probe failure modes with monkeypatched stand-ins.  Returns
+    ``(path, message)`` pairs; empty = in sync."""
+    errors: List[tuple] = []
+
+    # 1. recorder.SECTIONS must BE the canonical list
+    if tuple(recorder.SECTIONS) != tuple(telemetry.PHASES):
+        errors.append((RECORDER_PATH,
+                       f"recorder.SECTIONS {tuple(recorder.SECTIONS)!r} != "
+                       f"telemetry.PHASES {tuple(telemetry.PHASES)!r}"))
+
+    # 2. the record keys a live print_train_info actually emits
+    r = recorder.Recorder({"verbose": False, "printFreq": 1})
+    r.start()
+    r.end("train")
+    r.train_error(1, 1.0, 0.5, 8)
+    rec = r.print_train_info(1)
+    if not rec:
+        errors.append((RECORDER_PATH,
+                       "print_train_info(1) did not fire at printFreq=1"))
+    else:
+        got = {k for k in rec if k.startswith("t_")}
+        want = {"t_" + s for s in telemetry.PHASES if s != "val"}
+        if got != want:
+            errors.append((RECORDER_PATH,
+                           f"print_train_info record keys {sorted(got)} != "
+                           f"t_<PHASES except val> {sorted(want)}"))
+    if tuple(recorder.RECORD_KEYS) != tuple(
+            "t_" + s for s in telemetry.PHASES if s != "val"):
+        errors.append((RECORDER_PATH,
+                       f"recorder.RECORD_KEYS {tuple(recorder.RECORD_KEYS)!r}"
+                       " drifted from telemetry.PHASES"))
+
+    # 3. the phase-event names a live registry emits for each section
+    tm = telemetry.Telemetry(rank=0, run_id="drift-check")
+    for s in telemetry.PHASES:
+        tm.phase(s, 0.0)
+    evs = [e for e in tm.tail(len(telemetry.PHASES) + 1)
+           if e["ev"] == "phase"]
+    got_secs = {e.get("sec") for e in evs}
+    if got_secs != set(telemetry.PHASES):
+        errors.append((TELEMETRY_PATH,
+                       f"telemetry phase-event names {sorted(got_secs)} != "
+                       f"PHASES {sorted(telemetry.PHASES)}"))
+    got_hists = {k for k in tm.hists if k.startswith("phase.")}
+    if got_hists != {"phase." + s for s in telemetry.PHASES}:
+        errors.append((TELEMETRY_PATH,
+                       f"telemetry phase histograms {sorted(got_hists)} "
+                       "drifted from PHASES"))
+    return errors
+
+
+@register
+class SchemaDriftChecker(Checker):
+    name = "schema-drift"
+    description = ("recorder.SECTIONS / print_train_info record keys / "
+                   "telemetry phase events must derive from telemetry."
+                   "PHASES (live-object probe)")
+    reads_files = False    # `--only schema-drift` skips the repo parse
+
+    def check_project(self, files):
+        # normal import both under pytest (real package loaded) and under
+        # the lint CLI (scripts/lint.py registers a synthetic
+        # `theanompi_tpu` parent whose __path__ skips the jax-importing
+        # package __init__)
+        from theanompi_tpu.utils import recorder, telemetry
+        return [Finding(self.name, path, 1, 0, msg)
+                for path, msg in live_drift_errors(recorder, telemetry)]

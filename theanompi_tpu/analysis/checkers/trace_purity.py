@@ -1,0 +1,294 @@
+"""trace-purity: no host-side leaks inside functions that get traced.
+
+The invariant (docs/design.md §12): a function that flows into
+``jax.jit`` / ``lax.scan`` / ``lax.cond`` / ``shard_map`` executes at
+TRACE time — a ``time.time()`` there stamps the compile, not the step;
+``np.random`` draws freeze one sample into the executable; ``print``
+fires once per compile (or not at all on a cache hit); ``.item()`` /
+``jax.device_get`` force a device sync mid-trace; and a Python ``if`` on
+a tracer either fails to trace or, worse, specializes on one concrete
+value.  All of these are the silent-throughput/correctness bug class
+the Theano-MPI and pjit-scaling papers attribute regressions to.
+
+Seeding: within each file, every function object passed (positionally)
+to a trace wrapper is traced — ``per_worker`` into ``shard_map``,
+``body`` into ``lax.scan``, ``self.exchange_body`` into the standalone
+collective (``steps.py`` / ``exchanger.py`` / ``model_base.py`` entry
+points all match this shape) — plus, transitively, every same-file
+function they call by name (module-level, enclosing-local, or
+``self.<method>``: all same-named methods in the file, covering
+subclass overrides like the rules' ``exchange_body``).
+
+The Python-``if``-on-tracer check is restricted to functions passed to
+``lax.scan``-family primitives, whose arguments are tracers BY
+CONSTRUCTION (jit/shard_map args can be static); there it flags
+``if``/``while`` tests that read a parameter name.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from ..core import Checker, Finding, SourceFile, register
+
+# Wrappers whose (positional) function arguments get traced.
+TRACE_WRAPPERS = {
+    "jax.jit",
+    "jax.grad",
+    "jax.value_and_grad",
+    "jax.checkpoint",
+    "jax.remat",
+    "jax.vmap",
+    "jax.shard_map",
+    "jax.experimental.shard_map.shard_map",
+    "theanompi_tpu.jax_compat.shard_map",
+    "jax.lax.scan",
+    "jax.lax.cond",
+    "jax.lax.switch",
+    "jax.lax.while_loop",
+    "jax.lax.fori_loop",
+    "jax.lax.map",
+    "jax.lax.associative_scan",
+}
+
+# Subset whose function arguments receive TRACERS by construction —
+# a Python `if` on their parameters cannot be a static-config branch.
+TRACER_ARG_WRAPPERS = {
+    "jax.lax.scan", "jax.lax.cond", "jax.lax.switch",
+    "jax.lax.while_loop", "jax.lax.fori_loop", "jax.lax.map",
+    "jax.lax.associative_scan",
+}
+
+HOST_CLOCKS = {"time.time", "time.perf_counter", "time.monotonic",
+               "time.process_time", "time.sleep"}
+SYNC_CALLS = {"jax.device_get"}
+
+_FuncNode = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+
+
+def _func_params(fn) -> Set[str]:
+    a = fn.args
+    names = [p.arg for p in
+             list(a.posonlyargs) + list(a.args) + list(a.kwonlyargs)]
+    if a.vararg:
+        names.append(a.vararg.arg)
+    if a.kwarg:
+        names.append(a.kwarg.arg)
+    return {n for n in names if n not in ("self", "cls")}
+
+
+class _Index:
+    """Per-file function index: defs by enclosing scope, methods by name."""
+
+    def __init__(self, sf: SourceFile):
+        self.sf = sf
+        # id(scope-node-or-None) -> {name: [def nodes]}
+        self.by_scope: Dict[Optional[int], Dict[str, List[ast.AST]]] = {}
+        # method name -> [def nodes] across every class in the file
+        self.methods: Dict[str, List[ast.AST]] = {}
+        # def node id -> enclosing function node (for local lookup chains)
+        self.parent_func: Dict[int, Optional[ast.AST]] = {}
+        self._walk(sf.tree, None, None)
+
+    def _walk(self, node, func: Optional[ast.AST], cls: Optional[ast.AST]):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                scope = self.by_scope.setdefault(
+                    id(func) if func else None, {})
+                scope.setdefault(child.name, []).append(child)
+                if cls is not None and func is None or \
+                        (cls is not None and isinstance(node, ast.ClassDef)):
+                    self.methods.setdefault(child.name, []).append(child)
+                self.parent_func[id(child)] = func
+                self._walk(child, child, None)
+            elif isinstance(child, ast.ClassDef):
+                self._walk(child, func, child)
+            elif isinstance(child, ast.Lambda):
+                self.parent_func[id(child)] = func
+                self._walk(child, child, None)
+            else:
+                self._walk(child, func, cls)
+
+    def lookup(self, name: str, from_func: Optional[ast.AST]
+               ) -> List[ast.AST]:
+        """Defs named ``name`` visible from ``from_func``: its locals,
+        then enclosing functions', then module level."""
+        seen: List[ast.AST] = []
+        f = from_func
+        while True:
+            scope = self.by_scope.get(id(f) if f else None, {})
+            if name in scope:
+                seen.extend(scope[name])
+                return seen
+            if f is None:
+                return seen
+            f = self.parent_func.get(id(f))
+
+
+@register
+class TracePurityChecker(Checker):
+    name = "trace-purity"
+    description = ("host clocks, numpy RNG, print, .item()/device_get, "
+                   "and Python `if` on tracer args inside traced functions")
+
+    def check_file(self, sf: SourceFile):
+        idx = _Index(sf)
+        resolver = sf.resolver
+
+        # ---- seed: functions passed positionally to trace wrappers ----
+        traced: Dict[int, ast.AST] = {}           # id -> def node
+        tracer_args: Set[int] = set()             # ids with tracer params
+        # enclosing function of every node (for name lookup at call sites)
+        encl: Dict[int, Optional[ast.AST]] = {}
+
+        def record_enclosing(node, func):
+            encl[id(node)] = func
+            for child in ast.iter_child_nodes(node):
+                record_enclosing(
+                    child, child if isinstance(child, _FuncNode) else func)
+
+        record_enclosing(sf.tree, None)
+
+        def mark(node, scan_like: bool, from_func):
+            """Mark function refs found in a trace-wrapper argument."""
+            for sub in ast.walk(node):
+                targets: List[ast.AST] = []
+                if isinstance(sub, ast.Lambda):
+                    targets = [sub]
+                elif isinstance(sub, ast.Name):
+                    targets = idx.lookup(sub.id, from_func)
+                elif isinstance(sub, ast.Attribute) and \
+                        isinstance(sub.value, ast.Name) and \
+                        sub.value.id in ("self", "cls"):
+                    targets = idx.methods.get(sub.attr, [])
+                for t in targets:
+                    if id(t) not in traced:
+                        traced[id(t)] = t
+                    if scan_like:
+                        tracer_args.add(id(t))
+
+        def decorator_traces(dec) -> bool:
+            """``@jax.jit``, ``@jax.jit(...)``, and
+            ``@functools.partial(jax.jit, ...)`` all trace the function
+            they decorate."""
+            if resolver.resolve(dec) in TRACE_WRAPPERS:
+                return True
+            if isinstance(dec, ast.Call):
+                if resolver.resolve(dec.func) in TRACE_WRAPPERS:
+                    return True
+                if resolver.resolve(dec.func) == "functools.partial" \
+                        and dec.args \
+                        and resolver.resolve(dec.args[0]) in \
+                        TRACE_WRAPPERS:
+                    return True
+            return False
+
+        for node in ast.walk(sf.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                if any(decorator_traces(d) for d in node.decorator_list):
+                    traced.setdefault(id(node), node)
+                continue
+            if not isinstance(node, ast.Call):
+                continue
+            resolved = resolver.resolve(node.func)
+            if resolved not in TRACE_WRAPPERS:
+                continue
+            scan_like = resolved in TRACER_ARG_WRAPPERS
+            # keywords too (`lax.scan(f=body, ...)`, `jax.jit(fun=...)`)
+            # — mark() only marks names that resolve to function DEFS,
+            # so spec/mesh kwargs stay invisible
+            for arg in list(node.args) + [kw.value for kw in
+                                          node.keywords]:
+                mark(arg, scan_like, encl.get(id(node.func)))
+
+        # ---- transitive closure: same-file calls from traced functions ----
+        changed = True
+        while changed:
+            changed = False
+            for fid, fn in list(traced.items()):
+                for sub in self._body_walk(fn):
+                    if not isinstance(sub, ast.Call):
+                        continue
+                    targets: List[ast.AST] = []
+                    if isinstance(sub.func, ast.Name):
+                        targets = idx.lookup(sub.func.id, fn)
+                    elif isinstance(sub.func, ast.Attribute) and \
+                            isinstance(sub.func.value, ast.Name) and \
+                            sub.func.value.id in ("self", "cls"):
+                        targets = idx.methods.get(sub.func.attr, [])
+                    for t in targets:
+                        if id(t) not in traced:
+                            traced[id(t)] = t
+                            changed = True
+
+        # ---- walk each traced function for host leaks ----
+        findings: List[Finding] = []
+        seen_lines: Set[Tuple[int, str]] = set()
+
+        def emit(node, msg):
+            key = (node.lineno, msg)
+            if key not in seen_lines:
+                seen_lines.add(key)
+                findings.append(Finding(self.name, sf.path, node.lineno,
+                                        node.col_offset, msg))
+
+        for fid, fn in traced.items():
+            fname = getattr(fn, "name", "<lambda>")
+            params = _func_params(fn)
+            check_ifs = fid in tracer_args
+            for sub in self._body_walk(fn):
+                if isinstance(sub, ast.Call):
+                    resolved = resolver.resolve(sub.func)
+                    if resolved in HOST_CLOCKS:
+                        emit(sub, f"host clock `{resolved}()` inside "
+                                  f"traced function `{fname}`")
+                    elif resolved and resolved.startswith("numpy.random."):
+                        emit(sub, f"host RNG `{resolved}()` inside traced "
+                                  f"function `{fname}` (freezes one draw "
+                                  "into the compiled program)")
+                    elif resolved in SYNC_CALLS:
+                        emit(sub, f"`{resolved}()` inside traced function "
+                                  f"`{fname}` (host sync mid-trace)")
+                    elif isinstance(sub.func, ast.Name) and \
+                            sub.func.id in ("print", "breakpoint", "input") \
+                            and not idx.lookup(sub.func.id, fn):
+                        emit(sub, f"host `{sub.func.id}()` inside traced "
+                                  f"function `{fname}` (fires at trace "
+                                  "time, not per step)")
+                    elif isinstance(sub.func, ast.Attribute) and \
+                            sub.func.attr == "item" and not sub.args \
+                            and not sub.keywords:
+                        emit(sub, f"`.item()` inside traced function "
+                                  f"`{fname}` (host sync mid-trace)")
+                elif check_ifs and isinstance(sub, (ast.If, ast.While)):
+                    hit = self._test_param(sub.test, params)
+                    if hit:
+                        kind = "while" if isinstance(sub, ast.While) \
+                            else "if"
+                        emit(sub, f"Python `{kind}` on tracer-typed name "
+                                  f"`{hit}` inside `{fname}` (args of "
+                                  "scan/cond bodies are tracers; use "
+                                  "lax.cond/jnp.where)")
+        return findings
+
+    @staticmethod
+    def _test_param(test: ast.AST, params: Set[str]) -> Optional[str]:
+        for sub in ast.walk(test):
+            if isinstance(sub, ast.Name) and sub.id in params and \
+                    isinstance(sub.ctx, ast.Load):
+                return sub.id
+        return None
+
+    @staticmethod
+    def _body_walk(fn):
+        """Walk a function's body, NOT descending into nested
+        FunctionDefs (traced separately if reachable) but following
+        inline lambdas (they run at trace time via tree.map etc.)."""
+        stack = list(ast.iter_child_nodes(fn))
+        while stack:
+            node = stack.pop()
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            yield node
+            stack.extend(ast.iter_child_nodes(node))

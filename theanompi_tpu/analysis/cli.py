@@ -1,0 +1,152 @@
+"""tpulint CLI — the one analysis entry point ``scripts/lint.py`` execs.
+
+Modes:
+
+* default: run the suite, print findings; non-baselined findings fail
+  (exit 1), stale baseline entries only warn.
+* ``--check-baseline`` (the tier-1 gate): ALSO fail on stale entries —
+  the committed baseline must be exact (no drift in either direction).
+* ``--update-baseline``: regenerate ``tpulint_baseline.json``
+  deterministically (sorted, path-relative), preserving justifications
+  of retained entries; new entries get ``TODO: justify``.
+* ``--json``: machine-readable findings + baseline delta.
+* ``--only`` / ``--disable``: comma-separated checker names;
+  ``--list-checks`` prints the registry.
+
+Exit codes: 0 clean, 1 findings/drift, 2 bad invocation.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import List, Optional
+
+from . import checkers as _checkers  # noqa: F401  (registers the suite)
+from .core import (BASELINE_NAME, CHECKERS, compare_baseline, load_baseline,
+                   run_lint, save_baseline)
+
+
+def _repo_root() -> str:
+    # core.py lives at <root>/theanompi_tpu/analysis/cli.py
+    return os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+
+
+def _split(value: Optional[str]) -> Optional[List[str]]:
+    if value is None:
+        return None
+    return [v.strip() for v in value.split(",") if v.strip()]
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="lint.py",
+        description="tpulint — AST invariant checkers (docs/design.md §12)")
+    ap.add_argument("paths", nargs="*",
+                    help="files/dirs to lint (default: the repo set)")
+    ap.add_argument("--root", default=None,
+                    help="repo root (default: inferred from this file)")
+    ap.add_argument("--json", action="store_true", dest="as_json")
+    ap.add_argument("--only", default=None,
+                    help="comma-separated checker names to run")
+    ap.add_argument("--disable", default=None,
+                    help="comma-separated checker names to skip")
+    ap.add_argument("--baseline", default=None,
+                    help=f"baseline file (default: <root>/{BASELINE_NAME})")
+    ap.add_argument("--update-baseline", action="store_true")
+    ap.add_argument("--check-baseline", action="store_true",
+                    help="fail on stale baseline entries too (tier-1 mode)")
+    ap.add_argument("--list-checks", action="store_true")
+    try:
+        args = ap.parse_args(argv)
+    except SystemExit as e:
+        return 2 if e.code not in (0, None) else 0
+
+    if args.list_checks:
+        for name in sorted(CHECKERS):
+            print(f"{name}: {CHECKERS[name].description}")
+        return 0
+
+    root = os.path.abspath(args.root or _repo_root())
+    baseline_path = args.baseline or os.path.join(root, BASELINE_NAME)
+    # a typo'd explicit path must not read as "linted clean" — the
+    # default set is allowed to have absent members (bare roots), an
+    # explicitly named one is not
+    missing = [p for p in args.paths
+               if not os.path.exists(os.path.join(root, p))]
+    if missing:
+        print(f"lint: no such path(s): {', '.join(missing)}",
+              file=sys.stderr)
+        return 2
+    try:
+        findings = run_lint(root, paths=args.paths or None,
+                            only=_split(args.only),
+                            disable=_split(args.disable))
+    except KeyError as e:
+        print(f"lint: {e.args[0]}", file=sys.stderr)
+        return 2
+
+    entries = load_baseline(baseline_path)
+    # a partial run (explicit paths / --only) must not call untouched
+    # baseline entries stale — staleness is only meaningful repo-wide
+    partial = bool(args.paths or args.only or args.disable)
+    new, matched, stale = compare_baseline(findings, entries)
+    if partial:
+        stale = []
+
+    if args.update_baseline:
+        if partial:
+            # a partial run only sees a slice of the findings — writing
+            # it out would silently drop every entry outside the slice
+            print("lint: --update-baseline requires a full run (no "
+                  "paths/--only/--disable)", file=sys.stderr)
+            return 2
+        saved = save_baseline(baseline_path, findings, entries)
+        print(f"tpulint: baseline written to "
+              f"{os.path.relpath(baseline_path, root)} "
+              f"({len(saved)} entries)")
+        todo = sum(1 for e in saved
+                   if e["justification"].startswith("TODO"))
+        if todo:
+            print(f"tpulint: {todo} entries need a justification "
+                  "(edit the file)", file=sys.stderr)
+        return 0
+
+    # the documented baseline contract: entries carry a real one-line
+    # justification; TODO placeholders nag on EVERY run, not just the
+    # --update-baseline that wrote them
+    todo = [e for e in entries
+            if str(e.get("justification", "")).startswith("TODO")]
+    if todo:
+        # stderr, so --json stdout stays machine-readable
+        for e in todo:
+            print(f"baseline entry needs a justification: "
+                  f"{e.get('check')}: {e.get('path')}: "
+                  f"{e.get('message')}", file=sys.stderr)
+
+    if args.as_json:
+        print(json.dumps({
+            "findings": [f.to_dict() for f in findings],
+            "new": [f.to_dict() for f in new],
+            "baselined": len(matched),
+            "stale_baseline": stale,
+        }, indent=2, sort_keys=True))
+    else:
+        for f in new:
+            print(f.render())
+        for e in stale:
+            print(f"stale baseline entry: {e.get('check')}: "
+                  f"{e.get('path')}: {e.get('message')}", file=sys.stderr)
+        status = (f"tpulint: {len(findings)} finding(s) — {len(new)} new, "
+                  f"{len(matched)} baselined, {len(stale)} stale baseline "
+                  "entr(ies)")
+        print(status)
+
+    if new:
+        return 1
+    if stale and args.check_baseline:
+        return 1
+    return 0

@@ -37,7 +37,7 @@ IMAGES_PER_REPORT = 5120
 # win (and a resume recompiling from scratch) visible per run.
 # The list itself lives in telemetry.PHASES — ONE source of truth for the
 # recorder buckets, the t_<section> record keys below, and the telemetry
-# phase-event names (scripts/check_schema_drift.py guards the sync).
+# phase-event names (the tpulint schema-drift checker guards the sync).
 SECTIONS = telemetry.PHASES
 
 # the per-print record carries every section except `val` (val time is

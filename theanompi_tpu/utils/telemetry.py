@@ -54,8 +54,8 @@ from typing import Any, Dict, List, Optional
 # ``recorder.SECTIONS``, the ``print_train_info`` record keys
 # (``t_<phase>``), and the telemetry phase-event names
 # (``phase`` events' ``sec`` field / ``phase.<name>`` histograms).
-# ``scripts/check_schema_drift.py`` (run by ``scripts/tier1.sh``) fails
-# the gate when any consumer drifts from this tuple.
+# The tpulint ``schema-drift`` checker (``scripts/lint.py``, run by
+# ``scripts/tier1.sh``) fails the gate when any consumer drifts.
 PHASES = ("compile", "train", "comm", "wait", "load", "stage", "val")
 
 SCHEMA_VERSION = 1
