@@ -1,0 +1,41 @@
+#!/usr/bin/env bash
+# tpulint pre-commit entry point: lint exactly the STAGED content of the
+# staged .py files.
+#
+#   ln -s ../../scripts/precommit_lint.sh .git/hooks/pre-commit
+#
+# Staged paths are filtered to the repo lint scope (theanompi_tpu/,
+# scripts/, tests/, bench.py — the same roots the tier-1 gate walks); a
+# commit touching nothing in scope lints nothing and exits 0.  The
+# staged BLOBS are checked out of the index into a temp tree and linted
+# there (`--root`), so the verdict matches what the commit will contain
+# even when the worktree has further unstaged edits — while the repo's
+# baseline and .tpulint_cache/ are passed through, so a re-commit of
+# unchanged staged content is a cache hit.  Exit codes follow
+# scripts/lint.py: 0 clean, 1 findings, 2 usage.
+set -u
+cd "$(dirname "$0")/.."
+repo="$PWD"
+
+staged=()
+while IFS= read -r f; do
+    case "$f" in
+        theanompi_tpu/*.py|scripts/*.py|tests/*.py|bench.py)
+            staged+=("$f")
+            ;;
+    esac
+done < <(git diff --cached --name-only --diff-filter=ACMR -- '*.py')
+
+if [ ${#staged[@]} -eq 0 ]; then
+    echo "precommit-lint: no staged python files in lint scope"
+    exit 0
+fi
+
+tmp="$(mktemp -d "${TMPDIR:-/tmp}/tpulint-precommit.XXXXXX")"
+trap 'rm -rf "$tmp"' EXIT
+git checkout-index --prefix="$tmp/" -- "${staged[@]}" || exit 2
+
+python scripts/lint.py --root "$tmp" \
+    --baseline "$repo/tpulint_baseline.json" \
+    --cache-dir "$repo/.tpulint_cache" \
+    "${staged[@]}"

@@ -6,13 +6,16 @@
 # Exits with pytest's return code; prints DOTS_PASSED=<n> as the last line.
 #
 # Preceded by the tpulint suite (scripts/lint.py --check-baseline): the
-# AST invariant checkers of docs/design.md §12 — trace purity inside
-# fused scan bodies, jax.random key discipline, donation safety,
-# the jax_compat shim boundary, the telemetry hot-path enabled-guard
-# contract, and the recorder/telemetry schema sync (the old
-# check_schema_drift.py guard, absorbed as a checker).  Any finding not
-# covered by tpulint_baseline.json — or a stale baseline entry — fails
-# the gate here, in seconds and without importing jax, before pytest.
+# whole-program invariant checkers of docs/design.md §12 — trace purity
+# and rng/donation discipline closed over the repo-wide call graph
+# (analysis/engine.py), SPMD collective discipline (axis names,
+# rank-divergent branches, start/done pairing), PartitionSpec/shard_map
+# schema checks, exchange_body symmetry, the jax_compat shim boundary,
+# the telemetry hot-path enabled-guard contract, and the recorder/
+# telemetry schema sync.  Any finding not covered by
+# tpulint_baseline.json — or a stale baseline entry — fails the gate
+# here, without importing jax, before pytest.  An unchanged tree is a
+# .tpulint_cache/ hit: the gate costs well under a second.
 cd "$(dirname "$0")/.."
 python scripts/lint.py --check-baseline || { echo "tier1: tpulint gate FAILED (run scripts/lint.py for details)" >&2; exit 9; }
 set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=${PIPESTATUS[0]}; echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c); exit $rc

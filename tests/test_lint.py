@@ -741,3 +741,734 @@ def test_shim_still_guards_schema(tmp_path):
                           text=True, timeout=120)
     assert proc.returncode == 0, proc.stdout + proc.stderr
     assert "deprecated" in proc.stderr
+
+
+# ---------------------------------------------------------------------------
+# whole-program engine: cross-file closure + call-graph resolution
+# ---------------------------------------------------------------------------
+
+def _write_pkg(tmp_path, files):
+    """Lay out a package tree and return the repo-relative paths."""
+    rels = []
+    for rel, code in files.items():
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(code)
+        rels.append(rel)
+    return rels
+
+
+def test_trace_purity_cross_file_host_clock(tmp_path):
+    """The ISSUE-6 motivating case: a host clock TWO modules away from
+    the scan body must be visible to the closure."""
+    rels = _write_pkg(tmp_path, {
+        "pkg/__init__.py": "",
+        "pkg/clock.py": (
+            "import time\n"
+            "def stamp():\n"
+            "    return time.time()\n"),
+        "pkg/mid.py": (
+            "from .clock import stamp\n"
+            "def helper(x):\n"
+            "    return x + stamp()\n"),
+        "pkg/body.py": (
+            "from jax import lax\n"
+            "from .mid import helper\n"
+            "def build(xs):\n"
+            "    def body(c, x):\n"
+            "        return helper(c), x\n"
+            "    return lax.scan(body, 0.0, xs)\n"),
+    })
+    found = core.run_lint(str(tmp_path), paths=rels,
+                          only=["trace-purity"])
+    assert len(found) == 1, [f.render() for f in found]
+    assert found[0].path == "pkg/clock.py"
+    assert "time.time" in found[0].message
+
+
+def test_trace_purity_cross_file_good(tmp_path):
+    """The same helper chain WITHOUT the host clock stays silent, and a
+    host-side caller of the clock helper is not flagged."""
+    rels = _write_pkg(tmp_path, {
+        "pkg/__init__.py": "",
+        "pkg/clock.py": (
+            "import time\n"
+            "def stamp():\n"
+            "    return time.time()\n"),
+        "pkg/body.py": (
+            "from jax import lax\n"
+            "from .clock import stamp\n"
+            "def host_loop(xs):\n"
+            "    t0 = stamp()\n"         # host side: fine
+            "    def body(c, x):\n"
+            "        return c + x, x\n"
+            "    return lax.scan(body, 0.0, xs), stamp() - t0\n"),
+    })
+    assert core.run_lint(str(tmp_path), paths=rels,
+                         only=["trace-purity"]) == []
+
+
+def test_trace_purity_method_override_reached_cross_file(tmp_path):
+    """`self.exchange_body` passed to shard_map must close over a
+    SUBCLASS override defined in another file."""
+    rels = _write_pkg(tmp_path, {
+        "pkg/__init__.py": "",
+        "pkg/base.py": (
+            "from theanompi_tpu.jax_compat import shard_map\n"
+            "class Base:\n"
+            "    def build(self, mesh, spec):\n"
+            "        return shard_map(self.exchange_body, mesh=mesh,\n"
+            "                         in_specs=(spec,), out_specs=spec)\n"
+            "    def exchange_body(self, state):\n"
+            "        return state\n"),
+        "pkg/sub.py": (
+            "import time\n"
+            "from .base import Base\n"
+            "class Sub(Base):\n"
+            "    def exchange_body(self, state):\n"
+            "        t = time.time()\n"
+            "        return state\n"),
+    })
+    found = core.run_lint(str(tmp_path), paths=rels,
+                          only=["trace-purity"])
+    assert len(found) == 1 and found[0].path == "pkg/sub.py", \
+        [f.render() for f in found]
+
+
+def test_rng_discipline_cross_file_reuse(tmp_path):
+    """A helper that spends its key parameter makes two same-key calls
+    of it reuse — even when the helper lives in another module."""
+    rels = _write_pkg(tmp_path, {
+        "pkg/__init__.py": "",
+        "pkg/draws.py": (
+            "import jax\n"
+            "def draw(key, shape):\n"
+            "    return jax.random.normal(key, shape)\n"),
+        "pkg/use.py": (
+            "from .draws import draw\n"
+            "def run(key):\n"
+            "    a = draw(key, (4,))\n"
+            "    b = draw(key, (4,))\n"
+            "    return a + b\n"),
+    })
+    found = core.run_lint(str(tmp_path), paths=rels,
+                          only=["rng-discipline"])
+    assert len(found) == 1 and found[0].path == "pkg/use.py", \
+        [f.render() for f in found]
+    assert "key `key` consumed again" in found[0].message
+
+
+def test_rng_discipline_cross_file_fold_in_helper_ok(tmp_path):
+    """A helper that only DERIVES (fold_in) does not consume — two
+    calls with one key are the sanctioned multi-stream pattern."""
+    rels = _write_pkg(tmp_path, {
+        "pkg/__init__.py": "",
+        "pkg/draws.py": (
+            "import jax\n"
+            "def derive(key, n):\n"
+            "    return jax.random.fold_in(key, n)\n"),
+        "pkg/use.py": (
+            "from .draws import derive\n"
+            "def run(key):\n"
+            "    return derive(key, 1), derive(key, 2)\n"),
+    })
+    assert core.run_lint(str(tmp_path), paths=rels,
+                         only=["rng-discipline"]) == []
+
+
+def test_donation_safety_cross_file_donating_import(tmp_path):
+    """`from train import step` where train.py jits with donation:
+    read-after-donate at the importing call site."""
+    rels = _write_pkg(tmp_path, {
+        "pkg/__init__.py": "",
+        "pkg/train.py": (
+            "import jax\n"
+            "def g(s):\n"
+            "    return s\n"
+            "step = jax.jit(g, donate_argnums=0)\n"),
+        "pkg/use.py": (
+            "from .train import step\n"
+            "def run(state):\n"
+            "    out = step(state)\n"
+            "    return out, state['params']\n"),
+    })
+    found = core.run_lint(str(tmp_path), paths=rels,
+                          only=["donation-safety"])
+    assert len(found) == 1 and found[0].path == "pkg/use.py", \
+        [f.render() for f in found]
+    assert "`state` read after being donated" in found[0].message
+
+
+def test_engine_resolves_every_exchange_body_override():
+    """Repo-wide: the call graph must see the whole exchange_body
+    override family (the checkers build on exactly this)."""
+    from theanompi_tpu.analysis.engine import ProgramIndex
+    files = core.collect_files(REPO, ["theanompi_tpu"])
+    index = ProgramIndex(files)
+    recs = index.method_records(
+        ("theanompi_tpu.parallel.exchanger", "Exchanger"),
+        "exchange_body")
+    owners = {r.class_name for r in recs}
+    assert {"Exchanger", "BSP_Exchanger", "EASGD_Exchanger",
+            "ASGD_Exchanger", "GOSGD_Exchanger"} <= owners, owners
+    # and the symmetry checker enumerates the same family
+    from theanompi_tpu.analysis.checkers.exchange_symmetry import \
+        ExchangeSymmetryChecker
+    bodies = ExchangeSymmetryChecker()._exchange_bodies(index)
+    assert {r.class_name for r in bodies} >= {
+        "BSP_Exchanger", "EASGD_Exchanger", "ASGD_Exchanger",
+        "GOSGD_Exchanger"}
+
+
+# ---------------------------------------------------------------------------
+# collective-discipline
+# ---------------------------------------------------------------------------
+
+def test_collective_discipline_axis_typo(tmp_path):
+    code = (
+        "from jax import lax\n"
+        "def exchange(x):\n"
+        "    return lax.pmean(x, 'workerz')\n")
+    found = lint_snippet(tmp_path, "x.py", code, "collective-discipline")
+    assert len(found) == 1
+    assert "undeclared mesh axis 'workerz'" in found[0].message
+
+
+def test_collective_discipline_axis_constant_prop(tmp_path):
+    """The `axis, alpha = WORKER_AXIS, self.alpha` tuple-assign shape
+    (exchanger.py) resolves through constant propagation."""
+    code = (
+        "from jax import lax\n"
+        "from theanompi_tpu.parallel.mesh import WORKER_AXIS\n"
+        "def good(x, alpha):\n"
+        "    axis, a = WORKER_AXIS, alpha\n"
+        "    return lax.psum(x, axis)\n"
+        "def bad(x, alpha):\n"
+        "    axis, a = 'workerz', alpha\n"
+        "    return lax.psum(x, axis)\n")
+    found = lint_snippet(tmp_path, "x.py", code, "collective-discipline")
+    assert len(found) == 1 and found[0].line == 8, \
+        [f.render() for f in found]
+
+
+def test_collective_discipline_same_file_mesh_declares_axis(tmp_path):
+    """An axis declared by a literal Mesh(...) in the same file is
+    valid vocabulary (tests declare ('workers', 'seq') meshes)."""
+    code = (
+        "import numpy as np\n"
+        "import jax\n"
+        "from jax import lax\n"
+        "from jax.sharding import Mesh\n"
+        "mesh = Mesh(np.array(jax.devices()), ('rows', 'cols'))\n"
+        "def f(x):\n"
+        "    return lax.psum(x, 'rows')\n")
+    assert lint_snippet(tmp_path, "x.py", code,
+                        "collective-discipline") == []
+
+
+def test_collective_discipline_rank_branch(tmp_path):
+    code = (
+        "from jax import lax\n"
+        "def exchange(x):\n"
+        "    rank = lax.axis_index('workers')\n"
+        "    if rank == 0:\n"
+        "        x = lax.psum(x, 'workers')\n"
+        "    return x\n")
+    found = lint_snippet(tmp_path, "x.py", code, "collective-discipline")
+    assert len(found) == 1
+    assert "divergence hazard" in found[0].message
+
+
+def test_collective_discipline_rank_branch_via_helper(tmp_path):
+    """The hazard is interprocedural: a branch calling a helper whose
+    SUMMARY issues collectives is flagged too."""
+    code = (
+        "import jax\n"
+        "from jax import lax\n"
+        "def reduce_all(x):\n"
+        "    return lax.psum(x, 'workers')\n"
+        "def exchange(x):\n"
+        "    if jax.process_index() == 0:\n"
+        "        x = reduce_all(x)\n"
+        "    return x\n")
+    found = lint_snippet(tmp_path, "x.py", code, "collective-discipline")
+    assert len(found) == 1
+    assert "reduce_all" in found[0].message
+
+
+def test_collective_discipline_uniform_branch_ok(tmp_path):
+    """Static-config branches (mesh size, flags) are NOT rank taint."""
+    code = (
+        "from jax import lax\n"
+        "def exchange(x, n, use_ring):\n"
+        "    rank = lax.axis_index('workers')\n"
+        "    y = x + rank\n"                       # data use: fine
+        "    if n > 1 and use_ring:\n"
+        "        y = lax.psum(y, 'workers')\n"
+        "    return y\n")
+    assert lint_snippet(tmp_path, "x.py", code,
+                        "collective-discipline") == []
+
+
+def test_collective_discipline_start_done_pairing(tmp_path):
+    code = (
+        "from jax import lax\n"
+        "def overlap(x):\n"
+        "    t = lax.psum_start(x, 'workers')\n"
+        "    return x\n"
+        "def balanced(x):\n"
+        "    t = lax.psum_start(x, 'workers')\n"
+        "    return lax.psum_done(t)\n")
+    found = lint_snippet(tmp_path, "x.py", code, "collective-discipline")
+    assert len(found) == 1 and found[0].line == 3
+    assert "unbalanced async collective pair" in found[0].message
+
+
+# ---------------------------------------------------------------------------
+# sharding-schema
+# ---------------------------------------------------------------------------
+
+def test_sharding_schema_bad_axis_in_spec(tmp_path):
+    code = (
+        "from jax.sharding import PartitionSpec as P\n"
+        "SPEC = P('workerz', None)\n")
+    found = lint_snippet(tmp_path, "x.py", code, "sharding-schema")
+    assert len(found) == 1
+    assert "undeclared mesh axis 'workerz'" in found[0].message
+
+
+def test_sharding_schema_good_specs(tmp_path):
+    """Declared axes, tuple entries, None, and star-constructions
+    (the steps.stage_window P(None, *base) shape) all pass."""
+    code = (
+        "from jax.sharding import PartitionSpec as P\n"
+        "A = P('workers', None)\n"
+        "B = P(('workers', 'model'), 'seq')\n"
+        "def stage(base):\n"
+        "    return P(None, *base)\n")
+    assert lint_snippet(tmp_path, "x.py", code, "sharding-schema") == []
+
+
+def test_sharding_schema_in_specs_arity(tmp_path):
+    code = (
+        "from jax.sharding import PartitionSpec as P\n"
+        "from theanompi_tpu.jax_compat import shard_map\n"
+        "def build(mesh):\n"
+        "    def per_worker(state, batch, lr):\n"
+        "        return state\n"
+        "    return shard_map(per_worker, mesh=mesh,\n"
+        "                     in_specs=(P(), P()), out_specs=P())\n")
+    found = lint_snippet(tmp_path, "x.py", code, "sharding-schema")
+    assert len(found) == 1
+    assert "2 spec(s)" in found[0].message
+    assert "3 positional parameter(s)" in found[0].message
+
+
+def test_sharding_schema_out_specs_arity(tmp_path):
+    code = (
+        "from jax.sharding import PartitionSpec as P\n"
+        "from theanompi_tpu.jax_compat import shard_map\n"
+        "def build(mesh):\n"
+        "    def per_worker(state):\n"
+        "        return state, 1.0, 2.0\n"
+        "    return shard_map(per_worker, mesh=mesh,\n"
+        "                     in_specs=(P(),), out_specs=(P(), P()))\n")
+    found = lint_snippet(tmp_path, "x.py", code, "sharding-schema")
+    assert len(found) == 1
+    assert "returns 3 value(s)" in found[0].message
+
+
+def test_sharding_schema_matching_arity_ok(tmp_path):
+    code = (
+        "from jax.sharding import PartitionSpec as P\n"
+        "from theanompi_tpu.jax_compat import shard_map\n"
+        "def build(mesh):\n"
+        "    def per_worker(state, batch):\n"
+        "        return state, batch\n"
+        "    return shard_map(per_worker, mesh=mesh,\n"
+        "                     in_specs=(P('workers'), P('workers')),\n"
+        "                     out_specs=(P('workers'), P('workers')))\n")
+    assert lint_snippet(tmp_path, "x.py", code, "sharding-schema") == []
+
+
+# ---------------------------------------------------------------------------
+# exchange-symmetry
+# ---------------------------------------------------------------------------
+
+SYMMETRY_BAD = """
+from jax import lax
+from theanompi_tpu.parallel.exchanger import Exchanger
+
+class Skippy(Exchanger):
+    def exchange_body(self, state, key, count):
+        if state.get("skip"):
+            return state
+        return {k: lax.pmean(v, "workers") for k, v in state.items()}
+"""
+
+SYMMETRY_BAD_ONE_ARM = """
+from jax import lax
+from theanompi_tpu.parallel.exchanger import Exchanger
+
+class OneArm(Exchanger):
+    def exchange_body(self, state, key, count):
+        if count % 2:
+            state = {k: lax.psum(v, "workers") for k, v in state.items()}
+        return state
+"""
+
+SYMMETRY_GOOD = """
+from jax import lax
+from theanompi_tpu.parallel.exchanger import Exchanger
+
+class Clean(Exchanger):
+    def exchange_body(self, state, key, count):
+        reduced = {k: lax.pmean(v, "workers") for k, v in state.items()}
+        if count % 2:
+            reduced = {k: v * 2 for k, v in reduced.items()}
+        return reduced
+"""
+
+
+def test_exchange_symmetry_early_return(tmp_path):
+    found = lint_snippet(tmp_path, "x.py", SYMMETRY_BAD,
+                         "exchange-symmetry")
+    assert len(found) == 1
+    assert "early exit" in found[0].message
+    assert "pmean" in found[0].message
+
+
+def test_exchange_symmetry_one_armed_branch(tmp_path):
+    found = lint_snippet(tmp_path, "x.py", SYMMETRY_BAD_ONE_ARM,
+                         "exchange-symmetry")
+    assert len(found) == 1
+    assert "diverges across `if` arms" in found[0].message
+
+
+def test_exchange_symmetry_good_subclass(tmp_path):
+    assert lint_snippet(tmp_path, "x.py", SYMMETRY_GOOD,
+                        "exchange-symmetry") == []
+
+
+def test_exchange_symmetry_repo_rules_clean():
+    """The four live rules already satisfy the invariant."""
+    found = core.run_lint(REPO, paths=["theanompi_tpu/parallel"],
+                          only=["exchange-symmetry"])
+    assert found == [], [f.render() for f in found]
+
+
+# ---------------------------------------------------------------------------
+# acceptance injections against the REAL files (tmp copies)
+# ---------------------------------------------------------------------------
+
+def _inject(tmp_path, rel, old, new):
+    src = open(os.path.join(REPO, rel)).read()
+    assert old in src, f"{rel} changed shape; update the injection"
+    p = tmp_path / rel
+    p.parent.mkdir(parents=True, exist_ok=True)
+    p.write_text(src.replace(old, new))
+    return rel
+
+
+def test_injection_axis_typo_in_exchanger(tmp_path):
+    rel = _inject(tmp_path, "theanompi_tpu/parallel/exchanger.py",
+                  "axis, alpha = WORKER_AXIS, self.alpha",
+                  "axis, alpha = 'workerz', self.alpha")
+    found = core.run_lint(str(tmp_path), paths=[rel],
+                          only=["collective-discipline"])
+    assert any("undeclared mesh axis 'workerz'" in f.message
+               for f in found), [f.render() for f in found]
+
+
+def test_injection_rank_conditional_psum_in_strategies(tmp_path):
+    rel = _inject(
+        tmp_path, "theanompi_tpu/parallel/strategies.py",
+        "        inv = 1.0 / size\n"
+        "        if self.wire_dtype is None:\n"
+        "            out = jax.tree.map(lambda g: lax.psum(g, axis) * inv"
+        ", tree)",
+        "        inv = 1.0 / size\n"
+        "        rank = lax.axis_index(axis)\n"
+        "        if self.wire_dtype is None:\n"
+        "            if rank == 0:\n"
+        "                tree = jax.tree.map(lambda g: lax.psum(g, axis),"
+        " tree)\n"
+        "            out = jax.tree.map(lambda g: lax.psum(g, axis) * inv"
+        ", tree)")
+    found = core.run_lint(str(tmp_path), paths=[rel],
+                          only=["collective-discipline"])
+    assert any("divergence hazard" in f.message for f in found), \
+        [f.render() for f in found]
+
+
+def test_injection_wrong_length_in_specs_in_steps(tmp_path):
+    rel = _inject(tmp_path, "theanompi_tpu/parallel/steps.py",
+                  "in_specs=(state_spec, batch_spec, P(), P(), P()),",
+                  "in_specs=(state_spec, batch_spec, P(), P()),")
+    found = core.run_lint(str(tmp_path), paths=[rel],
+                          only=["sharding-schema"])
+    assert any("4 spec(s)" in f.message and "5 positional" in f.message
+               for f in found), [f.render() for f in found]
+
+
+# ---------------------------------------------------------------------------
+# result cache (.tpulint_cache/)
+# ---------------------------------------------------------------------------
+
+def _lint_cli(root, *extra, env_extra=None):
+    env = dict(os.environ, **(env_extra or {}))
+    return subprocess.run(
+        [sys.executable, LINT, "--root", str(root), *extra],
+        capture_output=True, text=True, timeout=300, env=env)
+
+
+def test_cache_warm_run_identical_and_fast(tmp_path):
+    """Cold vs warm: identical findings, warm under a second, and the
+    status line says which happened."""
+    (tmp_path / "bad.py").write_text(RNG_BAD)
+    cold = _lint_cli(tmp_path, "bad.py", "--format", "json")
+    assert json.loads(cold.stdout)["cache"] == "miss"
+    import time as _time
+    t0 = _time.monotonic()
+    warm = _lint_cli(tmp_path, "bad.py", "--format", "json")
+    elapsed = _time.monotonic() - t0
+    w = json.loads(warm.stdout)
+    assert w["cache"] == "hit"
+    assert w["findings"] == json.loads(cold.stdout)["findings"]
+    assert cold.returncode == warm.returncode == 1
+    # interpreter startup dominates; the run itself must be trivial
+    assert elapsed < 5.0, elapsed
+    assert (tmp_path / ".tpulint_cache").is_dir()
+
+
+def test_cache_invalidates_on_content_change(tmp_path):
+    (tmp_path / "f.py").write_text("x = 1\n")
+    assert json.loads(_lint_cli(tmp_path, "f.py", "--format",
+                                "json").stdout)["cache"] == "miss"
+    (tmp_path / "f.py").write_text(RNG_BAD)
+    out = json.loads(_lint_cli(tmp_path, "f.py", "--format",
+                               "json").stdout)
+    assert out["cache"] == "miss"
+    assert out["findings"], "edited file must re-lint, not hit"
+
+
+def test_cache_no_cache_flag(tmp_path):
+    (tmp_path / "f.py").write_text("x = 1\n")
+    _lint_cli(tmp_path, "f.py")
+    out = json.loads(_lint_cli(tmp_path, "f.py", "--no-cache",
+                               "--format", "json").stdout)
+    assert out["cache"] == "off"
+
+
+def test_cache_key_depends_on_analysis_sources():
+    """Editing any analysis/ source changes the fingerprint — the
+    auto-invalidation the cache's soundness rests on."""
+    from theanompi_tpu.analysis import cache as cm
+    fp = cm.analysis_fingerprint()
+    h1 = cm.tree_key(fp, ["a"], [], [("f.py", "sha")])
+    h2 = cm.tree_key(fp + "x", ["a"], [], [("f.py", "sha")])
+    h3 = cm.tree_key(fp, ["a", "b"], [], [("f.py", "sha")])
+    h4 = cm.tree_key(fp, ["a"], [], [("f.py", "sha2")])
+    assert len({h1, h2, h3, h4}) == 4
+
+
+def test_cache_repo_gate_warm_subsecond():
+    """The acceptance criterion: a cached re-run of the unchanged repo
+    completes in < 1s (process time minus interpreter startup) and is
+    finding-identical to the cold run."""
+    import time as _time
+    cold = subprocess.run(
+        [sys.executable, LINT, "--format", "json"], cwd=REPO,
+        capture_output=True, text=True, timeout=300)
+    t0 = _time.monotonic()
+    warm = subprocess.run(
+        [sys.executable, LINT, "--format", "json"], cwd=REPO,
+        capture_output=True, text=True, timeout=300)
+    elapsed = _time.monotonic() - t0
+    w, c = json.loads(warm.stdout), json.loads(cold.stdout)
+    assert w["cache"] == "hit"
+    assert w["findings"] == c["findings"]
+    assert elapsed < 2.5, f"warm repo lint took {elapsed:.2f}s"
+
+
+# ---------------------------------------------------------------------------
+# --format json fingerprints + TODO-nag collapse
+# ---------------------------------------------------------------------------
+
+def test_json_format_stable_fingerprints(tmp_path):
+    (tmp_path / "bad.py").write_text(TRACE_BAD)
+    out = json.loads(_lint_cli(tmp_path, "bad.py", "--format",
+                               "json").stdout)
+    f = out["findings"][0]
+    assert set(f) >= {"check", "path", "line", "col", "message",
+                      "fingerprint"}
+    # stable = line-insensitive (for messages that don't quote a line):
+    # shifting the file moves the finding but keeps the fingerprint
+    (tmp_path / "bad.py").write_text("\n\n\n" + TRACE_BAD)
+    out2 = json.loads(_lint_cli(tmp_path, "bad.py", "--format",
+                                "json").stdout)
+    assert out2["findings"][0]["fingerprint"] == f["fingerprint"]
+    assert out2["findings"][0]["line"] != f["line"]
+
+
+def test_todo_nag_collapses_to_summary(tmp_path):
+    """Two TODO entries: default output is ONE summary line carrying
+    the count; --verbose restores the per-entry list.  Never silent."""
+    (tmp_path / "bad.py").write_text(RNG_BAD + RNG_BAD_LOOP)
+    findings = core.run_lint(str(tmp_path), paths=["bad.py"],
+                             only=["rng-discipline"])
+    assert len(findings) == 2
+    core.save_baseline(str(tmp_path / core.BASELINE_NAME), findings)
+    proc = _lint_cli(tmp_path, "bad.py", "--only", "rng-discipline")
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    nag_lines = [l for l in proc.stderr.splitlines()
+                 if "justification" in l]
+    assert len(nag_lines) == 1, proc.stderr
+    assert "2 baseline entries" in nag_lines[0]
+    verbose = _lint_cli(tmp_path, "bad.py", "--only", "rng-discipline",
+                        "--verbose")
+    v_lines = [l for l in verbose.stderr.splitlines()
+               if "needs a justification" in l]
+    assert len(v_lines) == 2, verbose.stderr
+
+
+# ---------------------------------------------------------------------------
+# precommit entry point
+# ---------------------------------------------------------------------------
+
+def test_precommit_lint_script_clean_and_failing(tmp_path):
+    """scripts/precommit_lint.sh lints exactly the staged in-scope
+    files of a scratch clone: clean stage exits 0, a staged finding
+    exits 1, out-of-scope stages are ignored."""
+    import shutil
+    repo = tmp_path / "r"
+    (repo / "scripts").mkdir(parents=True)
+    (repo / "theanompi_tpu").mkdir()
+    shutil.copy(os.path.join(REPO, "scripts", "precommit_lint.sh"),
+                repo / "scripts" / "precommit_lint.sh")
+    shutil.copy(LINT, repo / "scripts" / "lint.py")
+    # the launcher needs the analysis package under the scratch root
+    shutil.copytree(os.path.join(REPO, "theanompi_tpu", "analysis"),
+                    repo / "theanompi_tpu" / "analysis")
+    shutil.copy(os.path.join(REPO, "theanompi_tpu", "jax_compat.py"),
+                repo / "theanompi_tpu" / "jax_compat.py")
+    # the schema-drift live probe imports these two for real
+    (repo / "theanompi_tpu" / "utils").mkdir()
+    for m in ("__init__.py", "recorder.py", "telemetry.py"):
+        shutil.copy(os.path.join(REPO, "theanompi_tpu", "utils", m),
+                    repo / "theanompi_tpu" / "utils" / m)
+
+    def git(*a):
+        return subprocess.run(["git", *a], cwd=repo, capture_output=True,
+                              text=True, timeout=60)
+
+    git("init", "-q")
+    git("config", "user.email", "t@t")
+    git("config", "user.name", "t")
+    sh = ["bash", "scripts/precommit_lint.sh"]
+
+    # nothing staged in scope
+    (repo / "NOTES.md").write_text("x\n")
+    git("add", "NOTES.md")
+    p = subprocess.run(sh, cwd=repo, capture_output=True, text=True,
+                       timeout=300)
+    assert p.returncode == 0 and "no staged python files" in p.stdout
+
+    # a staged clean file
+    (repo / "theanompi_tpu" / "ok.py").write_text("x = 1\n")
+    git("add", "theanompi_tpu/ok.py")
+    p = subprocess.run(sh, cwd=repo, capture_output=True, text=True,
+                       timeout=300)
+    assert p.returncode == 0, p.stdout + p.stderr
+
+    # a staged finding fails the hook
+    (repo / "theanompi_tpu" / "bad.py").write_text(RNG_BAD)
+    git("add", "theanompi_tpu/bad.py")
+    p = subprocess.run(sh, cwd=repo, capture_output=True, text=True,
+                       timeout=300)
+    assert p.returncode == 1, p.stdout + p.stderr
+    assert "rng-discipline" in p.stdout
+
+
+def test_collective_discipline_axis_name_kwarg_typo(tmp_path):
+    """A typo'd axis passed as `axis_name=` on a COLLECTIVE must not
+    self-whitelist (review finding: kwarg harvesting is for binders)."""
+    code = (
+        "from jax import lax\n"
+        "def exchange(x):\n"
+        "    return lax.pmean(x, axis_name='workerz')\n")
+    found = lint_snippet(tmp_path, "x.py", code, "collective-discipline")
+    assert len(found) == 1
+    assert "undeclared mesh axis 'workerz'" in found[0].message
+
+
+def test_exchange_symmetry_exiting_arm_issues_collective(tmp_path):
+    """The mirror of SYMMETRY_BAD: the EXITING arm reduces and the
+    fall-through does not — same divergence, must be flagged."""
+    code = (
+        "from jax import lax\n"
+        "from theanompi_tpu.parallel.exchanger import Exchanger\n"
+        "class Mirror(Exchanger):\n"
+        "    def exchange_body(self, state, key, count):\n"
+        "        if state.get('skip'):\n"
+        "            return {k: lax.pmean(v, 'workers')\n"
+        "                    for k, v in state.items()}\n"
+        "        return state\n")
+    found = lint_snippet(tmp_path, "x.py", code, "exchange-symmetry")
+    assert len(found) == 1, [f.render() for f in found]
+    assert "pmean" in found[0].message
+
+
+def test_exchange_symmetry_config_assert_not_flagged(tmp_path):
+    """A raising guard before the collectives is a loud uniform abort,
+    not a silent divergence — no finding."""
+    code = (
+        "from jax import lax\n"
+        "from theanompi_tpu.parallel.exchanger import Exchanger\n"
+        "class Guarded(Exchanger):\n"
+        "    def exchange_body(self, state, key, count):\n"
+        "        if not state:\n"
+        "            raise ValueError('empty state')\n"
+        "        return {k: lax.pmean(v, 'workers')\n"
+        "                for k, v in state.items()}\n")
+    assert lint_snippet(tmp_path, "x.py", code, "exchange-symmetry") == []
+
+
+def test_precommit_lints_staged_blob_not_worktree(tmp_path):
+    """Stage a violation, fix the worktree WITHOUT re-staging: the hook
+    must still fail — the commit would contain the staged violation."""
+    import shutil
+    repo = tmp_path / "r"
+    (repo / "scripts").mkdir(parents=True)
+    (repo / "theanompi_tpu").mkdir()
+    shutil.copy(os.path.join(REPO, "scripts", "precommit_lint.sh"),
+                repo / "scripts" / "precommit_lint.sh")
+    shutil.copy(LINT, repo / "scripts" / "lint.py")
+    shutil.copytree(os.path.join(REPO, "theanompi_tpu", "analysis"),
+                    repo / "theanompi_tpu" / "analysis")
+    shutil.copy(os.path.join(REPO, "theanompi_tpu", "jax_compat.py"),
+                repo / "theanompi_tpu" / "jax_compat.py")
+    (repo / "theanompi_tpu" / "utils").mkdir()
+    for m in ("__init__.py", "recorder.py", "telemetry.py"):
+        shutil.copy(os.path.join(REPO, "theanompi_tpu", "utils", m),
+                    repo / "theanompi_tpu" / "utils" / m)
+
+    def git(*a):
+        return subprocess.run(["git", *a], cwd=repo, capture_output=True,
+                              text=True, timeout=60)
+
+    git("init", "-q")
+    git("config", "user.email", "t@t")
+    git("config", "user.name", "t")
+    (repo / "theanompi_tpu" / "f.py").write_text(RNG_BAD)
+    git("add", "theanompi_tpu/f.py")
+    (repo / "theanompi_tpu" / "f.py").write_text("x = 1\n")  # fixed, unstaged
+    p = subprocess.run(["bash", "scripts/precommit_lint.sh"], cwd=repo,
+                       capture_output=True, text=True, timeout=300)
+    assert p.returncode == 1, p.stdout + p.stderr
+    assert "rng-discipline" in p.stdout
+    # re-stage the fix: clean
+    git("add", "theanompi_tpu/f.py")
+    p = subprocess.run(["bash", "scripts/precommit_lint.sh"], cwd=repo,
+                       capture_output=True, text=True, timeout=300)
+    assert p.returncode == 0, p.stdout + p.stderr

@@ -1,0 +1,170 @@
+"""Result cache for the lint CLI (``.tpulint_cache/``).
+
+Two granularities, both keyed so a stale hit is impossible:
+
+* **tree entries** — the complete finding list of one invocation, keyed
+  on sha256 over (analysis-source fingerprint, checker selection,
+  explicit path arguments, every in-scope file's content hash).  An
+  unchanged tree re-run is one hash pass + one JSON read: the tier-1
+  gate drops from ~7 s to sub-second.
+* **per-file entries** — the FILE-scoped checkers' findings for one
+  file, keyed on (file content sha256, analysis fingerprint, the
+  file-scoped checker selection).  On a tree miss (one file edited),
+  unchanged files splice their cached findings in and skip
+  ``check_file``; program/project checkers re-run live — they are
+  whole-program by definition, so only their work is repeated.
+
+The **analysis fingerprint** hashes every ``analysis/`` source file, so
+editing any checker, the engine, or this module invalidates everything
+automatically — there is no manually-bumped version to forget.  Writes
+are atomic (tmp + rename) and every cache failure degrades to a normal
+uncached run: the cache can slow a run down, never corrupt one.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import tempfile
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from .core import Finding
+
+CACHE_DIR_NAME = ".tpulint_cache"
+SCHEMA = 1                 # bump when the entry layout itself changes
+_TREE_KEEP = 64            # pruning caps (newest kept)
+_FILE_KEEP = 4096
+
+
+def _sha(data: bytes) -> str:
+    return hashlib.sha256(data).hexdigest()
+
+
+def analysis_fingerprint() -> str:
+    """sha256 over every ``analysis/`` source — the auto-invalidation
+    key: any checker/engine/cache edit changes it."""
+    here = os.path.dirname(os.path.abspath(__file__))
+    h = hashlib.sha256()
+    for dirpath, dirnames, filenames in os.walk(here):
+        dirnames[:] = sorted(d for d in dirnames if d != "__pycache__")
+        for fn in sorted(filenames):
+            if not fn.endswith(".py"):
+                continue
+            rel = os.path.relpath(os.path.join(dirpath, fn), here)
+            h.update(rel.encode())
+            with open(os.path.join(dirpath, fn), "rb") as f:
+                h.update(_sha(f.read()).encode())
+    return h.hexdigest()
+
+
+def file_hashes(root: str, rels: Sequence[str]) -> List[Tuple[str, str]]:
+    """(repo-relative path, content sha256) for every file; unreadable
+    files hash to a unique marker so they can never produce a hit."""
+    out = []
+    for rel in rels:
+        try:
+            with open(os.path.join(root, rel), "rb") as f:
+                out.append((rel.replace(os.sep, "/"), _sha(f.read())))
+        except OSError:
+            out.append((rel.replace(os.sep, "/"), f"unreadable:{rel}"))
+    return out
+
+
+def tree_key(analysis_fp: str, checker_names: Sequence[str],
+             path_args: Sequence[str],
+             hashes: Sequence[Tuple[str, str]]) -> str:
+    payload = json.dumps({
+        "schema": SCHEMA,
+        "analysis": analysis_fp,
+        "checkers": sorted(checker_names),
+        "paths": list(path_args),
+        "files": sorted(hashes),
+    }, sort_keys=True)
+    return _sha(payload.encode())
+
+
+def file_key(analysis_fp: str, file_checkers: Sequence[str],
+             content_sha: str) -> str:
+    payload = json.dumps({
+        "schema": SCHEMA,
+        "analysis": analysis_fp,
+        "checkers": sorted(file_checkers),
+        "sha": content_sha,
+    }, sort_keys=True)
+    return _sha(payload.encode())
+
+
+# ---------------------------------------------------------------------------
+# store
+# ---------------------------------------------------------------------------
+
+class LintCache:
+    """Filesystem store under ``<root>/.tpulint_cache`` (or an explicit
+    ``cache_dir`` — the precommit hook roots the lint at a temp
+    checkout of the index but keeps the repo's cache).  Every method is
+    failure-tolerant: IO errors read as misses / silent no-ops."""
+
+    def __init__(self, root: str, cache_dir: Optional[str] = None):
+        self.dir = cache_dir or os.path.join(root, CACHE_DIR_NAME)
+
+    def _path(self, kind: str, key: str) -> str:
+        return os.path.join(self.dir, kind, key[:32] + ".json")
+
+    def _load(self, kind: str, key: str) -> Optional[List[Finding]]:
+        try:
+            with open(self._path(kind, key), encoding="utf-8") as f:
+                data = json.load(f)
+            if data.get("schema") != SCHEMA:
+                return None
+            return [Finding(d["check"], d["path"], d["line"], d["col"],
+                            d["message"]) for d in data["findings"]]
+        except (OSError, KeyError, TypeError, ValueError):
+            return None
+
+    def _store(self, kind: str, key: str, findings: Sequence[Finding],
+               keep: int) -> None:
+        try:
+            d = os.path.join(self.dir, kind)
+            os.makedirs(d, exist_ok=True)
+            fd, tmp = tempfile.mkstemp(dir=d, suffix=".tmp")
+            with os.fdopen(fd, "w", encoding="utf-8") as f:
+                json.dump({"schema": SCHEMA,
+                           "findings": [x.to_dict() for x in findings]},
+                          f)
+            os.replace(tmp, self._path(kind, key))
+            self._prune(d, keep)
+        except OSError:
+            pass
+
+    @staticmethod
+    def _prune(d: str, keep: int) -> None:
+        try:
+            entries = [(e.stat().st_mtime, e.path)
+                       for e in os.scandir(d) if e.name.endswith(".json")]
+            if len(entries) <= keep:
+                return
+            entries.sort()
+            for _, path in entries[:len(entries) - keep]:
+                try:
+                    os.unlink(path)
+                except OSError:
+                    pass
+        except OSError:
+            pass
+
+    # -- tree level --------------------------------------------------------
+
+    def load_tree(self, key: str) -> Optional[List[Finding]]:
+        return self._load("tree", key)
+
+    def store_tree(self, key: str, findings: Sequence[Finding]) -> None:
+        self._store("tree", key, findings, _TREE_KEEP)
+
+    # -- per-file level ----------------------------------------------------
+
+    def load_file(self, key: str) -> Optional[List[Finding]]:
+        return self._load("files", key)
+
+    def store_file(self, key: str, findings: Sequence[Finding]) -> None:
+        self._store("files", key, findings, _FILE_KEEP)

@@ -1,11 +1,21 @@
 """The project-specific checker suite — importing this package registers
-every checker with :data:`~..core.CHECKERS` (docs/design.md §12)."""
+every checker with :data:`~..core.CHECKERS` (docs/design.md §12).
+
+The dataflow checkers (trace-purity, rng-discipline, donation-safety,
+collective-discipline, sharding-schema, exchange-symmetry) run on the
+whole-program engine (``analysis/engine.py``); compat-boundary and
+telemetry-hot-path stay per-file (their invariants are lexical);
+schema-drift is the live-object project probe.
+"""
 
 from . import (  # noqa: F401
+    collective_discipline,
     compat_boundary,
     donation_safety,
+    exchange_symmetry,
     rng_discipline,
     schema_drift,
+    sharding_schema,
     telemetry_hot_path,
     trace_purity,
 )

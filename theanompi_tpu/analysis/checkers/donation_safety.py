@@ -19,6 +19,12 @@ a finding.  The ``state = train_fn(state, ...)`` rebind idiom is
 recognized: consuming and rebinding in one statement is the sanctioned
 in-place-update shape.  Branch bodies scan against a state copy, so
 exclusive arms cannot poison each other.
+
+Interprocedural (the whole-program engine): module-level donating
+callables are collected REPO-WIDE and resolved through each file's
+import table, so ``from train import step_fn`` — where ``train.py``
+holds ``step_fn = jax.jit(g, donate_argnums=0)`` — flags a
+read-after-donate at the importing call site too.
 """
 
 from __future__ import annotations
@@ -27,6 +33,7 @@ import ast
 from typing import Dict, List, Optional, Set
 
 from ..core import Checker, Finding, ImportResolver, SourceFile, register
+from ..engine import ProgramIndex
 
 _JIT_NAMES = {"jax.jit"}
 
@@ -85,9 +92,26 @@ def _callee_params(call: ast.Call) -> Optional[list]:
 class DonationSafetyChecker(Checker):
     name = "donation-safety"
     description = ("a name passed through a donate_argnums call site and "
-                   "read afterwards in the same scope")
+                   "read afterwards in the same scope (donating callables "
+                   "resolved repo-wide)")
+    needs_engine = True
 
-    def check_file(self, sf: SourceFile):
+    def check_program(self, index: ProgramIndex):
+        # module-level donating callables, repo-wide, by absolute dotted
+        # name — visible through any file's import table
+        self._global_fns: Dict[str, Set[int]] = {}
+        for sf in index.files:
+            module = sf.resolver.module
+            for name, idx in self._collect_donating_fns(sf,
+                                                        sf.tree).items():
+                if "." not in name:    # dotted targets stay file-local
+                    self._global_fns[f"{module}.{name}"] = idx
+        findings: List[Finding] = []
+        for sf in index.files:
+            findings.extend(self._check_one(sf))
+        return findings
+
+    def _check_one(self, sf: SourceFile):
         findings: List[Finding] = []
         # module-level donating names (`f = jax.jit(g, donate_argnums=0)`
         # at top level) are visible from every function scope — merge
@@ -199,6 +223,11 @@ class DonationSafetyChecker(Checker):
                 resolved = sf.resolver.resolve(sub.func.func)
                 if resolved in _JIT_NAMES:
                     idx = _donated_indices(sub.func)
+            else:
+                # a donating callable imported from another module
+                resolved = sf.resolver.resolve(sub.func)
+                if resolved is not None:
+                    idx = getattr(self, "_global_fns", {}).get(resolved)
             if not idx:
                 continue
             for i in idx:

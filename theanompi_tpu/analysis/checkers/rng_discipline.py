@@ -18,6 +18,13 @@ fine), which trades a little recall for zero false positives on
 exclusive paths.  A loop body that consumes a key defined outside the
 loop without ever rebinding it is flagged too — the classic
 ``for i: x = normal(key)`` freeze.
+
+Interprocedural (the whole-program engine): a call to a function whose
+summary says it CONSUMES one of its parameters as a key — directly, or
+by passing it on to a consuming callee, fixpointed across the repo-wide
+call graph — consumes the name passed at that position, exactly like a
+direct sampler call.  ``draw(key); draw(key)`` through a helper one
+module away is now the same finding as ``normal(key); normal(key)``.
 """
 
 from __future__ import annotations
@@ -26,20 +33,7 @@ import ast
 from typing import Dict, List, Optional
 
 from ..core import Checker, Finding, SourceFile, register
-
-# jax.random.<fn> that CONSUME their key argument.  split consumes (two
-# splits of one key collide); fold_in derives (distinct data → distinct
-# streams) and is deliberately absent.
-_SAMPLERS = {
-    "ball", "bernoulli", "beta", "binomial", "bits", "categorical",
-    "cauchy", "chisquare", "choice", "dirichlet", "double_sided_maxwell",
-    "exponential", "f", "gamma", "generalized_normal", "geometric",
-    "gumbel", "laplace", "loggamma", "logistic", "lognormal", "maxwell",
-    "multinomial", "multivariate_normal", "normal", "orthogonal",
-    "pareto", "permutation", "poisson", "rademacher", "randint",
-    "rayleigh", "split", "t", "triangular", "truncated_normal",
-    "uniform", "wald", "weibull_min",
-}
+from ..engine import ProgramIndex, consumed_key_name
 
 _BLOCK_FIELDS = ("body", "orelse", "finalbody")
 
@@ -47,10 +41,21 @@ _BLOCK_FIELDS = ("body", "orelse", "finalbody")
 @register
 class RngDisciplineChecker(Checker):
     name = "rng-discipline"
-    description = ("a jax.random key consumed by two draws with no "
-                   "interleaving split/fold_in")
+    description = ("a jax.random key consumed by two draws (direct or "
+                   "through key-consuming callees) with no interleaving "
+                   "split/fold_in")
+    needs_engine = True
 
-    def check_file(self, sf: SourceFile):
+    def check_program(self, index: ProgramIndex):
+        out: List[Finding] = []
+        for sf in index.files:
+            out.extend(self._check_file(index, sf))
+        return out
+
+    def _check_file(self, index: ProgramIndex, sf: SourceFile):
+        self._index = index
+        self._sf = sf
+        self._fidx = index.file_index[sf.path]
         findings: List[Finding] = []
         for node in ast.walk(sf.tree):
             if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
@@ -73,22 +78,29 @@ class RngDisciplineChecker(Checker):
         body = getattr(fn, "body", None)
         return body if isinstance(body, list) else []
 
-    def _key_name(self, sf: SourceFile, call: ast.Call) -> Optional[str]:
-        """The consumed key name of a ``jax.random.<sampler>`` call."""
+    def _key_names(self, sf: SourceFile, call: ast.Call) -> List[str]:
+        """Names consumed as keys by this call: the key argument of a
+        direct ``jax.random.<sampler>``, plus every Name passed at a
+        position the (engine-resolved) callee's summary consumes."""
         resolved = sf.resolver.resolve(call.func)
-        if not resolved or not resolved.startswith("jax.random."):
-            return None
-        if resolved.rsplit(".", 1)[-1] not in _SAMPLERS:
-            return None
-        key_arg = None
-        if call.args:
-            key_arg = call.args[0]
-        for kw in call.keywords:
-            if kw.arg == "key":
-                key_arg = kw.value
-        if isinstance(key_arg, ast.Name):
-            return key_arg.id
-        return None
+        if resolved and resolved.startswith("jax.random."):
+            direct = consumed_key_name(call, sf.resolver)
+            return [direct] if direct is not None else []
+        out: List[str] = []
+        enclosing = self._fidx.enclosing.get(id(call.func))
+        for tgt in self._index.resolve_call(sf, call.func, enclosing):
+            kp = self._index.key_params(tgt)
+            if not kp:
+                continue
+            tparams = tgt.params()
+            for i in kp:
+                arg = call.args[i] if i < len(call.args) else None
+                for kw in call.keywords:
+                    if i < len(tparams) and kw.arg == tparams[i]:
+                        arg = kw.value
+                if isinstance(arg, ast.Name) and arg.id not in out:
+                    out.append(arg.id)
+        return out
 
     def _calls_in_order(self, node):
         """Calls in (approximate) evaluation order within one statement,
@@ -191,8 +203,7 @@ class RngDisciplineChecker(Checker):
             for sub in list(node.args) + [kw.value for kw in node.keywords]:
                 self._scan_exprs(sf, sub, consumed, findings, soft)
             self._scan_exprs(sf, node.func, consumed, findings, soft)
-            name = self._key_name(sf, node)
-            if name is not None:
+            for name in self._key_names(sf, node):
                 if name in consumed and name in soft:
                     soft.discard(name)    # re-armed: next in-arm use reports
                     consumed[name] = node.lineno
@@ -221,14 +232,13 @@ class RngDisciplineChecker(Checker):
             if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef)):
                 continue
             for call in self._calls_in_order(st):
-                name = self._key_name(sf, call)
-                if name is None:
-                    continue
-                if name not in body_stores:
-                    findings.append(Finding(
-                        self.name, sf.path, call.lineno, call.col_offset,
-                        f"key `{name}` consumed inside a loop without "
-                        "re-split/fold_in — every iteration draws the "
-                        "same bits"))
+                for name in self._key_names(sf, call):
+                    if name not in body_stores:
+                        findings.append(Finding(
+                            self.name, sf.path, call.lineno,
+                            call.col_offset,
+                            f"key `{name}` consumed inside a loop "
+                            "without re-split/fold_in — every iteration "
+                            "draws the same bits"))
         # and the body itself scans linearly for straight-line reuse
         self._scan_block(sf, loop.body, dict(consumed), findings)

@@ -1,0 +1,183 @@
+"""sharding-schema: PartitionSpec literals must fit the mesh and the
+function they annotate.
+
+Two invariants (docs/design.md §12), guarding the ROADMAP-item-5
+universal sharded-update wrapper before it exists:
+
+1. **Spec axis names are real.**  Every string entry of a
+   ``PartitionSpec`` literal (``P('workers', None)``, tuple entries
+   ``P(('workers', 'model'))`` included) must name a declared mesh axis
+   — the ``parallel/mesh.py`` ``*_AXIS`` constants plus axes literally
+   declared in the same file, exactly the vocabulary
+   collective-discipline validates collectives against.  A typo'd axis
+   in a spec places every leaf REPLICATED (jax treats an unknown name
+   as an error only at mesh-bind time, often far from the literal).
+   ``P(None, *base)``-style star constructions (``steps.stage_window``)
+   are recognized: literal entries are checked, the starred tail is
+   skipped, never guessed.
+
+2. **shard_map specs match the callee.**  For ``shard_map(f, mesh=...,
+   in_specs=(...), out_specs=...)`` where ``f`` resolves to a visible
+   def/lambda: a literal ``in_specs`` tuple must have exactly one entry
+   per positional parameter of ``f``, and a literal ``out_specs`` tuple
+   must match the arity of ``f``'s literal ``return`` tuples.  A
+   wrong-length spec tuple compiles into the WRONG argument→sharding
+   pairing (or a trace error three layers away from the edit).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional, Set
+
+from ..core import Checker, Finding, SourceFile, register
+from ..engine import ProgramIndex
+from .collective_discipline import CollectiveDisciplineChecker
+
+PSPEC_NAMES = {"jax.sharding.PartitionSpec",
+               "jax.interpreters.pxla.PartitionSpec"}
+
+SHARD_MAP_NAMES = {
+    "jax.shard_map",
+    "jax.experimental.shard_map.shard_map",
+    "theanompi_tpu.jax_compat.shard_map",
+}
+
+
+def _is_pspec_call(sf: SourceFile, node: ast.AST) -> bool:
+    return isinstance(node, ast.Call) and \
+        sf.resolver.resolve(node.func) in PSPEC_NAMES
+
+
+@register
+class ShardingSchemaChecker(Checker):
+    name = "sharding-schema"
+    description = ("PartitionSpec literals checked against mesh axis "
+                   "names; shard_map in_specs/out_specs arity checked "
+                   "against the callee signature")
+    needs_engine = True
+
+    def check_program(self, index: ProgramIndex):
+        # reuse collective-discipline's axis vocabulary (one source of
+        # truth for what counts as a declared axis)
+        cd = CollectiveDisciplineChecker()
+        declared = cd._declared_axes(index)
+        findings: List[Finding] = []
+        for sf in index.files:
+            valid = declared | cd._file_axes(sf)
+            for node in ast.walk(sf.tree):
+                if _is_pspec_call(sf, node):
+                    self._check_spec_literal(sf, node, valid, findings)
+                elif isinstance(node, ast.Call) and \
+                        sf.resolver.resolve(node.func) in SHARD_MAP_NAMES:
+                    self._check_shard_map(index, sf, node, findings)
+        return findings
+
+    # -- 1: axis names inside P literals -----------------------------------
+
+    def _check_spec_literal(self, sf: SourceFile, call: ast.Call,
+                            valid: Set[str],
+                            findings: List[Finding]) -> None:
+        def check_entry(e: ast.AST) -> None:
+            if isinstance(e, ast.Starred):
+                return                      # P(None, *base): tail unknown
+            if isinstance(e, ast.Constant):
+                if isinstance(e.value, str) and e.value not in valid:
+                    findings.append(Finding(
+                        self.name, sf.path, e.lineno, e.col_offset,
+                        f"PartitionSpec names undeclared mesh axis "
+                        f"'{e.value}' (declared: "
+                        f"{', '.join(sorted(valid))})"))
+                return
+            if isinstance(e, (ast.Tuple, ast.List)):
+                for sub in e.elts:
+                    check_entry(sub)
+
+        for e in call.args:
+            check_entry(e)
+
+    # -- 2: shard_map in_specs/out_specs arity -----------------------------
+
+    def _check_shard_map(self, index: ProgramIndex, sf: SourceFile,
+                         call: ast.Call,
+                         findings: List[Finding]) -> None:
+        fn_arg = call.args[0] if call.args else None
+        for kw in call.keywords:
+            if kw.arg == "f":
+                fn_arg = kw.value
+        if fn_arg is None:
+            return
+        n_params, has_vararg, returns = self._callee_shape(index, sf,
+                                                           call, fn_arg)
+        if n_params is None:
+            return
+        in_specs = out_specs = None
+        for kw in call.keywords:
+            if kw.arg == "in_specs":
+                in_specs = kw.value
+            elif kw.arg == "out_specs":
+                out_specs = kw.value
+        fname = getattr(fn_arg, "attr", None) or \
+            getattr(fn_arg, "id", "<lambda>")
+        if isinstance(in_specs, (ast.Tuple, ast.List)) and \
+                not any(isinstance(e, ast.Starred) for e in in_specs.elts):
+            n_specs = len(in_specs.elts)
+            ok = n_specs == n_params or (has_vararg and
+                                         n_specs >= n_params)
+            if not ok:
+                findings.append(Finding(
+                    self.name, sf.path, in_specs.lineno,
+                    in_specs.col_offset,
+                    f"shard_map in_specs has {n_specs} spec(s) but "
+                    f"`{fname}` takes {n_params} positional "
+                    "parameter(s) — every argument needs exactly one "
+                    "spec"))
+        if isinstance(out_specs, (ast.Tuple, ast.List)) and \
+                not any(isinstance(e, ast.Starred)
+                        for e in out_specs.elts) and returns:
+            n_specs = len(out_specs.elts)
+            bad = [r for r in returns if r != n_specs]
+            if bad and all(r != n_specs for r in returns):
+                findings.append(Finding(
+                    self.name, sf.path, out_specs.lineno,
+                    out_specs.col_offset,
+                    f"shard_map out_specs has {n_specs} spec(s) but "
+                    f"`{fname}` returns {bad[0]} value(s)"))
+
+    def _callee_shape(self, index: ProgramIndex, sf: SourceFile,
+                      call: ast.Call, fn_arg: ast.AST):
+        """(positional param count, has_vararg, literal return-tuple
+        arities) of the shard_map'd callable, or (None, ..) when it is
+        not statically visible."""
+        node = None
+        if isinstance(fn_arg, ast.Lambda):
+            node = fn_arg
+        elif isinstance(fn_arg, (ast.Name, ast.Attribute)):
+            fidx = index.file_index[sf.path]
+            enc = fidx.enclosing.get(id(fn_arg))
+            targets = index.resolve_call(sf, fn_arg, enc)
+            if len(targets) == 1:
+                node = targets[0].node
+            elif targets:
+                # several overrides: check only when they agree on arity
+                counts = {self._param_count(t.node)[0] for t in targets}
+                if len(counts) == 1:
+                    node = targets[0].node
+        if node is None:
+            return None, False, []
+        n, vararg = self._param_count(node)
+        returns: List[int] = []
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            from ..engine import body_walk
+            for sub in body_walk(node):
+                if isinstance(sub, ast.Return) and \
+                        isinstance(sub.value, ast.Tuple):
+                    returns.append(len(sub.value.elts))
+        return n, vararg, returns
+
+    @staticmethod
+    def _param_count(node: ast.AST):
+        a = node.args
+        params = [p.arg for p in list(a.posonlyargs) + list(a.args)
+                  if p.arg not in ("self", "cls")]
+        return len(params), a.vararg is not None

@@ -10,14 +10,17 @@ a tracer either fails to trace or, worse, specializes on one concrete
 value.  All of these are the silent-throughput/correctness bug class
 the Theano-MPI and pjit-scaling papers attribute regressions to.
 
-Seeding: within each file, every function object passed (positionally)
+Seeding: every function object passed (positionally or by keyword)
 to a trace wrapper is traced — ``per_worker`` into ``shard_map``,
 ``body`` into ``lax.scan``, ``self.exchange_body`` into the standalone
 collective (``steps.py`` / ``exchanger.py`` / ``model_base.py`` entry
-points all match this shape) — plus, transitively, every same-file
-function they call by name (module-level, enclosing-local, or
-``self.<method>``: all same-named methods in the file, covering
-subclass overrides like the rules' ``exchange_body``).
+points all match this shape) — plus, since the whole-program engine
+(``analysis/engine.py``), TRANSITIVELY every function they can reach
+through the repo-wide call graph: same-file calls, imported module
+functions, ``self.<method>`` through the class hierarchy including
+subclass overrides, and unique-family method names (the
+``exchange_body`` rule).  A host clock two modules away from the scan
+body is now visible.
 
 The Python-``if``-on-tracer check is restricted to functions passed to
 ``lax.scan``-family primitives, whose arguments are tracers BY
@@ -31,8 +34,9 @@ import ast
 from typing import Dict, List, Optional, Set, Tuple
 
 from ..core import Checker, Finding, SourceFile, register
+from ..engine import FuncRecord, ProgramIndex, body_walk
 
-# Wrappers whose (positional) function arguments get traced.
+# Wrappers whose function arguments get traced.
 TRACE_WRAPPERS = {
     "jax.jit",
     "jax.grad",
@@ -60,12 +64,6 @@ TRACER_ARG_WRAPPERS = {
     "jax.lax.associative_scan",
 }
 
-HOST_CLOCKS = {"time.time", "time.perf_counter", "time.monotonic",
-               "time.process_time", "time.sleep"}
-SYNC_CALLS = {"jax.device_get"}
-
-_FuncNode = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
-
 
 def _func_params(fn) -> Set[str]:
     a = fn.args
@@ -78,95 +76,62 @@ def _func_params(fn) -> Set[str]:
     return {n for n in names if n not in ("self", "cls")}
 
 
-class _Index:
-    """Per-file function index: defs by enclosing scope, methods by name."""
-
-    def __init__(self, sf: SourceFile):
-        self.sf = sf
-        # id(scope-node-or-None) -> {name: [def nodes]}
-        self.by_scope: Dict[Optional[int], Dict[str, List[ast.AST]]] = {}
-        # method name -> [def nodes] across every class in the file
-        self.methods: Dict[str, List[ast.AST]] = {}
-        # def node id -> enclosing function node (for local lookup chains)
-        self.parent_func: Dict[int, Optional[ast.AST]] = {}
-        self._walk(sf.tree, None, None)
-
-    def _walk(self, node, func: Optional[ast.AST], cls: Optional[ast.AST]):
-        for child in ast.iter_child_nodes(node):
-            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
-                scope = self.by_scope.setdefault(
-                    id(func) if func else None, {})
-                scope.setdefault(child.name, []).append(child)
-                if cls is not None and func is None or \
-                        (cls is not None and isinstance(node, ast.ClassDef)):
-                    self.methods.setdefault(child.name, []).append(child)
-                self.parent_func[id(child)] = func
-                self._walk(child, child, None)
-            elif isinstance(child, ast.ClassDef):
-                self._walk(child, func, child)
-            elif isinstance(child, ast.Lambda):
-                self.parent_func[id(child)] = func
-                self._walk(child, child, None)
-            else:
-                self._walk(child, func, cls)
-
-    def lookup(self, name: str, from_func: Optional[ast.AST]
-               ) -> List[ast.AST]:
-        """Defs named ``name`` visible from ``from_func``: its locals,
-        then enclosing functions', then module level."""
-        seen: List[ast.AST] = []
-        f = from_func
-        while True:
-            scope = self.by_scope.get(id(f) if f else None, {})
-            if name in scope:
-                seen.extend(scope[name])
-                return seen
-            if f is None:
-                return seen
-            f = self.parent_func.get(id(f))
-
-
 @register
 class TracePurityChecker(Checker):
     name = "trace-purity"
     description = ("host clocks, numpy RNG, print, .item()/device_get, "
-                   "and Python `if` on tracer args inside traced functions")
+                   "and Python `if` on tracer args inside traced "
+                   "functions — closed over the whole-program call graph")
+    needs_engine = True
 
-    def check_file(self, sf: SourceFile):
-        idx = _Index(sf)
+    def check_program(self, index: ProgramIndex):
+        seeds: List[FuncRecord] = []
+        tracer_args: Set[int] = set()
+        for sf in index.files:
+            self._seed_file(index, sf, seeds, tracer_args)
+
+        findings: List[Finding] = []
+        seen: Set[Tuple[str, int, str]] = set()
+
+        def emit(rec: FuncRecord, node, msg):
+            key = (rec.sf.path, node.lineno, msg)
+            if key not in seen:
+                seen.add(key)
+                findings.append(Finding(self.name, rec.sf.path,
+                                        node.lineno, node.col_offset, msg))
+
+        for rec in index.reachable(seeds):
+            self._scan_record(index, rec, id(rec.node) in tracer_args,
+                              emit)
+        return findings
+
+    # -- seed discovery (per file, as the trace-wrapper call sites are
+    #    lexical) ----------------------------------------------------------
+
+    def _seed_file(self, index: ProgramIndex, sf: SourceFile,
+                   seeds: List[FuncRecord], tracer_args: Set[int]) -> None:
+        idx = index.file_index[sf.path]
         resolver = sf.resolver
 
-        # ---- seed: functions passed positionally to trace wrappers ----
-        traced: Dict[int, ast.AST] = {}           # id -> def node
-        tracer_args: Set[int] = set()             # ids with tracer params
-        # enclosing function of every node (for name lookup at call sites)
-        encl: Dict[int, Optional[ast.AST]] = {}
+        def add(node: ast.AST, scan_like: bool) -> None:
+            rec = index.record_for(node)
+            if rec is None:
+                return
+            seeds.append(rec)
+            if scan_like:
+                tracer_args.add(id(node))
 
-        def record_enclosing(node, func):
-            encl[id(node)] = func
-            for child in ast.iter_child_nodes(node):
-                record_enclosing(
-                    child, child if isinstance(child, _FuncNode) else func)
-
-        record_enclosing(sf.tree, None)
-
-        def mark(node, scan_like: bool, from_func):
+        def mark(node, scan_like: bool, from_func) -> None:
             """Mark function refs found in a trace-wrapper argument."""
             for sub in ast.walk(node):
                 targets: List[ast.AST] = []
                 if isinstance(sub, ast.Lambda):
                     targets = [sub]
-                elif isinstance(sub, ast.Name):
-                    targets = idx.lookup(sub.id, from_func)
-                elif isinstance(sub, ast.Attribute) and \
-                        isinstance(sub.value, ast.Name) and \
-                        sub.value.id in ("self", "cls"):
-                    targets = idx.methods.get(sub.attr, [])
+                elif isinstance(sub, (ast.Name, ast.Attribute)):
+                    targets = [t.node for t in index.resolve_call(
+                        sf, sub, from_func)]
                 for t in targets:
-                    if id(t) not in traced:
-                        traced[id(t)] = t
-                    if scan_like:
-                        tracer_args.add(id(t))
+                    add(t, scan_like)
 
         def decorator_traces(dec) -> bool:
             """``@jax.jit``, ``@jax.jit(...)``, and
@@ -187,7 +152,7 @@ class TracePurityChecker(Checker):
         for node in ast.walk(sf.tree):
             if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
                 if any(decorator_traces(d) for d in node.decorator_list):
-                    traced.setdefault(id(node), node)
+                    add(node, False)
                 continue
             if not isinstance(node, ast.Call):
                 continue
@@ -200,77 +165,52 @@ class TracePurityChecker(Checker):
             # so spec/mesh kwargs stay invisible
             for arg in list(node.args) + [kw.value for kw in
                                           node.keywords]:
-                mark(arg, scan_like, encl.get(id(node.func)))
+                mark(arg, scan_like, idx.enclosing.get(id(node.func)))
 
-        # ---- transitive closure: same-file calls from traced functions ----
-        changed = True
-        while changed:
-            changed = False
-            for fid, fn in list(traced.items()):
-                for sub in self._body_walk(fn):
-                    if not isinstance(sub, ast.Call):
-                        continue
-                    targets: List[ast.AST] = []
-                    if isinstance(sub.func, ast.Name):
-                        targets = idx.lookup(sub.func.id, fn)
-                    elif isinstance(sub.func, ast.Attribute) and \
-                            isinstance(sub.func.value, ast.Name) and \
-                            sub.func.value.id in ("self", "cls"):
-                        targets = idx.methods.get(sub.func.attr, [])
-                    for t in targets:
-                        if id(t) not in traced:
-                            traced[id(t)] = t
-                            changed = True
+    # -- host-leak scan of one traced function -----------------------------
 
-        # ---- walk each traced function for host leaks ----
-        findings: List[Finding] = []
-        seen_lines: Set[Tuple[int, str]] = set()
+    def _scan_record(self, index: ProgramIndex, rec: FuncRecord,
+                     check_ifs: bool, emit) -> None:
+        sf = rec.sf
+        idx = index.file_index[sf.path]
+        resolver = sf.resolver
+        fname = rec.name
+        params = _func_params(rec.node)
 
-        def emit(node, msg):
-            key = (node.lineno, msg)
-            if key not in seen_lines:
-                seen_lines.add(key)
-                findings.append(Finding(self.name, sf.path, node.lineno,
-                                        node.col_offset, msg))
+        # the engine summary carries clocks / numpy RNG / device_get
+        for node, what in index.summary(rec).host_calls:
+            if "host clock" in what:
+                emit(rec, node, f"{what} inside traced function "
+                                f"`{fname}`")
+            elif "host RNG" in what:
+                emit(rec, node, f"{what} inside traced function "
+                                f"`{fname}` (freezes one draw into the "
+                                "compiled program)")
+            else:
+                emit(rec, node, f"{what} inside traced function "
+                                f"`{fname}` (host sync mid-trace)")
 
-        for fid, fn in traced.items():
-            fname = getattr(fn, "name", "<lambda>")
-            params = _func_params(fn)
-            check_ifs = fid in tracer_args
-            for sub in self._body_walk(fn):
-                if isinstance(sub, ast.Call):
-                    resolved = resolver.resolve(sub.func)
-                    if resolved in HOST_CLOCKS:
-                        emit(sub, f"host clock `{resolved}()` inside "
-                                  f"traced function `{fname}`")
-                    elif resolved and resolved.startswith("numpy.random."):
-                        emit(sub, f"host RNG `{resolved}()` inside traced "
-                                  f"function `{fname}` (freezes one draw "
-                                  "into the compiled program)")
-                    elif resolved in SYNC_CALLS:
-                        emit(sub, f"`{resolved}()` inside traced function "
-                                  f"`{fname}` (host sync mid-trace)")
-                    elif isinstance(sub.func, ast.Name) and \
-                            sub.func.id in ("print", "breakpoint", "input") \
-                            and not idx.lookup(sub.func.id, fn):
-                        emit(sub, f"host `{sub.func.id}()` inside traced "
-                                  f"function `{fname}` (fires at trace "
-                                  "time, not per step)")
-                    elif isinstance(sub.func, ast.Attribute) and \
-                            sub.func.attr == "item" and not sub.args \
-                            and not sub.keywords:
-                        emit(sub, f"`.item()` inside traced function "
-                                  f"`{fname}` (host sync mid-trace)")
-                elif check_ifs and isinstance(sub, (ast.If, ast.While)):
-                    hit = self._test_param(sub.test, params)
-                    if hit:
-                        kind = "while" if isinstance(sub, ast.While) \
-                            else "if"
-                        emit(sub, f"Python `{kind}` on tracer-typed name "
-                                  f"`{hit}` inside `{fname}` (args of "
-                                  "scan/cond bodies are tracers; use "
-                                  "lax.cond/jnp.where)")
-        return findings
+        for sub in body_walk(rec.node):
+            if isinstance(sub, ast.Call):
+                if isinstance(sub.func, ast.Name) and \
+                        sub.func.id in ("print", "breakpoint", "input") \
+                        and not idx.lookup(sub.func.id, rec.node):
+                    emit(rec, sub, f"host `{sub.func.id}()` inside "
+                                   f"traced function `{fname}` (fires "
+                                   "at trace time, not per step)")
+                elif isinstance(sub.func, ast.Attribute) and \
+                        sub.func.attr == "item" and not sub.args \
+                        and not sub.keywords:
+                    emit(rec, sub, f"`.item()` inside traced function "
+                                   f"`{fname}` (host sync mid-trace)")
+            elif check_ifs and isinstance(sub, (ast.If, ast.While)):
+                hit = self._test_param(sub.test, params)
+                if hit:
+                    kind = "while" if isinstance(sub, ast.While) else "if"
+                    emit(rec, sub, f"Python `{kind}` on tracer-typed "
+                                   f"name `{hit}` inside `{fname}` "
+                                   "(args of scan/cond bodies are "
+                                   "tracers; use lax.cond/jnp.where)")
 
     @staticmethod
     def _test_param(test: ast.AST, params: Set[str]) -> Optional[str]:
@@ -279,16 +219,3 @@ class TracePurityChecker(Checker):
                     isinstance(sub.ctx, ast.Load):
                 return sub.id
         return None
-
-    @staticmethod
-    def _body_walk(fn):
-        """Walk a function's body, NOT descending into nested
-        FunctionDefs (traced separately if reachable) but following
-        inline lambdas (they run at trace time via tree.map etc.)."""
-        stack = list(ast.iter_child_nodes(fn))
-        while stack:
-            node = stack.pop()
-            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
-                continue
-            yield node
-            stack.extend(ast.iter_child_nodes(node))

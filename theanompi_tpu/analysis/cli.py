@@ -9,9 +9,17 @@ Modes:
 * ``--update-baseline``: regenerate ``tpulint_baseline.json``
   deterministically (sorted, path-relative), preserving justifications
   of retained entries; new entries get ``TODO: justify``.
-* ``--json``: machine-readable findings + baseline delta.
+* ``--format json`` (``--json`` kept as an alias): machine-readable
+  findings + baseline delta; every finding carries a stable
+  ``fingerprint`` (schema in docs/design.md §12).
 * ``--only`` / ``--disable``: comma-separated checker names;
   ``--list-checks`` prints the registry.
+* ``--no-cache``: bypass the ``.tpulint_cache/`` result cache (on by
+  default; keyed on content hashes + the analysis-source fingerprint,
+  so it can only ever hit on a byte-identical configuration —
+  ``analysis/cache.py``).
+* ``--verbose``: list every TODO-justified baseline entry instead of
+  the one-line summary.
 
 Exit codes: 0 clean, 1 findings/drift, 2 bad invocation.
 """
@@ -22,10 +30,12 @@ import argparse
 import json
 import os
 import sys
-from typing import List, Optional
+from typing import Dict, List, Optional
 
+from . import cache as cache_mod
 from . import checkers as _checkers  # noqa: F401  (registers the suite)
-from .core import (BASELINE_NAME, CHECKERS, compare_baseline, load_baseline,
+from .core import (BASELINE_NAME, CHECKERS, Finding, compare_baseline,
+                   file_scoped_checkers, iter_py_paths, load_baseline,
                    run_lint, save_baseline)
 
 
@@ -41,15 +51,76 @@ def _split(value: Optional[str]) -> Optional[List[str]]:
     return [v.strip() for v in value.split(",") if v.strip()]
 
 
+def _cached_run(root, paths, only, disable, cache_dir=None):
+    """Run the suite through the result cache.  Returns
+    ``(findings, status)`` with status in hit/miss/off (off = the cache
+    store is unusable)."""
+    unknown = [n for n in (list(only or []) + list(disable or []))
+               if n not in CHECKERS]
+    if unknown:
+        raise KeyError(f"unknown checker(s) {unknown}; have "
+                       f"{sorted(CHECKERS)}")
+    selected = sorted(n for n in CHECKERS
+                      if (only is None or n in only)
+                      and (disable is None or n not in disable))
+    rels = iter_py_paths(root, paths)
+    lint_rels = {r.replace(os.sep, "/") for r in rels}
+    if "schema-drift" in selected:
+        # the live probe's inputs must key the cache even on partial
+        # runs whose path set does not cover them — but they are NOT
+        # part of the linted set then, so no per-file entry may be
+        # stored for them (it would read as "no findings" to a later
+        # full run)
+        from .checkers.schema_drift import RECORDER_PATH, TELEMETRY_PATH
+        for probe in (RECORDER_PATH, TELEMETRY_PATH):
+            if probe not in lint_rels and \
+                    os.path.exists(os.path.join(root, probe)):
+                rels = list(rels) + [probe]
+    hashes = cache_mod.file_hashes(root, rels)
+    afp = cache_mod.analysis_fingerprint()
+    store = cache_mod.LintCache(root, cache_dir)
+    tkey = cache_mod.tree_key(afp, selected, list(paths or []), hashes)
+    cached = store.load_tree(tkey)
+    if cached is not None:
+        return cached, "hit"
+
+    # tree miss: splice per-file hits for the file-scoped checkers and
+    # run everything else live
+    fsc = [n for n in file_scoped_checkers() if n in selected]
+    fkeys = {rel: cache_mod.file_key(afp, fsc, sha)
+             for rel, sha in hashes}
+    file_cache: Dict[str, List[Finding]] = {}
+    for rel, key in fkeys.items():
+        hit = store.load_file(key)
+        if hit is not None:
+            file_cache[rel] = hit
+    findings = run_lint(root, paths=paths, only=only, disable=disable,
+                        file_cache=file_cache or None)
+    by_path: Dict[str, List[Finding]] = {}
+    for f in findings:
+        if f.check in fsc:
+            by_path.setdefault(f.path, []).append(f)
+    for rel, key in fkeys.items():
+        if rel not in file_cache and rel in lint_rels:
+            store.store_file(key, by_path.get(rel, []))
+    store.store_tree(tkey, findings)
+    return findings, "miss"
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     ap = argparse.ArgumentParser(
         prog="lint.py",
-        description="tpulint — AST invariant checkers (docs/design.md §12)")
+        description="tpulint — whole-program invariant checkers "
+                    "(docs/design.md §12)")
     ap.add_argument("paths", nargs="*",
                     help="files/dirs to lint (default: the repo set)")
     ap.add_argument("--root", default=None,
                     help="repo root (default: inferred from this file)")
-    ap.add_argument("--json", action="store_true", dest="as_json")
+    ap.add_argument("--format", default=None, dest="fmt",
+                    choices=("human", "json"),
+                    help="output format (default: human)")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="alias for --format json")
     ap.add_argument("--only", default=None,
                     help="comma-separated checker names to run")
     ap.add_argument("--disable", default=None,
@@ -59,6 +130,15 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap.add_argument("--update-baseline", action="store_true")
     ap.add_argument("--check-baseline", action="store_true",
                     help="fail on stale baseline entries too (tier-1 mode)")
+    ap.add_argument("--no-cache", action="store_true",
+                    help="bypass the .tpulint_cache/ result cache")
+    ap.add_argument("--cache-dir", default=None,
+                    help="cache directory (default: <root>/"
+                         ".tpulint_cache; the precommit hook points "
+                         "this at the repo while rooting at a temp "
+                         "index checkout)")
+    ap.add_argument("--verbose", action="store_true",
+                    help="list every TODO-justified baseline entry")
     ap.add_argument("--list-checks", action="store_true")
     try:
         args = ap.parse_args(argv)
@@ -70,6 +150,7 @@ def main(argv: Optional[List[str]] = None) -> int:
             print(f"{name}: {CHECKERS[name].description}")
         return 0
 
+    as_json = args.as_json or args.fmt == "json"
     root = os.path.abspath(args.root or _repo_root())
     baseline_path = args.baseline or os.path.join(root, BASELINE_NAME)
     # a typo'd explicit path must not read as "linted clean" — the
@@ -82,9 +163,15 @@ def main(argv: Optional[List[str]] = None) -> int:
               file=sys.stderr)
         return 2
     try:
-        findings = run_lint(root, paths=args.paths or None,
-                            only=_split(args.only),
-                            disable=_split(args.disable))
+        if args.no_cache:
+            findings = run_lint(root, paths=args.paths or None,
+                                only=_split(args.only),
+                                disable=_split(args.disable))
+            cache_status = "off"
+        else:
+            findings, cache_status = _cached_run(
+                root, args.paths or None, _split(args.only),
+                _split(args.disable), cache_dir=args.cache_dir)
     except KeyError as e:
         print(f"lint: {e.args[0]}", file=sys.stderr)
         return 2
@@ -117,22 +204,36 @@ def main(argv: Optional[List[str]] = None) -> int:
 
     # the documented baseline contract: entries carry a real one-line
     # justification; TODO placeholders nag on EVERY run, not just the
-    # --update-baseline that wrote them
+    # --update-baseline that wrote them — but as ONE summary line, not
+    # a per-entry flood (--verbose restores the full list)
     todo = [e for e in entries
             if str(e.get("justification", "")).startswith("TODO")]
     if todo:
-        # stderr, so --json stdout stays machine-readable
-        for e in todo:
-            print(f"baseline entry needs a justification: "
-                  f"{e.get('check')}: {e.get('path')}: "
-                  f"{e.get('message')}", file=sys.stderr)
+        # stderr, so json stdout stays machine-readable
+        if args.verbose:
+            for e in todo:
+                print(f"baseline entry needs a justification: "
+                      f"{e.get('check')}: {e.get('path')}: "
+                      f"{e.get('message')}", file=sys.stderr)
+        else:
+            n = len(todo)
+            print(f"tpulint: {n} baseline entr{'y' if n == 1 else 'ies'} "
+                  "with a TODO placeholder — each needs a justification "
+                  "(--verbose lists them)", file=sys.stderr)
 
-    if args.as_json:
+    if as_json:
+        def enrich(f: Finding) -> dict:
+            d = f.to_dict()
+            d["fingerprint"] = f.stable_id
+            return d
+
         print(json.dumps({
-            "findings": [f.to_dict() for f in findings],
-            "new": [f.to_dict() for f in new],
+            "version": 2,
+            "findings": [enrich(f) for f in findings],
+            "new": [enrich(f) for f in new],
             "baselined": len(matched),
             "stale_baseline": stale,
+            "cache": cache_status,
         }, indent=2, sort_keys=True))
     else:
         for f in new:
@@ -142,7 +243,7 @@ def main(argv: Optional[List[str]] = None) -> int:
                   f"{e.get('path')}: {e.get('message')}", file=sys.stderr)
         status = (f"tpulint: {len(findings)} finding(s) — {len(new)} new, "
                   f"{len(matched)} baselined, {len(stale)} stale baseline "
-                  "entr(ies)")
+                  f"entr(ies) [cache {cache_status}]")
         print(status)
 
     if new:

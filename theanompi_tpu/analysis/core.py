@@ -53,6 +53,16 @@ class Finding:
     def fingerprint(self) -> Tuple[str, str, str]:
         return (self.check, self.path, self.message)
 
+    @property
+    def stable_id(self) -> str:
+        """Line-insensitive hex id for machine consumers (--format json,
+        docs/design.md §12): sha1 over ``check|path|message``, 12 hex
+        chars — stable across unrelated edits exactly like the baseline
+        identity it hashes."""
+        import hashlib
+        return hashlib.sha1(
+            "|".join(self.fingerprint).encode()).hexdigest()[:12]
+
     def sort_key(self):
         return (self.path, self.line, self.col, self.check, self.message)
 
@@ -181,20 +191,29 @@ CHECKERS: Dict[str, "Checker"] = {}
 
 class Checker:
     """Base checker.  Subclasses set ``name``/``description`` and override
-    :meth:`check_file` (per-file AST walk) and/or :meth:`check_project`
-    (one run per invocation — live-object probes).  A project-only
-    checker sets ``reads_files = False`` so a run restricted to it
-    (``--only schema-drift``, the shim's mode) skips the repo-wide
-    parse — and its parse-error findings — entirely."""
+    :meth:`check_file` (per-file AST walk), :meth:`check_program` (the
+    whole-program pass — receives the shared
+    :class:`~.engine.ProgramIndex`, built once per invocation), and/or
+    :meth:`check_project` (one run per invocation — live-object probes).
+    A project-only checker sets ``reads_files = False`` so a run
+    restricted to it (``--only schema-drift``, the shim's mode) skips
+    the repo-wide parse — and its parse-error findings — entirely.
+    ``needs_engine = True`` asks the runner for the shared call-graph
+    index."""
 
     name = "checker"
     description = ""
     reads_files = True
+    needs_engine = False
 
     def applies_to(self, path: str) -> bool:
         return True
 
     def check_file(self, sf: SourceFile) -> Iterable[Finding]:
+        return ()
+
+    def check_program(self, index) -> Iterable[Finding]:
+        """Whole-program pass over the shared ProgramIndex."""
         return ()
 
     def check_project(self, files: List[SourceFile]) -> Iterable[Finding]:
@@ -243,10 +262,19 @@ def collect_files(root: str, paths: Optional[Sequence[str]] = None
 
 def run_lint(root: str, paths: Optional[Sequence[str]] = None,
              only: Optional[Sequence[str]] = None,
-             disable: Optional[Sequence[str]] = None) -> List[Finding]:
+             disable: Optional[Sequence[str]] = None,
+             file_cache: Optional[Dict[str, List["Finding"]]] = None
+             ) -> List[Finding]:
     """Run the registered checkers over the file set; returns findings
     sorted by (path, line).  Suppressed findings are dropped here, so
-    checkers never need to know about the comment syntax."""
+    checkers never need to know about the comment syntax.
+
+    ``file_cache`` (the ``scripts/lint.py`` result cache): per-path
+    findings of the FILE-scoped checkers from a previous run over
+    byte-identical content — those paths skip :meth:`Checker.check_file`
+    and splice the cached findings in (already suppression-filtered,
+    since suppression is a function of the unchanged file content).
+    Program/project checkers always run live."""
     selected = {n: c for n, c in CHECKERS.items()
                 if (only is None or n in only)
                 and (disable is None or n not in disable)}
@@ -267,13 +295,27 @@ def run_lint(root: str, paths: Optional[Sequence[str]] = None,
                     "parse-error", rel.replace(os.sep, "/"),
                     int(e.lineno or 1), 0, f"cannot parse: {e.msg}"))
 
+    index = None
+    if files and any(c.needs_engine for c in selected.values()):
+        from .engine import ProgramIndex
+        index = ProgramIndex(files)
+
     by_path = {sf.path: sf for sf in files}
-    for checker in selected.values():
+    cached_paths = set(file_cache or ())
+    for name in sorted(selected):
+        checker = selected[name]
         for sf in files:
             if not checker.applies_to(sf.path):
                 continue
+            if sf.path in cached_paths:
+                continue      # spliced in below, once per path
             for f in checker.check_file(sf):
                 if not sf.suppressed(f.line, f.check):
+                    findings.append(f)
+        if index is not None and checker.needs_engine:
+            for f in checker.check_program(index):
+                sf = by_path.get(f.path)
+                if sf is None or not sf.suppressed(f.line, f.check):
                     findings.append(f)
         for f in checker.check_project(files):
             # project-level findings honor the same inline suppression
@@ -281,8 +323,21 @@ def run_lint(root: str, paths: Optional[Sequence[str]] = None,
             sf = by_path.get(f.path)
             if sf is None or not sf.suppressed(f.line, f.check):
                 findings.append(f)
+    for path in cached_paths & set(by_path):
+        findings.extend(f for f in file_cache[path]
+                        if f.check in selected or f.check == "parse-error")
     findings.sort(key=Finding.sort_key)
     return findings
+
+
+def file_scoped_checkers(selected: Optional[Dict[str, "Checker"]] = None
+                         ) -> List[str]:
+    """Names of checkers whose findings are a pure function of ONE file
+    (overridden :meth:`Checker.check_file`) — the set the per-file
+    result cache may memoize."""
+    pool = selected if selected is not None else CHECKERS
+    return sorted(n for n, c in pool.items()
+                  if type(c).check_file is not Checker.check_file)
 
 
 # ---------------------------------------------------------------------------

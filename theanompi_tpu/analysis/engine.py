@@ -1,0 +1,710 @@
+"""tpulint whole-program engine: repo-wide call graph + summaries.
+
+PR-5's checkers were per-file AST walks — the trace-purity closure
+stopped at same-file calls, so a host-side ``time.time()`` hidden one
+module away from a ``lax.scan`` body was invisible.  This module builds
+ONE :class:`ProgramIndex` per lint invocation on top of the existing
+one-parse-per-file :class:`~.core.SourceFile` cache and exposes:
+
+* a **call graph** over every function and method in scope — module
+  functions resolved through :class:`~.core.ImportResolver` (relative
+  imports included), ``self.<m>``/``cls.<m>`` resolved through the class
+  hierarchy INCLUDING subclass overrides (the ``exchange_body`` family),
+  and ``obj.<m>`` resolved when the method name is owned by exactly one
+  class hierarchy in scope (the *unique-family* rule — ``exchange_body``
+  qualifies, ``update`` does not) or when ``obj`` was assigned from a
+  visible constructor.  Callables passed by keyword or decorator count
+  as references (an edge), matching how trace wrappers consume them.
+* **transitive reachability** (:meth:`ProgramIndex.reachable`) so a
+  checker can close a seed set over the whole repo instead of one file.
+* a **per-function summary lattice** (:class:`FuncSummary`, all facts
+  monotone unions): reads-host-state, consumes-key (which parameter
+  positions a function spends as jax.random keys — directly or by
+  passing them into a consuming callee), issues-collective (which
+  ``lax`` collectives with which statically-known axis names), donates.
+  :meth:`ProgramIndex.transitive_summary` unions a function's summary
+  over everything it can reach.
+
+The engine is deliberately STATIC-only (stdlib ``ast``): resolution that
+would need type inference returns the empty list rather than guessing —
+a checker migrating onto this API keeps per-file behavior on single-file
+fixture runs (cross-file targets simply are not in scope) and gains the
+interprocedural closure on repo-wide runs.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Dict, FrozenSet, Iterable, List, Optional, Sequence, Set, Tuple
+
+from .core import ImportResolver, SourceFile
+
+_FuncDef = (ast.FunctionDef, ast.AsyncFunctionDef)
+_FuncLike = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+
+# ---------------------------------------------------------------------------
+# shared vocabulary (checkers import these instead of re-declaring)
+# ---------------------------------------------------------------------------
+
+HOST_CLOCKS = {"time.time", "time.perf_counter", "time.monotonic",
+               "time.process_time", "time.sleep"}
+SYNC_CALLS = {"jax.device_get"}
+
+# jax.random.<fn> that CONSUME their key argument (split consumes: two
+# splits of one key collide; fold_in derives and is deliberately absent —
+# the §8 fused-cadence contract).
+KEY_CONSUMERS = {
+    "ball", "bernoulli", "beta", "binomial", "bits", "categorical",
+    "cauchy", "chisquare", "choice", "dirichlet", "double_sided_maxwell",
+    "exponential", "f", "gamma", "generalized_normal", "geometric",
+    "gumbel", "laplace", "loggamma", "logistic", "lognormal", "maxwell",
+    "multinomial", "multivariate_normal", "normal", "orthogonal",
+    "pareto", "permutation", "poisson", "rademacher", "randint",
+    "rayleigh", "split", "t", "triangular", "truncated_normal",
+    "uniform", "wald", "weibull_min",
+}
+
+# named-axis collectives: maps the simple name to the positional index of
+# the axis-name argument in the jax.lax signature
+COLLECTIVES = {
+    "psum": 1, "pmean": 1, "pmax": 1, "pmin": 1,
+    "ppermute": 1, "pshuffle": 1, "all_gather": 1,
+    "all_gather_invariant": 1, "all_to_all": 1, "psum_scatter": 1,
+    "axis_index": 0, "axis_size": 0,
+}
+
+_COLLECTIVE_MODULES = ("jax.lax.", "theanompi_tpu.jax_compat.")
+
+
+def collective_name(resolved: Optional[str]) -> Optional[str]:
+    """The simple collective name of a resolved dotted path, or None."""
+    if not resolved:
+        return None
+    for mod in _COLLECTIVE_MODULES:
+        if resolved.startswith(mod):
+            simple = resolved[len(mod):]
+            if simple in COLLECTIVES:
+                return simple
+    return None
+
+
+# ---------------------------------------------------------------------------
+# records and summaries
+# ---------------------------------------------------------------------------
+
+@dataclass
+class FuncRecord:
+    """One function/method definition anywhere in scope."""
+
+    sf: SourceFile
+    node: ast.AST                      # FunctionDef/AsyncFunctionDef/Lambda
+    qualname: str                      # module.Class.method / module.func
+    class_name: Optional[str] = None   # simple name of the enclosing class
+    class_key: Optional[Tuple[str, str]] = None   # (module, ClassName)
+
+    @property
+    def name(self) -> str:
+        return getattr(self.node, "name", "<lambda>")
+
+    def params(self) -> List[str]:
+        a = self.node.args
+        out = [p.arg for p in list(a.posonlyargs) + list(a.args)]
+        return [p for p in out if p not in ("self", "cls")]
+
+
+@dataclass
+class FuncSummary:
+    """Direct (non-transitive) facts about one function body.  Every
+    field is a monotone set/flag so transitive summaries are unions."""
+
+    host_calls: List[Tuple[ast.AST, str]] = field(default_factory=list)
+    key_params: Set[int] = field(default_factory=set)
+    collectives: List[Tuple[ast.AST, str, Tuple]] = field(
+        default_factory=list)        # (call node, name, axis values or ())
+    donates: bool = False
+
+    @property
+    def reads_host_state(self) -> bool:
+        return bool(self.host_calls)
+
+    @property
+    def consumes_key(self) -> bool:
+        return bool(self.key_params)
+
+    @property
+    def issues_collective(self) -> bool:
+        return bool(self.collectives)
+
+
+@dataclass
+class TransitiveSummary:
+    reads_host_state: bool = False
+    consumes_key: bool = False
+    issues_collective: bool = False
+    donates: bool = False
+    collective_names: FrozenSet[str] = frozenset()
+
+
+# ---------------------------------------------------------------------------
+# per-file scope index
+# ---------------------------------------------------------------------------
+
+class FileIndex:
+    """Scoping structure of one file: defs by enclosing function scope,
+    methods by class, classes with their (resolved) base names, and the
+    enclosing function of every node."""
+
+    def __init__(self, sf: SourceFile):
+        self.sf = sf
+        # id(scope func node or None) -> {name: [def nodes]}
+        self.by_scope: Dict[Optional[int], Dict[str, List[ast.AST]]] = {}
+        # method simple name -> [def nodes] across every class in the file
+        self.methods: Dict[str, List[ast.AST]] = {}
+        # def-node id -> enclosing function node
+        self.parent_func: Dict[int, Optional[ast.AST]] = {}
+        # any-node id -> enclosing function node (call-site scope lookup)
+        self.enclosing: Dict[int, Optional[ast.AST]] = {}
+        # ClassDef nodes by simple name; def-node id -> owning ClassDef
+        self.classes: Dict[str, ast.ClassDef] = {}
+        self.class_of: Dict[int, ast.ClassDef] = {}
+        self._walk(sf.tree, None, None)
+        self._record_enclosing(sf.tree, None)
+
+    def _walk(self, node, func, cls) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, _FuncDef):
+                scope = self.by_scope.setdefault(
+                    id(func) if func else None, {})
+                scope.setdefault(child.name, []).append(child)
+                if cls is not None and isinstance(node, ast.ClassDef):
+                    self.methods.setdefault(child.name, []).append(child)
+                    self.class_of[id(child)] = cls
+                self.parent_func[id(child)] = func
+                self._walk(child, child, None)
+            elif isinstance(child, ast.ClassDef):
+                self.classes.setdefault(child.name, child)
+                self._walk(child, func, child)
+            elif isinstance(child, ast.Lambda):
+                self.parent_func[id(child)] = func
+                self._walk(child, child, None)
+            else:
+                self._walk(child, func, cls)
+
+    def _record_enclosing(self, node, func) -> None:
+        self.enclosing[id(node)] = func
+        for child in ast.iter_child_nodes(node):
+            self._record_enclosing(
+                child, child if isinstance(child, _FuncLike) else func)
+
+    def lookup(self, name: str, from_func: Optional[ast.AST]
+               ) -> List[ast.AST]:
+        """Defs named ``name`` visible from ``from_func``: its locals,
+        then enclosing functions', then module level."""
+        f = from_func
+        while True:
+            scope = self.by_scope.get(id(f) if f else None, {})
+            if name in scope:
+                return list(scope[name])
+            if f is None:
+                return []
+            f = self.parent_func.get(id(f))
+
+
+# ---------------------------------------------------------------------------
+# the whole-program index
+# ---------------------------------------------------------------------------
+
+class ProgramIndex:
+    """Repo-wide call graph + summaries over a list of parsed files."""
+
+    def __init__(self, files: Sequence[SourceFile]):
+        self.files = list(files)
+        self.by_path: Dict[str, SourceFile] = {sf.path: sf for sf in files}
+        self.file_index: Dict[str, FileIndex] = {
+            sf.path: FileIndex(sf) for sf in files}
+        # absolute dotted name -> [FuncRecord] (module funcs AND methods
+        # under module.Class.method)
+        self.by_qualname: Dict[str, List[FuncRecord]] = {}
+        # method simple name -> [FuncRecord] repo-wide
+        self.methods: Dict[str, List[FuncRecord]] = {}
+        self.records: Dict[int, FuncRecord] = {}      # id(node) -> record
+        # (module, ClassName) -> [absolute dotted base names]
+        self.class_bases: Dict[Tuple[str, str], List[str]] = {}
+        # absolute dotted class name -> (module, ClassName)
+        self._class_keys: Dict[str, Tuple[str, str]] = {}
+        self._module_constants: Dict[str, object] = {}
+        for sf in files:
+            self._index_file(sf)
+        self._subclasses = self._compute_subclasses()
+        self._callees_cache: Dict[int, List[FuncRecord]] = {}
+        self._summary_cache: Dict[int, FuncSummary] = {}
+        self._key_params_cache: Optional[Dict[int, Set[int]]] = None
+        self._transitive_cache: Dict[int, TransitiveSummary] = {}
+
+    # -- construction ------------------------------------------------------
+
+    def _index_file(self, sf: SourceFile) -> None:
+        module = sf.resolver.module
+        idx = self.file_index[sf.path]
+        for node in ast.walk(sf.tree):
+            if isinstance(node, ast.Lambda):
+                # unnamed, unresolvable by name — indexed so a lambda
+                # seed (a scan body) still closes over its callees
+                rec = FuncRecord(sf, node,
+                                 f"{module}.<lambda>:{node.lineno}")
+                self.records[id(node)] = rec
+                continue
+            if not isinstance(node, _FuncDef):
+                continue
+            cls = idx.class_of.get(id(node))
+            if cls is not None:
+                qual = f"{module}.{cls.name}.{node.name}"
+                rec = FuncRecord(sf, node, qual, cls.name,
+                                 (module, cls.name))
+                self.methods.setdefault(node.name, []).append(rec)
+            elif idx.parent_func.get(id(node)) is None:
+                qual = f"{module}.{node.name}"
+                rec = FuncRecord(sf, node, qual)
+            else:
+                qual = f"{module}.<locals>.{node.name}"
+                rec = FuncRecord(sf, node, qual)
+            self.records[id(node)] = rec
+            self.by_qualname.setdefault(rec.qualname, []).append(rec)
+        for name, cls in idx.classes.items():
+            key = (module, name)
+            self._class_keys[f"{module}.{name}"] = key
+            bases = []
+            for b in cls.bases:
+                resolved = sf.resolver.resolve(b)
+                if resolved is None and isinstance(b, ast.Name):
+                    # same-file base class
+                    if b.id in idx.classes:
+                        resolved = f"{module}.{b.id}"
+                if resolved:
+                    bases.append(resolved)
+            self.class_bases[key] = bases
+        # module-level string constants (mesh axis names and the like)
+        for st in sf.tree.body:
+            if isinstance(st, ast.Assign) and \
+                    isinstance(st.value, ast.Constant):
+                for t in st.targets:
+                    if isinstance(t, ast.Name):
+                        self._module_constants[f"{module}.{t.id}"] = \
+                            st.value.value
+
+    def _compute_subclasses(self) -> Dict[Tuple[str, str],
+                                          Set[Tuple[str, str]]]:
+        """Transitive subclass sets, keyed by (module, ClassName)."""
+        direct: Dict[Tuple[str, str], Set[Tuple[str, str]]] = {}
+        for key, bases in self.class_bases.items():
+            for b in bases:
+                bkey = self._class_keys.get(b)
+                if bkey is not None:
+                    direct.setdefault(bkey, set()).add(key)
+        out: Dict[Tuple[str, str], Set[Tuple[str, str]]] = {}
+
+        def close(key):
+            if key in out:
+                return out[key]
+            out[key] = set()
+            for sub in direct.get(key, ()):
+                out[key].add(sub)
+            # iterate to fixpoint below instead of recursing (cycles)
+            return out[key]
+
+        for key in list(self.class_bases):
+            close(key)
+        changed = True
+        while changed:
+            changed = False
+            for key, subs in out.items():
+                grown = set(subs)
+                for s in subs:
+                    grown |= out.get(s, set())
+                if grown != subs:
+                    out[key] = grown
+                    changed = True
+        return out
+
+    # -- class hierarchy queries ------------------------------------------
+
+    def module_constant(self, dotted: str):
+        """The literal value of a module-level constant, or None."""
+        return self._module_constants.get(dotted)
+
+    def subclasses_of(self, dotted_class: str) -> List[Tuple[str, str]]:
+        key = self._class_keys.get(dotted_class)
+        if key is None:
+            return []
+        return sorted(self._subclasses.get(key, set()) | {key})
+
+    def hierarchy_root(self, key: Tuple[str, str]) -> Tuple[str, str]:
+        """Topmost in-scope ancestor of a class (first-base chain)."""
+        seen = set()
+        while key not in seen:
+            seen.add(key)
+            bases = self.class_bases.get(key, [])
+            parent = None
+            for b in bases:
+                bkey = self._class_keys.get(b)
+                if bkey is not None:
+                    parent = bkey
+                    break
+            if parent is None:
+                return key
+            key = parent
+        return key
+
+    def method_records(self, class_key: Tuple[str, str], name: str,
+                       include_subclasses: bool = True) -> List[FuncRecord]:
+        """Records for ``name`` defined on the class, its in-scope
+        ancestors, and (optionally) every subclass override."""
+        keys = {class_key}
+        # ancestors (first-base chains, all bases)
+        frontier = [class_key]
+        while frontier:
+            k = frontier.pop()
+            for b in self.class_bases.get(k, []):
+                bk = self._class_keys.get(b)
+                if bk is not None and bk not in keys:
+                    keys.add(bk)
+                    frontier.append(bk)
+        if include_subclasses:
+            keys |= self._subclasses.get(class_key, set())
+            # overrides live on subclasses of ANCESTORS too (siblings are
+            # deliberately excluded: a sibling's override is unreachable
+            # through this receiver)
+        out = []
+        for k in keys:
+            out.extend(self.by_qualname.get(f"{k[0]}.{k[1]}.{name}", []))
+        return out
+
+    # -- call resolution ---------------------------------------------------
+
+    def _unique_family(self, name: str) -> List[FuncRecord]:
+        """All methods named ``name`` when they belong to ONE class
+        hierarchy (same root) — the ``exchange_body`` rule.  Ambiguous
+        names (``update``, ``init``) resolve to nothing."""
+        recs = self.methods.get(name, [])
+        if not recs:
+            return []
+        roots = {self.hierarchy_root(r.class_key) for r in recs
+                 if r.class_key is not None}
+        if len(roots) != 1:
+            return []
+        return list(recs)
+
+    def _local_ctor_types(self, rec: FuncRecord) -> Dict[str, Tuple[str,
+                                                                    str]]:
+        """Names assigned from a visible constructor call in this
+        function's body: ``exch = BSP_Exchanger(cfg)`` -> class key."""
+        out: Dict[str, Tuple[str, str]] = {}
+        for sub in body_walk(rec.node):
+            if not isinstance(sub, ast.Assign) or \
+                    not isinstance(sub.value, ast.Call):
+                continue
+            fn = sub.value.func
+            cls_key = None
+            if isinstance(fn, ast.Name):
+                idx = self.file_index[rec.sf.path]
+                if fn.id in idx.classes:
+                    cls_key = (rec.sf.resolver.module, fn.id)
+                else:
+                    resolved = rec.sf.resolver.resolve(fn)
+                    cls_key = self._class_keys.get(resolved or "")
+            else:
+                resolved = rec.sf.resolver.resolve(fn)
+                cls_key = self._class_keys.get(resolved or "")
+            if cls_key is None:
+                continue
+            for t in sub.targets:
+                if isinstance(t, ast.Name):
+                    out[t.id] = cls_key
+        return out
+
+    def resolve_call(self, sf: SourceFile, func_expr: ast.AST,
+                     enclosing: Optional[ast.AST],
+                     ctor_types: Optional[Dict[str, Tuple[str, str]]] = None
+                     ) -> List[FuncRecord]:
+        """Possible targets of a call through ``func_expr``, or []."""
+        idx = self.file_index[sf.path]
+        if isinstance(func_expr, ast.Name):
+            local = idx.lookup(func_expr.id, enclosing)
+            if local:
+                return [self.records[id(n)] for n in local
+                        if id(n) in self.records]
+            resolved = sf.resolver.resolve(func_expr)
+            if resolved:
+                return list(self.by_qualname.get(resolved, []))
+            return []
+        if isinstance(func_expr, ast.Attribute):
+            base = func_expr.value
+            # self.m / cls.m: the enclosing class hierarchy + overrides
+            if isinstance(base, ast.Name) and base.id in ("self", "cls"):
+                cls = None
+                f = enclosing
+                while f is not None:
+                    cls = idx.class_of.get(id(f))
+                    if cls is not None:
+                        break
+                    f = idx.parent_func.get(id(f))
+                if cls is not None:
+                    recs = self.method_records(
+                        (sf.resolver.module, cls.name), func_expr.attr)
+                    if recs:
+                        return recs
+                # fixtures sometimes call self.m outside an indexed class;
+                # fall back to same-file methods by name
+                return [self.records[id(n)]
+                        for n in idx.methods.get(func_expr.attr, [])
+                        if id(n) in self.records]
+            # module.func through the import resolver
+            resolved = sf.resolver.resolve(func_expr)
+            if resolved and resolved in self.by_qualname:
+                return list(self.by_qualname[resolved])
+            # receiver with a locally-visible constructor type
+            if isinstance(base, ast.Name) and ctor_types and \
+                    base.id in ctor_types:
+                return self.method_records(ctor_types[base.id],
+                                           func_expr.attr)
+            # unique-family method name (the exchange_body rule)
+            return self._unique_family(func_expr.attr)
+        return []
+
+    def callees(self, rec: FuncRecord) -> List[FuncRecord]:
+        """Direct call/reference targets of one function body (not
+        descending into nested defs — they are reachable when called,
+        and local calls resolve through the scope chain)."""
+        cached = self._callees_cache.get(id(rec.node))
+        if cached is not None:
+            return cached
+        idx = self.file_index[rec.sf.path]
+        ctor_types = self._local_ctor_types(rec)
+        out: List[FuncRecord] = []
+        seen: Set[int] = set()
+
+        def add(targets: Iterable[FuncRecord]) -> None:
+            for t in targets:
+                if id(t.node) not in seen and t.node is not rec.node:
+                    seen.add(id(t.node))
+                    out.append(t)
+
+        for sub in body_walk(rec.node):
+            if isinstance(sub, ast.Call):
+                enc = idx.enclosing.get(id(sub.func), rec.node)
+                add(self.resolve_call(rec.sf, sub.func, enc, ctor_types))
+                # callables passed as arguments are references too
+                for arg in list(sub.args) + [kw.value for kw in
+                                             sub.keywords]:
+                    if isinstance(arg, (ast.Name, ast.Attribute)):
+                        enc = idx.enclosing.get(id(arg), rec.node)
+                        add(self.resolve_call(rec.sf, arg, enc,
+                                              ctor_types))
+        self._callees_cache[id(rec.node)] = out
+        return out
+
+    def reachable(self, seeds: Iterable[FuncRecord]) -> List[FuncRecord]:
+        """Transitive closure of :meth:`callees` over the seed set
+        (seeds included)."""
+        out: List[FuncRecord] = []
+        seen: Set[int] = set()
+        frontier = list(seeds)
+        while frontier:
+            rec = frontier.pop()
+            if id(rec.node) in seen:
+                continue
+            seen.add(id(rec.node))
+            out.append(rec)
+            frontier.extend(self.callees(rec))
+        return out
+
+    def record_for(self, node: ast.AST) -> Optional[FuncRecord]:
+        return self.records.get(id(node))
+
+    # -- summaries ---------------------------------------------------------
+
+    def summary(self, rec: FuncRecord) -> FuncSummary:
+        """Direct facts about one function body (cached)."""
+        cached = self._summary_cache.get(id(rec.node))
+        if cached is not None:
+            return cached
+        s = FuncSummary()
+        resolver = rec.sf.resolver
+        params = [p for p in rec.params()]
+        for sub in body_walk(rec.node):
+            if not isinstance(sub, ast.Call):
+                continue
+            resolved = resolver.resolve(sub.func)
+            if resolved in HOST_CLOCKS:
+                s.host_calls.append((sub, f"host clock `{resolved}()`"))
+            elif resolved and resolved.startswith("numpy.random."):
+                s.host_calls.append((sub, f"host RNG `{resolved}()`"))
+            elif resolved in SYNC_CALLS:
+                s.host_calls.append((sub, f"`{resolved}()`"))
+            cname = collective_name(resolved)
+            if cname is not None:
+                s.collectives.append(
+                    (sub, cname, axis_values(sub, cname, resolver, self)))
+            if resolved == "jax.jit" and any(
+                    kw.arg in ("donate_argnums", "donate_argnames")
+                    for kw in sub.keywords):
+                s.donates = True
+            # direct key consumption of a parameter
+            kn = consumed_key_name(sub, resolver)
+            if kn is not None and kn in params:
+                s.key_params.add(params.index(kn))
+        self._summary_cache[id(rec.node)] = s
+        return s
+
+    def key_params(self, rec: FuncRecord) -> Set[int]:
+        """Parameter positions this function consumes as jax.random keys
+        — directly, or by passing them to a consuming callee (fixpoint
+        across the whole graph)."""
+        if self._key_params_cache is None:
+            self._key_params_cache = self._compute_key_params()
+        return self._key_params_cache.get(id(rec.node), set())
+
+    def _compute_key_params(self) -> Dict[int, Set[int]]:
+        out: Dict[int, Set[int]] = {}
+        for rec in self.records.values():
+            direct = self.summary(rec).key_params
+            if direct:
+                out[id(rec.node)] = set(direct)
+        changed = True
+        while changed:
+            changed = False
+            for rec in self.records.values():
+                params = rec.params()
+                idx = self.file_index[rec.sf.path]
+                ctor_types = None
+                for sub in body_walk(rec.node):
+                    if not isinstance(sub, ast.Call):
+                        continue
+                    enc = idx.enclosing.get(id(sub.func), rec.node)
+                    if ctor_types is None:
+                        ctor_types = self._local_ctor_types(rec)
+                    for tgt in self.resolve_call(rec.sf, sub.func, enc,
+                                                 ctor_types):
+                        tgt_kp = out.get(id(tgt.node))
+                        if not tgt_kp:
+                            continue
+                        tparams = tgt.params()
+                        for i in tgt_kp:
+                            arg = None
+                            if i < len(sub.args):
+                                arg = sub.args[i]
+                            for kw in sub.keywords:
+                                if i < len(tparams) and \
+                                        kw.arg == tparams[i]:
+                                    arg = kw.value
+                            if isinstance(arg, ast.Name) and \
+                                    arg.id in params:
+                                j = params.index(arg.id)
+                                cur = out.setdefault(id(rec.node), set())
+                                if j not in cur:
+                                    cur.add(j)
+                                    changed = True
+        return out
+
+    def transitive_summary(self, rec: FuncRecord) -> TransitiveSummary:
+        """Union of :meth:`summary` over everything reachable from
+        ``rec`` (cached)."""
+        cached = self._transitive_cache.get(id(rec.node))
+        if cached is not None:
+            return cached
+        t = TransitiveSummary()
+        names: Set[str] = set()
+        for r in self.reachable([rec]):
+            s = self.summary(r)
+            t.reads_host_state = t.reads_host_state or s.reads_host_state
+            t.consumes_key = t.consumes_key or s.consumes_key
+            t.issues_collective = t.issues_collective or \
+                s.issues_collective
+            t.donates = t.donates or s.donates
+            names.update(n for _, n, _ in s.collectives)
+        t.collective_names = frozenset(names)
+        self._transitive_cache[id(rec.node)] = t
+        return t
+
+
+# ---------------------------------------------------------------------------
+# small shared AST helpers
+# ---------------------------------------------------------------------------
+
+def body_walk(fn: ast.AST):
+    """Walk a function's body, NOT descending into nested FunctionDefs
+    (reachable separately when called) but following inline lambdas
+    (they run at trace time via tree.map etc.)."""
+    stack = list(ast.iter_child_nodes(fn))
+    while stack:
+        node = stack.pop()
+        if isinstance(node, _FuncDef):
+            continue
+        yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def consumed_key_name(call: ast.Call, resolver: ImportResolver
+                      ) -> Optional[str]:
+    """The Name consumed as the key of a ``jax.random.<sampler>`` call,
+    or None."""
+    resolved = resolver.resolve(call.func)
+    if not resolved or not resolved.startswith("jax.random."):
+        return None
+    if resolved.rsplit(".", 1)[-1] not in KEY_CONSUMERS:
+        return None
+    key_arg = call.args[0] if call.args else None
+    for kw in call.keywords:
+        if kw.arg == "key":
+            key_arg = kw.value
+    if isinstance(key_arg, ast.Name):
+        return key_arg.id
+    return None
+
+
+def axis_values(call: ast.Call, cname: str, resolver: ImportResolver,
+                index: Optional[ProgramIndex] = None,
+                local_consts: Optional[Dict[str, object]] = None
+                ) -> Tuple:
+    """Statically-known axis names of one collective call: a tuple of
+    strings for every axis entry that resolves to a literal, or () when
+    the axis argument is not statically evaluable (parameters, computed
+    tuples) — unknown axes are SKIPPED, never guessed."""
+    pos = COLLECTIVES[cname]
+    arg = call.args[pos] if len(call.args) > pos else None
+    for kw in call.keywords:
+        if kw.arg in ("axis_name", "axis_names"):
+            arg = kw.value
+    if arg is None:
+        return ()
+    vals = _eval_axis(arg, resolver, index, local_consts)
+    return tuple(vals) if vals is not None else ()
+
+
+def _eval_axis(node: ast.AST, resolver: ImportResolver,
+               index: Optional[ProgramIndex],
+               local_consts: Optional[Dict[str, object]]
+               ) -> Optional[List[str]]:
+    if isinstance(node, ast.Constant):
+        return [node.value] if isinstance(node.value, str) else None
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out: List[str] = []
+        for e in node.elts:
+            sub = _eval_axis(e, resolver, index, local_consts)
+            if sub is None:
+                return None          # partially-unknown tuple: skip all
+            out.extend(sub)
+        return out
+    if isinstance(node, ast.Name) and local_consts is not None and \
+            node.id in local_consts:
+        v = local_consts[node.id]
+        return [v] if isinstance(v, str) else None
+    if isinstance(node, (ast.Name, ast.Attribute)):
+        resolved = resolver.resolve(node)
+        if resolved and index is not None:
+            v = index.module_constant(resolved)
+            if isinstance(v, str):
+                return [v]
+        return None
+    return None

@@ -207,22 +207,27 @@ class ModelBase:
             else 0.0
 
     def _u8_input_mean(self):
-        """Device constant for the u8-wire input path: the mean image's
-        center-crop window (or the scalar mean).  Cached per model.
+        """Constant for the u8-wire input path: the mean image's
+        center-crop window (or the scalar mean).  The HOST numpy value is
+        cached per model; the jnp conversion happens per call so each
+        trace owns its constant — caching the jnp array on ``self`` leaks
+        a tracer on jax versions that stage constant creation (first
+        touched inside the train trace, reused by the val trace →
+        UnexpectedTracerError; this was the u8-wire smoke seed failure).
         NOTE: for shared-window crops with a full mean image this deviates
         from the f32 pass's window-exact mean (see data/imagenet.py)."""
-        m = getattr(self, "__u8_mean", None)
+        m = getattr(self, "__u8_mean_host", None)
         if m is None:
             d = getattr(self, "data", None)
             mi = getattr(d, "img_mean", np.float32(122.0))
             if isinstance(mi, np.ndarray) and mi.ndim == 3:
                 c = int(getattr(d, "crop", mi.shape[0]))
                 cy, cx = (mi.shape[0] - c) // 2, (mi.shape[1] - c) // 2
-                m = jnp.asarray(mi[cy:cy + c, cx:cx + c, :], jnp.float32)
+                m = np.asarray(mi[cy:cy + c, cx:cx + c, :], np.float32)
             else:
-                m = jnp.float32(mi)
-            setattr(self, "__u8_mean", m)
-        return m
+                m = np.float32(mi)
+            setattr(self, "__u8_mean_host", m)
+        return jnp.asarray(m, jnp.float32)
 
     def stage_input(self, x):
         """Shared input staging for EVERY loss/metrics path (models with
