@@ -25,7 +25,15 @@ spc>1 single-step-flops derivation), ``BENCH_REAL_DATA`` (=1 drives the
 whole disk→augment→device pipeline; + ``BENCH_DATA_DIR``,
 ``BENCH_WIRE_U8``), ``BENCH_WINLOAD`` (=1, with BENCH_SPC>1: para_load
 window mode — the producer stacks+stages whole spc windows off the hot
-path and the timed loop dequeues mesh-resident windows).
+path and the timed loop dequeues mesh-resident windows),
+``BENCH_TRACE`` (=1 captures a ``jax.profiler`` window of
+``BENCH_TRACE_ITERS`` extra dispatches AFTER the timed loop — the
+measurement itself is never perturbed — and folds the
+``utils/devprof`` device-time attribution into the row:
+``overlap_ratio`` / ``exposed_comm_secs`` / ``device_compute_secs`` /
+``device_comm_secs`` plus ``device_mfu``, the trace-derived cross-check
+of the ``cost_analysis`` MFU column; ``BENCH_TRACE_DIR`` keeps the raw
+capture for Perfetto).
 
 Env knobs — wedge-proof wrapper: ``BENCH_TIMEOUT`` (hard kill, default
 1500 s), ``BENCH_PROBE_TIMEOUT`` (default 90 s), ``BENCH_PROBE_RETRIES``
@@ -565,6 +573,12 @@ def main() -> int:
         return 2
     iters = max(1, int(os.environ.get("BENCH_ITERS", "20")))
     warmup = max(1, int(os.environ.get("BENCH_WARMUP", "5")))
+    want_trace = os.environ.get("BENCH_TRACE") == "1"
+    trace_iters = max(1, int(os.environ.get("BENCH_TRACE_ITERS", "3")))
+    # extra dispatches the post-loop trace window consumes — the ONE value
+    # both dataset-provisioning computations below and the capture loop
+    # share, so they cannot drift
+    trace_extra = trace_iters + 1 if want_trace else 0
 
     import jax
     if _force_cpu():
@@ -639,7 +653,7 @@ def main() -> int:
             # epoch would block the dequeue until BENCH_TIMEOUT.  Both
             # synthetic knobs: batch-file-family (ImageNet) counts
             # batches, DataBase-family (cifar10) counts images.
-            need = (warmup + iters + 2) * spc_cfg
+            need = (warmup + iters + 2 + trace_extra) * spc_cfg
             config.setdefault("synthetic_batches", need)
             config.setdefault(
                 "synthetic_train",
@@ -656,7 +670,8 @@ def main() -> int:
         # imagenet.py files_per_step) — scale the dataset so one epoch
         # covers the whole timed run on any mesh size
         config["data_dir"] = _ensure_bench_dataset(
-            n_batches=max(32, (warmup + iters + 4) * spc_cfg) * n_chips,
+            n_batches=max(32, (warmup + iters + 4 + trace_extra)
+                          * spc_cfg) * n_chips,
             batch_size=int(config.get("batch_size", 128)))
         config["para_load"] = True
 
@@ -794,13 +809,39 @@ def main() -> int:
             except Exception as e:
                 print(f"mfu for spc>1 unavailable (single-step flop "
                       f"count failed: {e!r})", file=sys.stderr)
-        return (model, spc, n_images, dt, compiled, load_wait[0],
-                spc1_flops, step_secs)
+        # the row's load-wait evidence is frozen HERE: the trace window
+        # below keeps calling step() (streaming rows dequeue more batches
+        # after the producer idled through the flop-count gap), and those
+        # waits must not contaminate load_wait_share, which divides by the
+        # timed-loop-only dt
+        timed_load_wait = load_wait[0]
+        trace_profile = None
+        if want_trace:
+            # AFTER the timed window (nothing perturbs the measurement):
+            # capture trace_iters extra dispatches and attribute the device
+            # timeline — comm vs compute vs EXPOSED comm, the observability
+            # ROADMAP item 1's bucketed-overlap work is gated on
+            from theanompi_tpu.utils import devprof
+            tdir = os.environ.get("BENCH_TRACE_DIR")
+            try:
+                with devprof.capture(tdir) as cap:
+                    for i in range(trace_iters):
+                        step(warmup + iters + i)
+                    drain()
+                trace_profile = cap.profile
+                if trace_profile is None:
+                    print("bench: BENCH_TRACE capture produced no usable "
+                          "trace", file=sys.stderr)
+            except Exception as e:
+                print(f"bench: BENCH_TRACE capture failed ({e!r})",
+                      file=sys.stderr)
+        return (model, spc, n_images, dt, compiled, timed_load_wait,
+                spc1_flops, step_secs, trace_profile)
 
     retry = False
     try:
         model, spc, n_images, dt, compiled, load_wait, spc1_flops, \
-            step_secs = measure(config)
+            step_secs, trace_profile = measure(config)
     except Exception as e:
         if int(config.get("steps_per_call", 1)) <= 1:
             raise
@@ -817,7 +858,7 @@ def main() -> int:
         # (peak_hbm_bytes stays a process-wide monotone peak — see below)
         telem = telemetry.init({"telemetry": True})
         model, spc, n_images, dt, compiled, load_wait, spc1_flops, \
-            step_secs = measure(config)
+            step_secs, trace_profile = measure(config)
 
     ips = n_images * iters / dt
     ips_chip = ips / n_chips
@@ -874,6 +915,25 @@ def main() -> int:
         out["aot_donate"] = _cc.donated_load_safe(mesh)
     if mfu is not None:
         out["mfu"] = mfu
+    if trace_profile is not None:
+        # trace-derived columns (utils/devprof, BENCH_TRACE=1): device
+        # compute/comm/EXPOSED-comm time over the traced window and the
+        # overlap ratio — plus device_mfu, the device-timeline cross-check
+        # of the host-clock cost_analysis `mfu` column above
+        from theanompi_tpu.utils import devprof
+        flops_per_dispatch = None
+        if spc1_flops:
+            flops_per_dispatch = spc1_flops * spc
+        elif compiled is not None:
+            try:
+                flops_per_dispatch = _xla_flops(compiled)
+            except Exception:
+                flops_per_dispatch = None
+        out.update(devprof.profile_row_fields(
+            trace_profile,
+            total_flops=(flops_per_dispatch * trace_iters
+                         if flops_per_dispatch else None),
+            peak_flops=peak or None))
     if real_data or winload:
         # overlap evidence (SURVEY §2.8 "input pipeline at AlexNet
         # speeds"): the share of the timed window the consumer spent

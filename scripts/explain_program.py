@@ -1,0 +1,170 @@
+#!/usr/bin/env python
+"""Print and diff the AOT executable cache's per-program cost manifests.
+
+A cache HIT deserializes in milliseconds and tells you nothing about
+what you're about to run; since PR 7 the cache manifest
+(``utils/compile_cache``) records a cost/memory summary per entry at
+write time — flops, bytes accessed, argument/output/temp bytes and the
+HBM-peak estimate — so the question "what does this cached program cost"
+is answerable without recompiling anything.
+
+    python scripts/explain_program.py <cache_dir>              # table
+    python scripts/explain_program.py <cache_dir> --json       # raw dict
+    python scripts/explain_program.py <cache_dir> --diff A B   # two entries
+
+``A``/``B`` resolve by key prefix first, then by label substring (the
+NEWEST matching entry wins — labels repeat across spc/batch variants).
+The diff prints per-field deltas: where did the flops/HBM go between two
+variants of the same program (e.g. ``train:AlexNet:spc1`` vs ``spc4``,
+or a donated entry vs its donation-free twin).
+
+Stdlib only — reads ``manifest.json``, never unpickles entry bodies.
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+COST_FIELDS = ("flops", "bytes_accessed", "transcendentals",
+               "argument_bytes", "output_bytes", "temp_bytes",
+               "alias_bytes", "generated_code_bytes", "peak_hbm_bytes_est")
+
+
+def load_manifest(cache_dir):
+    path = os.path.join(cache_dir, "manifest.json")
+    try:
+        with open(path) as f:
+            m = json.load(f)
+    except OSError:
+        print(f"no manifest at {path} — not a compile-cache dir (or "
+              "nothing cached yet)", file=sys.stderr)
+        return None
+    except ValueError as e:
+        print(f"unparseable manifest {path}: {e}", file=sys.stderr)
+        return None
+    return m if isinstance(m, dict) else {}
+
+
+def resolve(manifest, token):
+    """One entry by key prefix, else by label substring (newest wins)."""
+    hits = [(k, v) for k, v in manifest.items() if k.startswith(token)]
+    if not hits:
+        hits = [(k, v) for k, v in manifest.items()
+                if token in str(v.get("label", ""))]
+    if not hits:
+        return None, None
+    return max(hits, key=lambda kv: kv[1].get("created", 0))
+
+
+def _fmt_count(v):
+    if v is None:
+        return "-"
+    for unit, div in (("T", 1e12), ("G", 1e9), ("M", 1e6), ("K", 1e3)):
+        if abs(v) >= div:
+            return f"{v / div:.2f}{unit}"
+    return f"{v:.0f}"
+
+
+def _fmt_bytes(v):
+    if v is None:
+        return "-"
+    for unit, div in (("GiB", 2**30), ("MiB", 2**20), ("KiB", 2**10)):
+        if abs(v) >= div:
+            return f"{v / div:.2f}{unit}"
+    return f"{v}B"
+
+
+def _age(created):
+    if not created:
+        return "-"
+    secs = max(0.0, time.time() - float(created))
+    for unit, div in (("d", 86400), ("h", 3600), ("m", 60)):
+        if secs >= div:
+            return f"{secs / div:.1f}{unit}"
+    return f"{secs:.0f}s"
+
+
+def print_table(manifest):
+    rows = sorted(manifest.items(),
+                  key=lambda kv: kv[1].get("created", 0), reverse=True)
+    print(f"{'key':<14}{'label':<34}{'plat':<6}{'compile':>8}{'blob':>10}"
+          f"{'flops':>9}{'rd/wr':>10}{'hbm est':>10}{'hits':>6}{'age':>7}")
+    for key, e in rows:
+        cost = e.get("cost", {})
+        print(f"{key[:12]:<14}"
+              f"{str(e.get('label', '?'))[:32]:<34}"
+              f"{str(e.get('platform', '?')):<6}"
+              f"{(str(e.get('compile_secs')) + 's'):>8}"
+              f"{_fmt_bytes(e.get('bytes')):>10}"
+              f"{_fmt_count(cost.get('flops')):>9}"
+              f"{_fmt_bytes(cost.get('bytes_accessed')):>10}"
+              f"{_fmt_bytes(cost.get('peak_hbm_bytes_est')):>10}"
+              f"{e.get('hits', 0):>6}"
+              f"{_age(e.get('created')):>7}")
+    no_cost = sum(1 for _, e in rows if not e.get("cost"))
+    if no_cost:
+        print(f"({no_cost} entr{'y' if no_cost == 1 else 'ies'} predate the "
+              "cost manifest — re-prewarm to populate)", file=sys.stderr)
+
+
+def print_diff(manifest, a_tok, b_tok):
+    ak, a = resolve(manifest, a_tok)
+    bk, b = resolve(manifest, b_tok)
+    missing = [t for t, k in ((a_tok, ak), (b_tok, bk)) if k is None]
+    if missing:
+        print(f"cannot resolve {missing} against the manifest (key prefix "
+              "or label substring)", file=sys.stderr)
+        return 2
+    print(f"A: {ak[:12]} {a.get('label')} ({a.get('platform')}, "
+          f"compiled {_age(a.get('created'))} ago)")
+    print(f"B: {bk[:12]} {b.get('label')} ({b.get('platform')}, "
+          f"compiled {_age(b.get('created'))} ago)")
+    ca, cb = a.get("cost", {}), b.get("cost", {})
+    def _fmt_secs(v):
+        return "-" if v is None else f"{v:.2f}s"
+
+    rows = [("compile_secs", a.get("compile_secs"), b.get("compile_secs")),
+            ("blob_bytes", a.get("bytes"), b.get("bytes"))]
+    rows += [(f, ca.get(f), cb.get(f)) for f in COST_FIELDS
+             if f in ca or f in cb]
+    print(f"  {'field':<24}{'A':>14}{'B':>14}{'B/A':>8}")
+    for field, va, vb in rows:
+        fmt = _fmt_secs if "secs" in field else \
+            _fmt_bytes if "bytes" in field else _fmt_count
+        ratio = (f"{vb / va:.3f}x"
+                 if isinstance(va, (int, float)) and va
+                 and isinstance(vb, (int, float)) else "-")
+        print(f"  {field:<24}{fmt(va):>14}{fmt(vb):>14}{ratio:>8}")
+    return 0
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("cache_dir")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the raw manifest dict to stdout")
+    ap.add_argument("--diff", nargs=2, metavar=("A", "B"), default=None,
+                    help="diff two entries (key prefix or label substring)")
+    args = ap.parse_args(argv)
+    manifest = load_manifest(args.cache_dir)
+    if manifest is None:
+        return 2
+    if not manifest:
+        print("manifest is empty — nothing cached yet", file=sys.stderr)
+        return 1
+    if args.json:
+        print(json.dumps(manifest, indent=1, sort_keys=True))
+        return 0
+    if args.diff:
+        return print_diff(manifest, *args.diff)
+    print_table(manifest)
+    return 0
+
+
+if __name__ == "__main__":
+    try:
+        raise SystemExit(main())
+    except BrokenPipeError:
+        os._exit(0)

@@ -26,10 +26,28 @@ Stale hygiene (round 7): bench.py's wedge fallback tags re-emitted
 last-good numbers ``stale: true`` — they rank below any fresh measurement
 (but above tombstones/degraded rows), so a wedged round's fallback can
 never shadow a later genuine re-measure.
+
+Column tolerance (round 12): rows grow columns over rounds
+(``compile_secs``/``cache`` in r8, tails in r9, the trace-derived
+``overlap_ratio``/``exposed_comm_secs``/``device_*`` columns with
+BENCH_TRACE).  The merge compares rows ONLY on the contract fields it
+names (``config``, ``result.value``, ``ts``, the degraded/stale
+markers); every access is ``get``-based and every numeric comparison is
+fenced, so a column absent from (or unparseable in) one side is
+UNKNOWN — it can neither KeyError the merge nor demote a row.
 """
 
 import json
 import sys
+
+
+def _as_float(v):
+    """Numeric view of one row field, or None when absent/unparseable —
+    the unknown-compares-as-unknown rule."""
+    try:
+        return float(v)
+    except (TypeError, ValueError):
+        return None
 
 
 def _is_degraded(row: dict) -> bool:
@@ -74,12 +92,13 @@ def _rank(row: dict, voided: dict, cfg: str) -> int:
     if _is_degraded(row):
         return 1
     tomb = voided.get(cfg)
-    val = res.get("value")
-    if tomb is not None and val is not None and \
-            abs(float(val) - float(tomb["value"])) < 1e-6:
-        ts, tomb_ts = row.get("ts"), tomb.get("ts")
-        if ts is not None and tomb_ts is not None and \
-                float(ts) > float(tomb_ts):
+    val = _as_float(res.get("value"))
+    tomb_val = _as_float(tomb["value"]) if tomb is not None else None
+    if val is not None and tomb_val is not None and \
+            abs(val - tomb_val) < 1e-6:
+        ts = _as_float(row.get("ts"))
+        tomb_ts = _as_float(tomb.get("ts"))
+        if ts is not None and tomb_ts is not None and ts > tomb_ts:
             # re-measured after the voiding — trust it; but a STALE
             # fallback is ts-stamped at re-EMISSION time, so it passes
             # this check while still carrying the voided old reading —
@@ -115,9 +134,10 @@ def merge(paths: list[str]) -> None:
                     # unstamped one): last-file-wins here would let an old
                     # backup's earlier tombstone re-open the ts window and
                     # resurrect the very reading the newer tombstone voids
-                    if old is None or old.get("ts") is None or \
-                            (new["ts"] is not None and
-                             float(new["ts"]) >= float(old["ts"])):
+                    new_ts = _as_float(new["ts"])
+                    old_ts = _as_float(old.get("ts")) if old else None
+                    if old is None or old_ts is None or \
+                            (new_ts is not None and new_ts >= old_ts):
                         voided[cfg] = new
     for path in paths:
         with open(path) as f:

@@ -1,98 +1,121 @@
 #!/usr/bin/env python
-"""Capture a TPU profiler trace of one model's training step and print the
-top HLO ops by self time.
+"""Capture a profiler trace of one model's training step and print the
+device-time attribution: top op classes, compute vs collective time,
+EXPOSED collective time, and the comm/compute overlap ratio.
 
-The reference's perf story was wall-clock section buckets (SURVEY.md §2.10);
-on TPU the per-op breakdown comes from XLA's profiler.  This script is the
-bottleneck-analysis harness behind BASELINE.md's MFU table.
+The reference's perf story was wall-clock section buckets (SURVEY.md
+§2.10); the per-op breakdown comes from XLA's profiler.  The capture,
+glob walk, and trace parse live in ``theanompi_tpu/utils/devprof.py``
+(the shared, tested trace reader — this script used to do the walk
+inline); this harness just builds the model, drives a traced window, and
+formats the result.
 
-Usage: python scripts/profile_model.py [model] [batch] [iters]
-Env: PROFILE_DIR (default /tmp/tpu_profile)
+Usage:
+    python scripts/profile_model.py [model] [batch] [iters]
+        [--rule bsp] [--spc K] [--json OUT]
+
+``--json`` writes the machine-readable profile (the full devprof dict +
+run metadata) so BASELINE.md's MFU/bottleneck table regenerates
+mechanically instead of by scraping console output.
+
+Env: PROFILE_DIR (trace capture dir, default /tmp/tpu_profile_<model>).
 """
 
-import glob
-import gzip
+import argparse
 import json
 import os
 import sys
 
 
-def main():
-    model_name = sys.argv[1] if len(sys.argv) > 1 else "resnet50"
-    batch = int(sys.argv[2]) if len(sys.argv) > 2 else 0
-    iters = int(sys.argv[3]) if len(sys.argv) > 3 else 10
-    trace_dir = os.environ.get("PROFILE_DIR", f"/tmp/tpu_profile_{model_name}")
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("model", nargs="?", default="resnet50")
+    ap.add_argument("batch", nargs="?", type=int, default=0)
+    ap.add_argument("iters", nargs="?", type=int, default=10)
+    ap.add_argument("--rule", default="bsp",
+                    choices=["bsp", "easgd", "asgd", "gosgd"])
+    ap.add_argument("--spc", type=int, default=1,
+                    help="steps_per_call of the traced dispatch")
+    ap.add_argument("--json", default=None, metavar="OUT",
+                    help="write the machine-readable profile here "
+                         "('-' for stdout)")
+    args = ap.parse_args(argv)
+    model_name = args.model
+    trace_dir = os.environ.get("PROFILE_DIR",
+                               f"/tmp/tpu_profile_{model_name}")
 
-    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    sys.path.insert(0, os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
     import jax
     import jax.numpy as jnp
     import importlib
-    from bench import MODELS
+    from theanompi_tpu.models.registry import MODELS
     from theanompi_tpu.parallel.exchanger import get_exchanger
     from theanompi_tpu.parallel.mesh import WORKER_AXIS, worker_mesh
     from theanompi_tpu.parallel import steps
+    from theanompi_tpu.utils import devprof
 
     jax.config.update("jax_default_prng_impl", "rbg")
     mesh = worker_mesh()
     modelfile, modelclass, extra = MODELS[model_name]
     config = {"mesh": mesh, "size": mesh.shape[WORKER_AXIS], "rank": 0,
               "verbose": False, **extra}
-    if batch:
-        config["batch_size"] = batch
+    if args.batch:
+        config["batch_size"] = args.batch
+    if args.spc > 1:
+        config["steps_per_call"] = args.spc
     model = getattr(importlib.import_module(modelfile), modelclass)(config)
-    exchanger = get_exchanger("bsp", config)
+    exchanger = get_exchanger(args.rule, config)
     model.compile_iter_fns(exchanger)
-    dev_batch = steps.put_batch(mesh, model.data.next_train_batch(0))
+    spc = int(config.get("steps_per_call", 1))
+    if spc > 1:
+        batches = [model.data.next_train_batch(j) for j in range(spc)]
+        dev_batch = steps.put_batch_stack(mesh, batches, model.batch_spec())
+    else:
+        dev_batch = steps.put_batch(mesh, model.data.next_train_batch(0),
+                                    model.batch_spec())
     lr = jnp.float32(model.current_lr)
     rng = jax.random.key(0)
 
     def step(i):
-        model.step_state, cost, err = model.train_fn(
-            model.step_state, dev_batch, lr, rng, jnp.int32(i))
+        # 1-based count strided by spc, exactly the worker/bench
+        # convention: the fused in-scan exchange cadence fires at its true
+        # rate (a 0-based count would run steps down to count0 < 0 and
+        # fire a step-0 exchange no real run issues)
+        with jax.profiler.TraceAnnotation(devprof.TRAIN_DISPATCH_SPAN):
+            model.step_state, cost, err = model.train_fn(
+                model.step_state, dev_batch, lr, rng,
+                jnp.int32((i + 1) * spc))
 
     for i in range(5):
         step(i)
     jax.block_until_ready(model.step_state["params"])
 
-    jax.profiler.start_trace(trace_dir)
-    for i in range(iters):
-        step(5 + i)
-    jax.block_until_ready(model.step_state["params"])
-    jax.profiler.stop_trace()
-
-    xplanes = glob.glob(os.path.join(trace_dir, "plugins/profile/*/*.xplane.pb"))
-    if not xplanes:
-        print("no xplane capture found", file=sys.stderr)
+    with devprof.capture(trace_dir) as cap:
+        for i in range(args.iters):
+            step(5 + i)
+        jax.block_until_ready(model.step_state["params"])
+    prof = cap.profile
+    if prof is None:
+        print(f"no trace capture found under {trace_dir}", file=sys.stderr)
         return 1
-    xplane = max(xplanes, key=os.path.getmtime)
 
-    from tensorboard_plugin_profile.convert import raw_to_tool_data as rtd
-    data, _ = rtd.xspace_to_tool_data([xplane], "framework_op_stats", {})
-    if isinstance(data, bytes):
-        data = data.decode()
-    table = json.loads(data)
-    # framework_op_stats: [ {…gviz table…} ] — rows of per-op totals
-    rows = []
-    for t in table:
-        cols = [c["label"] for c in t.get("cols", [])]
-        if "Total self-time (us)" not in cols and "total_self_time" not in str(cols).lower():
-            continue
-        for r in t.get("rows", []):
-            vals = [c.get("v") for c in r["c"]]
-            rows.append(dict(zip(cols, vals)))
-    if not rows:
-        # fallback: dump whatever structure came back
-        print(json.dumps(table)[:4000])
-        return 0
-    key = [c for c in rows[0] if "self-time" in c.lower() and "total" in c.lower()][0]
-    rows.sort(key=lambda r: -(r.get(key) or 0))
-    total = sum(r.get(key) or 0 for r in rows)
-    print(f"== {model_name} batch {model.batch_size}: top ops by self time "
-          f"({iters} steps, total {total/1e3:.1f} ms) ==")
-    namecol = [c for c in rows[0] if c.lower() in ("operation", "op name", "type")]
-    for r in rows[:25]:
-        name = " | ".join(str(r.get(c)) for c in rows[0] if isinstance(r.get(c), str))
-        print(f"{(r.get(key) or 0)/1e3:9.2f} ms  {100*(r.get(key) or 0)/max(total,1):5.1f}%  {name[:110]}")
+    print(f"== {model_name} batch {model.batch_size} {args.rule.upper()}"
+          f"{f' spc={spc}' if spc > 1 else ''}: {args.iters} traced "
+          f"dispatch(es) on {jax.devices()[0].platform} ==")
+    print(devprof.format_profile(prof, top=25))
+    if args.json:
+        doc = {"model": model_name, "batch_size": int(model.batch_size),
+               "rule": args.rule, "spc": spc, "iters": args.iters,
+               "platform": jax.devices()[0].platform,
+               "device_kind": getattr(jax.devices()[0], "device_kind", "?"),
+               "trace_dir": trace_dir, **prof}
+        if args.json == "-":
+            print(json.dumps(doc))
+        else:
+            with open(args.json, "w") as f:
+                json.dump(doc, f, indent=1, sort_keys=True)
+            print(f"wrote {args.json}")
     return 0
 
 

@@ -20,11 +20,20 @@ and emits the cross-worker run report the bucket sums can't answer:
   step time;
 * **health flags** — prefetch queue starvation (starved dequeues / min
   queue depth) and HBM headroom (peak bytes vs limit from ``gauges``
-  events), plus any flight recordings found (a crash/stall happened).
+  events), plus any flight recordings found (a crash/stall happened) and
+  per-rank sentry ``anomaly`` counts (NaN loss / loss spike / throughput
+  regression — ``utils/sentry``);
+* **``--trace out.json``** — the merged per-rank streams converted to
+  Chrome trace-event JSON: one process (track group) per rank holding
+  the phase spans (a ``phase`` event's span is ``[ts − dt, ts]``),
+  counter tracks for HBM bytes-in-use, prefetch queue depth, and
+  images/sec, and instant markers for anomaly/crash/stall/fatal-signal
+  events — open directly in Perfetto (ui.perfetto.dev) or
+  ``chrome://tracing`` for the cross-rank straggler timeline.
 
 Usage:
     python scripts/telemetry_report.py <record_dir> [--window SEC]
-                                       [--json out.json]
+                                       [--json out.json] [--trace out.json]
 
 Stdlib only — runnable on a machine with no jax installed.
 """
@@ -35,6 +44,21 @@ import json
 import os
 import sys
 from collections import defaultdict
+
+
+# Event kinds this report (and the --trace converter) consumes — the
+# tpulint schema-drift checker asserts the emitters' vocabulary (telemetry
+# phase events, sentry anomalies, devprof device profiles) stays inside
+# it, so an emitter can't add a kind the report silently drops.
+TRACKED_EVENTS = ("phase", "train_record", "val_record", "gauges",
+                  "device_profile", "anomaly", "crash", "stall",
+                  "fatal_signal")
+
+# gauges-event keys drawn as Perfetto counter tracks (plus
+# images_per_sec from train_record events)
+TRACE_COUNTER_KEYS = ("hbm_bytes_in_use", "prefetch.queue_depth")
+
+INSTANT_EVENTS = ("anomaly", "crash", "stall", "fatal_signal")
 
 
 def percentile(values, q):
@@ -179,20 +203,113 @@ def health_flags(events, summaries):
                          "near_oom": bool(limit) and peak / limit > 0.9}
     if hbm:
         flags["hbm"] = hbm
+    # sentry anomalies: per-rank counts by kind — a run that tripped the
+    # sentry must never read as healthy in the merged report
+    anomalies = {}
+    for ev in events:
+        if ev["ev"] == "anomaly":
+            rank = int(ev.get("rank", 0))
+            kind = str(ev.get("kind", "?"))
+            anomalies.setdefault(rank, {})
+            anomalies[rank][kind] = anomalies[rank].get(kind, 0) + 1
+    if anomalies:
+        flags["anomalies"] = anomalies
     return flags
 
 
-def build_report(record_dir, window_s=10.0):
-    events = load_events(record_dir)
+def build_trace(events):
+    """Merged per-rank events → Chrome trace-event JSON (Perfetto/
+    chrome://tracing).  Layout: one process per rank (pid = rank) with a
+    ``phases`` thread of span events, counter tracks for HBM/queue-depth
+    (``gauges`` events) and images/sec (``train_record`` events), and
+    instant markers for anomaly/crash/stall/fatal-signal.  Spans are
+    emitted in ts order with non-negative durations — a ``phase`` event
+    is stamped at bracket END, so its span is ``[ts − dt, ts]``, clamped
+    at the capture origin."""
+    ranks = sorted({int(e.get("rank", 0)) for e in events})
+    t0 = min((e["ts"] for e in events if "ts" in e), default=0.0)
+
+    def us(ts):
+        return max(0.0, round((ts - t0) * 1e6, 1))
+
+    meta, body = [], []
+    for r in ranks:
+        meta.append({"ph": "M", "pid": r, "name": "process_name",
+                     "args": {"name": f"rank {r}"}})
+        meta.append({"ph": "M", "pid": r, "name": "process_sort_index",
+                     "args": {"sort_index": r}})
+        meta.append({"ph": "M", "pid": r, "tid": 0, "name": "thread_name",
+                     "args": {"name": "phases"}})
+    for ev in events:
+        kind = ev.get("ev")
+        if kind not in TRACKED_EVENTS or "ts" not in ev:
+            continue
+        rank = int(ev.get("rank", 0))
+        if kind == "phase":
+            dur = max(0.0, float(ev.get("dt", 0.0))) * 1e6
+            end = us(ev["ts"])
+            start = max(0.0, end - dur)
+            body.append({"ph": "X", "pid": rank, "tid": 0,
+                         "ts": round(start, 1),
+                         "dur": round(end - start, 1),
+                         "name": str(ev.get("sec", "?")), "cat": "phase"})
+        elif kind == "gauges":
+            for key in TRACE_COUNTER_KEYS:
+                if key in ev:
+                    body.append({"ph": "C", "pid": rank, "tid": 0,
+                                 "ts": us(ev["ts"]), "name": key,
+                                 "args": {"value": ev[key]}})
+        elif kind == "train_record":
+            if "images_per_sec" in ev:
+                body.append({"ph": "C", "pid": rank, "tid": 0,
+                             "ts": us(ev["ts"]), "name": "images_per_sec",
+                             "args": {"value": round(
+                                 ev["images_per_sec"], 1)}})
+        elif kind == "val_record":
+            if "val_cost" in ev and ev["val_cost"] == ev["val_cost"]:
+                body.append({"ph": "C", "pid": rank, "tid": 0,
+                             "ts": us(ev["ts"]), "name": "val_cost",
+                             "args": {"value": round(ev["val_cost"], 5)}})
+        elif kind == "device_profile":
+            if ev.get("overlap_ratio") is not None:
+                body.append({"ph": "C", "pid": rank, "tid": 0,
+                             "ts": us(ev["ts"]),
+                             "name": "device.overlap_ratio",
+                             "args": {"value": ev["overlap_ratio"]}})
+        elif kind in INSTANT_EVENTS:
+            detail = ev.get("kind") or ev.get("label") or \
+                ev.get("error", "")[:40] or ev.get("signum", "")
+            body.append({"ph": "i", "pid": rank, "tid": 0,
+                         "ts": us(ev["ts"]), "s": "p",
+                         "name": f"{kind}:{detail}" if detail else kind,
+                         "cat": "alert"})
+    body.sort(key=lambda e: e["ts"])
+    return {"displayTimeUnit": "ms", "traceEvents": meta + body}
+
+
+def build_report(record_dir, window_s=10.0, events=None):
+    if events is None:
+        events = load_events(record_dir)
     summaries = load_summaries(record_dir)
     dumps = find_flight_dumps(record_dir)
     runs = sorted({ev.get("run") for ev in events if ev.get("run")})
     ranks = sorted({int(ev.get("rank", 0)) for ev in events})
     crashes = [ev for ev in events if ev["ev"] in ("crash", "stall",
-                                                   "fatal_signal")]
+                                                   "fatal_signal",
+                                                   "anomaly")]
+    # last device-attribution result per rank (worker trace_dir captures,
+    # utils/devprof) — the comm/compute overlap evidence
+    device = {}
+    for ev in events:
+        if ev["ev"] == "device_profile":
+            device[int(ev.get("rank", 0))] = {
+                k: ev.get(k) for k in ("compute_secs", "comm_secs",
+                                       "exposed_comm_secs", "overlap_ratio",
+                                       "lanes", "train_dispatches")}
     return {
         "record_dir": os.path.abspath(record_dir),
         "runs": runs, "ranks": ranks, "events": len(events),
+        "device_profiles": device,
         "phases": phase_breakdown(events),
         "throughput_timeline": throughput_timeline(events),
         "straggler_ranking": straggler_ranking(events, window_s),
@@ -244,11 +361,26 @@ def print_report(rep):
             verdict = " — NEAR OOM" if f["near_oom"] else ""
             print(f"  rank {rank}: peak {f['peak_bytes'] / 2**30:.2f} GiB "
                   f"({share}){verdict}")
+    if rep.get("device_profiles"):
+        print("\ndevice-time attribution (last trace capture per rank):")
+        for rank, d in sorted(rep["device_profiles"].items()):
+            overlap = (f"{d['overlap_ratio']:.1%} overlap"
+                       if d.get("overlap_ratio") is not None
+                       else "no collectives in window")
+            print(f"  rank {rank}: compute {d.get('compute_secs', 0):.3f}s "
+                  f"comm {d.get('comm_secs', 0):.3f}s exposed "
+                  f"{d.get('exposed_comm_secs', 0):.3f}s ({overlap})")
+    an = rep["flags"].get("anomalies")
+    if an:
+        print("\nsentry anomalies:")
+        for rank, kinds in sorted(an.items()):
+            pretty = ", ".join(f"{k}×{n}" for k, n in sorted(kinds.items()))
+            print(f"  rank {rank}: {pretty}")
     if rep["crash_events"]:
-        print("\ncrash/stall events:")
+        print("\ncrash/stall/anomaly events:")
         for ev in rep["crash_events"][-5:]:
             detail = ev.get("error") or ev.get("label") or \
-                ev.get("signum", "")
+                ev.get("kind") or ev.get("signum", "")
             print(f"  rank {ev.get('rank', 0)} {ev['ev']}: {detail}")
     if rep["flight_dumps"]:
         print("\nflight recordings (crash/stall trails):")
@@ -264,11 +396,18 @@ def main(argv=None):
     ap.add_argument("--json", default=None, metavar="OUT",
                     help="also write the machine-readable report here "
                          "('-' for stdout)")
+    ap.add_argument("--trace", default=None, metavar="OUT",
+                    help="also write Chrome trace-event JSON (one track "
+                         "per rank: phase spans, HBM/queue-depth/img-s "
+                         "counter tracks, anomaly markers) — open in "
+                         "Perfetto (ui.perfetto.dev) or chrome://tracing")
     args = ap.parse_args(argv)
     if not os.path.isdir(args.record_dir):
         print(f"no such directory: {args.record_dir}", file=sys.stderr)
         return 2
-    rep = build_report(args.record_dir, args.window)
+    events = load_events(args.record_dir)        # parsed ONCE, shared by
+    rep = build_report(args.record_dir, args.window,  # report and --trace
+                       events=events)
     if not rep["events"]:
         print(f"no telemetry_rank*.jsonl events under {args.record_dir} — "
               "run with record_dir set (telemetry streams there)",
@@ -281,6 +420,13 @@ def main(argv=None):
         with open(args.json, "w") as f:
             json.dump(rep, f, indent=1, sort_keys=True)
         print(f"\nwrote {args.json}")
+    if args.trace:
+        trace = build_trace(events)
+        with open(args.trace, "w") as f:
+            json.dump(trace, f)
+        spans = sum(1 for e in trace["traceEvents"] if e.get("ph") == "X")
+        print(f"\nwrote {args.trace} ({spans} spans across "
+              f"{len(rep['ranks'])} rank track(s)) — open in Perfetto")
     return 0
 
 

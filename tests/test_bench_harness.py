@@ -149,6 +149,25 @@ def test_wrapper_cpu_success_end_to_end():
     assert out["cache"] == "miss" and out["compile_secs"] > 0, out
 
 
+def test_bench_trace_row_carries_overlap_columns():
+    """ISSUE 7 acceptance: BENCH_TRACE=1 captures a profiler window after
+    the timed loop and folds the devprof attribution into the row — the
+    BSP-grads step contains a psum, so the comm/compute breakdown is
+    nonzero and overlap_ratio is a real number in [0, 1]."""
+    rc, out = _run_bench({"BENCH_FORCE_CPU": "1", "BENCH_MODEL": "cifar10",
+                          "BENCH_BATCH": "16", "BENCH_ITERS": "2",
+                          "BENCH_WARMUP": "1", "BENCH_TRACE": "1",
+                          "BENCH_TRACE_ITERS": "2"})
+    assert rc == 0, out
+    assert out["value"] > 0
+    from theanompi_tpu.utils import devprof
+    assert set(devprof.TRACE_ROW_COLUMNS) <= set(out), sorted(out)
+    assert out["device_comm_secs"] > 0 and out["device_compute_secs"] > 0
+    assert 0.0 <= out["overlap_ratio"] <= 1.0
+    assert 0.0 <= out["exposed_comm_secs"] <= out["device_comm_secs"] + 1e-9
+    assert out["device_mfu"] is None          # CPU: no peak-flops table
+
+
 def test_wrapper_timeout_kills_and_reports():
     """A hung measurement dies at BENCH_TIMEOUT as a process group and the
     wrapper still emits structured JSON (no last_good for this config →

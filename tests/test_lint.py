@@ -1352,9 +1352,12 @@ def test_precommit_lint_script_clean_and_failing(tmp_path):
                     repo / "theanompi_tpu" / "analysis")
     shutil.copy(os.path.join(REPO, "theanompi_tpu", "jax_compat.py"),
                 repo / "theanompi_tpu" / "jax_compat.py")
-    # the schema-drift live probe imports these two for real
+    # the schema-drift live probe imports these for real (devprof/sentry
+    # feed the round-12 device-schema probes; the checker skips them
+    # gracefully when a partial tree omits them)
     (repo / "theanompi_tpu" / "utils").mkdir()
-    for m in ("__init__.py", "recorder.py", "telemetry.py"):
+    for m in ("__init__.py", "recorder.py", "telemetry.py", "devprof.py",
+              "sentry.py"):
         shutil.copy(os.path.join(REPO, "theanompi_tpu", "utils", m),
                     repo / "theanompi_tpu" / "utils" / m)
 

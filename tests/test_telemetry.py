@@ -102,6 +102,37 @@ def test_init_resolution_rules(tmp_path):
     assert telemetry.active() is DISABLED
 
 
+def test_aggregate_memory_stats_multi_device():
+    """ISSUE 7 satellite: HBM gauges aggregate across ALL local devices —
+    summed in-use, max peak, min limit, worst-device headroom — so
+    multi-chip pressure can't hide behind device 0."""
+    stats = [
+        {"bytes_in_use": 100, "peak_bytes_in_use": 900, "bytes_limit": 1000},
+        {"bytes_in_use": 300, "peak_bytes_in_use": 400, "bytes_limit": 1000},
+        None,                                    # a device with no stats
+        {"bytes_in_use": 50},                    # partial stats
+    ]
+    vals = telemetry.aggregate_memory_stats(stats)
+    assert vals["hbm_bytes_in_use"] == 450          # summed
+    assert vals["hbm_peak_bytes"] == 900            # max (hottest chip)
+    assert vals["hbm_bytes_limit"] == 1000          # min (binding budget)
+    assert vals["hbm_min_headroom_bytes"] == 100    # worst device: 1000-900
+    assert telemetry.aggregate_memory_stats([None, None]) == {}
+    assert telemetry.aggregate_memory_stats([]) == {}
+
+
+def test_system_snapshot_emits_device_count_and_queue_depth():
+    tm = telemetry.init({"telemetry": True})
+    tm.gauge("prefetch.queue_depth", 3)
+    vals = tm.system_snapshot(iter=7)
+    # the 8-device CPU mesh: count emitted even though CPU has no
+    # memory_stats; the loader's queue-depth gauge is sampled into the
+    # stream (the Perfetto counter track reads it from gauges events)
+    assert vals["device_count"] == 8
+    assert vals["prefetch.queue_depth"] == 3
+    assert vals["iter"] == 7
+
+
 # -- the cost contract ------------------------------------------------------
 
 def test_disabled_registry_is_noop_and_cheap():
@@ -276,6 +307,62 @@ def test_two_worker_run_streams_and_report(tmp_path):
         "prefetch", {}) else rep["flags"]["prefetch"][0]
     assert pf["min_queue_depth"] is not None
     assert rep["throughput_timeline"]
+
+
+def test_worker_sigterm_dumps_flight(tmp_path):
+    """ISSUE 7 satellite — the fatal-signal path of the PR 4 flight
+    recorder, previously only exercised by the stall path: a CLI worker
+    SIGTERM'd mid-run leaves a flight_rank0.jsonl that parses and ends
+    with the fatal_signal event, and the process dies with the honest
+    signal exit."""
+    import signal
+
+    rec = str(tmp_path / "rec")
+    env = dict(os.environ)
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+    proc = subprocess.Popen(
+        [sys.executable, "-u", "-m", "theanompi_tpu.worker",
+         "bsp", "tests.conftest", "TinyModel",
+         "platform=cpu", "epochs=999", "batch_size=8", "n_train=64",
+         "verbose=false", "scale_lr=false", "printFreq=2",
+         f"record_dir={rec}"],
+        cwd=REPO, env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+        text=True)
+    try:
+        # wait until training is demonstrably mid-run: the per-rank stream
+        # carries at least one phase bracket
+        stream = os.path.join(rec, "telemetry_rank0.jsonl")
+        deadline = time.time() + 120
+        seen_phase = False
+        while time.time() < deadline and not seen_phase:
+            if os.path.exists(stream):
+                with open(stream) as f:
+                    seen_phase = any('"ev": "phase"' in ln for ln in f)
+            if proc.poll() is not None:
+                break
+            if not seen_phase:
+                time.sleep(0.25)
+        assert seen_phase, (proc.poll(),
+                            proc.stderr.read()[-2000:] if proc.poll()
+                            is not None else "no phase event within 120s")
+        proc.send_signal(signal.SIGTERM)
+        proc.wait(timeout=60)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+        proc.communicate()
+    # the hook re-raises with the default handler: honest signal exit
+    assert proc.returncode == -signal.SIGTERM
+    flight_path = os.path.join(rec, "flight_rank0.jsonl")
+    assert os.path.exists(flight_path), os.listdir(rec)
+    flight = [json.loads(line) for line in open(flight_path)]  # parses
+    assert flight[0]["ev"] == "flight_dump"
+    assert "signal" in flight[0]["reason"]
+    assert flight[-1]["ev"] == "fatal_signal"
+    assert flight[-1]["signum"] == int(signal.SIGTERM)
+    # the trail shows the run was mid-training when the signal landed
+    assert any(e["ev"] in ("phase", "beat", "train_record")
+               for e in flight[1:])
 
 
 def test_crash_dumps_flight_and_launcher_sweeps(tmp_path):

@@ -8,22 +8,49 @@ vocabulary with ``telemetry.PHASES`` as the ONE source of truth —
 to one but not the others silently drops that phase from records,
 plots, or reports.
 
+Round 12 extends the probe to the device-attribution schema
+(docs/design.md §13): ``devprof.feed_telemetry`` must emit exactly the
+declared ``device.*`` gauge vocabulary (``devprof.DEVICE_GAUGES``), the
+training sentry must emit the ``anomaly`` event with a ``kind`` from
+``sentry.ANOMALY_KINDS``, the bench trace columns must be exactly
+``devprof.TRACE_ROW_COLUMNS`` (what ``profile_row_fields`` emits), and
+``scripts/telemetry_report.py``'s consumed-event vocabulary
+(``TRACKED_EVENTS``) must cover every emitter — so a new emitter can't
+stream events the report and Perfetto export silently drop.
+
 Unlike the AST checkers this is a PROJECT-level probe against LIVE
 objects (a Recorder driven through one print, a Telemetry instance fed
-one bracket per phase), so a hand-rolled record dict drifting from the
-declared list is caught too.  Both modules import without jax
-(``telemetry`` is stdlib-only by contract, ``recorder`` needs numpy),
-so the lint CLI stays backend-free.
+one bracket per phase, a sentry pushed into an anomaly), so a
+hand-rolled record dict drifting from the declared list is caught too.
+All probed modules import without jax (``telemetry``/``devprof``/
+``sentry`` are stdlib-only by contract, ``recorder`` needs numpy), so
+the lint CLI stays backend-free.
 """
 
 from __future__ import annotations
 
+import os
 from typing import List
 
 from ..core import Checker, Finding, register
 
 TELEMETRY_PATH = "theanompi_tpu/utils/telemetry.py"
 RECORDER_PATH = "theanompi_tpu/utils/recorder.py"
+DEVPROF_PATH = "theanompi_tpu/utils/devprof.py"
+SENTRY_PATH = "theanompi_tpu/utils/sentry.py"
+REPORT_PATH = "scripts/telemetry_report.py"
+
+# one lane, one module: a compute span [0,50]us and a comm span [40,60]us
+# → compute 50us, comm 20us, exposed 10us, overlap 0.5 — a COMPLETE
+# profile, so feed_telemetry must emit every declared gauge
+_PROBE_EVENTS = [
+    {"ph": "X", "pid": 1, "tid": 1, "ts": 0.0, "dur": 50.0,
+     "name": "fusion.1",
+     "args": {"hlo_op": "fusion.1", "hlo_module": "jit_step"}},
+    {"ph": "X", "pid": 1, "tid": 1, "ts": 40.0, "dur": 20.0,
+     "name": "all-reduce.1",
+     "args": {"hlo_op": "all-reduce.1", "hlo_module": "jit_step"}},
+]
 
 
 def live_drift_errors(recorder, telemetry) -> List[tuple]:
@@ -79,12 +106,111 @@ def live_drift_errors(recorder, telemetry) -> List[tuple]:
     return errors
 
 
+def device_schema_errors(devprof, sentry, telemetry,
+                         telemetry_report=None) -> List[tuple]:
+    """The round-12 device-attribution probes, parameterized on the live
+    modules.  ``telemetry_report`` may be None (script not in the linted
+    tree — e.g. a restricted pre-commit checkout); its cross-checks are
+    then skipped."""
+    errors: List[tuple] = []
+
+    # 1. feed_telemetry emits EXACTLY the declared device.* gauge set
+    prof = devprof.attribute(_PROBE_EVENTS)
+    tm = telemetry.Telemetry(rank=0, run_id="drift-check")
+    devprof.feed_telemetry(prof, tm)
+    if set(tm.gauges) != set(devprof.DEVICE_GAUGES):
+        errors.append((DEVPROF_PATH,
+                       f"feed_telemetry gauges {sorted(tm.gauges)} != "
+                       f"DEVICE_GAUGES {sorted(devprof.DEVICE_GAUGES)}"))
+    prof_evs = [e for e in tm.tail(4) if e["ev"] == devprof.PROFILE_EVENT]
+    if not prof_evs:
+        errors.append((DEVPROF_PATH,
+                       f"feed_telemetry emitted no "
+                       f"{devprof.PROFILE_EVENT!r} event"))
+    if any(not g.startswith("device.") for g in devprof.DEVICE_GAUGES):
+        errors.append((DEVPROF_PATH,
+                       "DEVICE_GAUGES contains a non-'device.' name"))
+
+    # 2. bench trace columns: profile_row_fields emits exactly the
+    # declared column set (bench.py folds its return verbatim)
+    fields = devprof.profile_row_fields(prof, total_flops=1e9,
+                                        peak_flops=1e12)
+    if set(fields) != set(devprof.TRACE_ROW_COLUMNS):
+        errors.append((DEVPROF_PATH,
+                       f"profile_row_fields keys {sorted(fields)} != "
+                       f"TRACE_ROW_COLUMNS "
+                       f"{sorted(devprof.TRACE_ROW_COLUMNS)}"))
+
+    # 3. the sentry's anomaly event: a live instance pushed into a NaN
+    # must emit ANOMALY_EVENT with a declared kind and an iter field
+    tm2 = telemetry.Telemetry(rank=0, run_id="drift-check")
+    s = sentry.TrainingSentry({"verbose": False, "sentry_min_records": 2},
+                              telemetry=tm2)
+    for i in range(3):
+        s.observe_record({"iter": i, "cost": 1.0, "images_per_sec": 100.0})
+    kind = s.observe_record({"iter": 3, "cost": float("nan"),
+                             "images_per_sec": 100.0})
+    anoms = [e for e in tm2.tail(8) if e["ev"] == sentry.ANOMALY_EVENT]
+    if kind != "nan_loss" or not anoms:
+        errors.append((SENTRY_PATH,
+                       "a NaN cost did not raise a live "
+                       f"{sentry.ANOMALY_EVENT!r} event (got kind "
+                       f"{kind!r})"))
+    else:
+        ev = anoms[-1]
+        if ev.get("kind") not in sentry.ANOMALY_KINDS:
+            errors.append((SENTRY_PATH,
+                           f"anomaly kind {ev.get('kind')!r} not in "
+                           f"ANOMALY_KINDS {sentry.ANOMALY_KINDS}"))
+        if "iter" not in ev:
+            errors.append((SENTRY_PATH,
+                           "anomaly event carries no 'iter' field"))
+
+    # 4. the report/Perfetto converter consumes every emitter's vocabulary
+    if telemetry_report is not None:
+        tracked = set(getattr(telemetry_report, "TRACKED_EVENTS", ()))
+        want = {"phase", "train_record", "gauges",
+                sentry.ANOMALY_EVENT, devprof.PROFILE_EVENT}
+        missing = sorted(want - tracked)
+        if missing:
+            errors.append((REPORT_PATH,
+                           f"TRACKED_EVENTS is missing emitter event "
+                           f"kind(s) {missing} — the report/trace export "
+                           "would silently drop them"))
+    return errors
+
+
+def _load_telemetry_report():
+    """scripts/telemetry_report.py loaded by FILE path (stdlib-only by
+    contract; it is a script, not a package module).  None when absent
+    from the linted tree."""
+    root = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__)))))
+    path = os.path.join(root, "scripts", "telemetry_report.py")
+    if not os.path.exists(path):
+        return None
+    import importlib.util
+    try:
+        spec = importlib.util.spec_from_file_location(
+            "_tpulint_telemetry_report", path)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+    except Exception:
+        # a broken script must not crash the whole lint run — the parse
+        # step flags its syntax error as a normal finding; this probe
+        # just skips its cross-checks
+        return None
+    return mod
+
+
 @register
 class SchemaDriftChecker(Checker):
     name = "schema-drift"
     description = ("recorder.SECTIONS / print_train_info record keys / "
                    "telemetry phase events must derive from telemetry."
-                   "PHASES (live-object probe)")
+                   "PHASES; device.* gauges, sentry anomaly schema, and "
+                   "bench trace columns must match their declared "
+                   "vocabularies (live-object probe)")
     reads_files = False    # `--only schema-drift` skips the repo parse
 
     def check_project(self, files):
@@ -93,5 +219,16 @@ class SchemaDriftChecker(Checker):
         # `theanompi_tpu` parent whose __path__ skips the jax-importing
         # package __init__)
         from theanompi_tpu.utils import recorder, telemetry
+        errors = live_drift_errors(recorder, telemetry)
+        try:
+            # absent from a partial tree (precommit_lint.sh lints staged
+            # blobs — a restricted checkout may omit them): the device
+            # probes are skipped, the phase probes above still ran
+            from theanompi_tpu.utils import devprof, sentry
+        except ImportError:
+            devprof = sentry = None
+        if devprof is not None and sentry is not None:
+            errors += device_schema_errors(devprof, sentry, telemetry,
+                                           _load_telemetry_report())
         return [Finding(self.name, path, 1, 0, msg)
-                for path, msg in live_drift_errors(recorder, telemetry)]
+                for path, msg in errors]

@@ -57,7 +57,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from . import steps
 from ..jax_compat import shard_map
-from ..utils import telemetry
+from ..utils import devprof, telemetry
 from .mesh import WORKER_AXIS
 from .strategies import Strategy, get_strategy
 
@@ -236,8 +236,13 @@ class Exchanger:
         if recorder:
             recorder.start()
         t0 = time.time() if tm.enabled else 0.0
-        self.model.step_state = self._exchange_fn(
-            self.model.step_state, self.model.next_exchange_key(), count)
+        # devprof dispatch anchor: a profiler capture sees one named span
+        # per standalone exchange dispatch, so trace attribution can count
+        # exchanges without guessing from collective-op repetitions (a
+        # TraceMe no-op while no capture is active)
+        with jax.profiler.TraceAnnotation(devprof.EXCHANGE_SPAN):
+            self.model.step_state = self._exchange_fn(
+                self.model.step_state, self.model.next_exchange_key(), count)
         if tm.enabled:
             # PER-EXCHANGE histograms, not bare sums: host dispatch cost
             # here; the device-side comm time lands via recorder.end('comm')

@@ -143,6 +143,51 @@ def donated_load_safe(mesh=None) -> bool:
     return getattr(_mesh_device(mesh), "platform", "") == "tpu"
 
 
+def program_summary(compiled) -> dict:
+    """Best-effort cost/memory summary of one compiled executable for the
+    manifest (``scripts/explain_program.py`` reads it): XLA
+    ``cost_analysis`` (flops, bytes accessed) + ``memory_analysis``
+    (argument/output/temp/code bytes, and their sum as the HBM-peak
+    estimate).  A cache hit skips the recompute — the summary was taken at
+    write time, when the fresh executable was in hand.  Every probe is
+    fenced: a backend that reports nothing (or nonsense like -1) yields a
+    smaller dict, never an error."""
+    out: Dict[str, float] = {}
+    try:
+        ca = compiled.cost_analysis()
+        ca = ca[0] if isinstance(ca, (list, tuple)) else ca
+        if isinstance(ca, dict):
+            for src, dst in (("flops", "flops"),
+                             ("bytes accessed", "bytes_accessed"),
+                             ("transcendentals", "transcendentals")):
+                v = ca.get(src)
+                if v is not None and float(v) > 0:
+                    out[dst] = float(v)
+    except Exception:
+        pass
+    try:
+        ma = compiled.memory_analysis()
+        for attr, dst in (("argument_size_in_bytes", "argument_bytes"),
+                          ("output_size_in_bytes", "output_bytes"),
+                          ("temp_size_in_bytes", "temp_bytes"),
+                          ("alias_size_in_bytes", "alias_bytes"),
+                          ("generated_code_size_in_bytes",
+                           "generated_code_bytes")):
+            v = getattr(ma, attr, None)
+            if v is not None and int(v) >= 0:
+                out[dst] = int(v)
+        if {"argument_bytes", "output_bytes", "temp_bytes"} <= out.keys():
+            # aliased (donated) buffers are counted inside argument_bytes
+            # and reused for output — subtract so donation shows up as the
+            # memory win it is
+            out["peak_hbm_bytes_est"] = (
+                out["argument_bytes"] + out["output_bytes"]
+                + out["temp_bytes"] - out.get("alias_bytes", 0))
+    except Exception:
+        pass
+    return out
+
+
 def key_extra(fn: str, model=None, exchanger=None,
               spc: Optional[int] = None) -> dict:
     """The caller-extras dict EVERY compile surface must build the same way
@@ -343,7 +388,7 @@ class CompileCache:
             self._write_entry(key, label, payload, in_tree, out_tree,
                               _mesh_device(mesh))
             self._record_manifest(key, label, compile_secs, len(payload),
-                                  mesh)
+                                  mesh, compiled=compiled)
             info["serialized"] = True
         except Exception as e:
             # rung 4: the backend (or this program shape) can't serialize —
@@ -377,7 +422,8 @@ class CompileCache:
         except OSError:
             pass                              # metadata only — never fatal
 
-    def _record_manifest(self, key, label, compile_secs, nbytes, mesh):
+    def _record_manifest(self, key, label, compile_secs, nbytes, mesh,
+                         compiled=None):
         jax_v, jaxlib_v = _versions()
         dev = _mesh_device(mesh)
         m = self._load_manifest()
@@ -386,6 +432,13 @@ class CompileCache:
                   "platform": getattr(dev, "platform", "?"),
                   "device_kind": getattr(dev, "device_kind", "?"),
                   "created": time.time(), "hits": 0}
+        if compiled is not None:
+            # cost/memory summary taken at write time, so a later cache
+            # HIT still tells you what you're running (flops, bytes, HBM
+            # estimate) — scripts/explain_program.py prints and diffs it
+            cost = program_summary(compiled)
+            if cost:
+                m[key]["cost"] = cost
         self._save_manifest(m)
 
     def _bump_manifest(self, key, label):

@@ -1,0 +1,444 @@
+"""Device-time attribution from ``jax.profiler`` trace captures.
+
+PR 4's ``phase.comm`` histograms time the HOST-side dispatch bracket: the
+worker blocks until the exchange collective's result is ready and charges
+the wall time to ``comm``.  That accounting goes blind the moment
+collectives become async start/done pairs overlapped with backprop
+(ROADMAP item 1): the host bracket then measures a queue push, and the
+question that actually governs scaling — how much collective time is
+*exposed* (serialized against compute) versus *hidden* (overlapped under
+it) — is only answerable from the device timeline the XLA profiler
+records.  The CUDA-aware-MPI characterization (PAPERS.md, 1810.11112)
+makes the same point for GPU clusters: overlap of reduction with
+backprop, not raw bandwidth, is the scaling variable.
+
+This module is the ONE trace-proto reader in the repo (the glob/gzip/json
+walk ``scripts/profile_model.py`` used to do inline, promoted and
+tested).  ``jax.profiler.stop_trace`` writes
+``<dir>/plugins/profile/<session>/<host>.trace.json.gz`` — gzipped
+Chrome trace-event JSON where every executed HLO op is a complete
+(``"ph": "X"``) event carrying ``args.hlo_op`` / ``args.hlo_module``.
+That marker is the discriminator: host-side Python/runtime spans have no
+``hlo_op``, so the parse needs no tensorboard plugin and stays stdlib.
+
+**Attribution model.**  Op events are grouped into *lanes* (one
+``(pid, tid)`` pair — a device plane's op line on TPU, one per-device
+executor thread on the CPU sim).  Per lane the comm-op intervals and
+compute-op intervals are union-merged, and
+
+* ``comm_secs``      = Σ lanes measure(comm ∪)
+* ``compute_secs``   = Σ lanes measure(compute ∪)
+* ``exposed_comm_secs`` = Σ lanes [measure(comm ∪) −
+  measure(comm ∪ ∩ compute ∪)] — collective time with NO compute running
+  on the same lane, i.e. the serialized tail the step actually pays
+* ``overlap_ratio``  = 1 − exposed_comm / comm  (None when no comm)
+
+Comm ops are matched by HLO opcode prefix (``all-reduce``,
+``all-gather``, ``reduce-scatter``, ``all-to-all``,
+``collective-permute``, ... including their async ``-start``/``-done``
+forms, whose ``-done`` wait IS the exposed time under XLA's
+latency-hiding scheduler).
+
+Host dispatch anchors: the worker loop and the standalone exchange tag
+their dispatches with ``jax.profiler.TraceAnnotation`` spans named
+:data:`TRAIN_DISPATCH_SPAN` / :data:`EXCHANGE_SPAN`; the parser counts
+them so per-dispatch means don't depend on guessing the iteration count
+from op repetitions.
+
+Consumers: the worker's ``trace_dir`` capture feeds the result into the
+PR 4 telemetry registry as ``device.*`` gauges (:func:`feed_telemetry` —
+names pinned by the tpulint schema-drift checker), ``bench.py``'s
+``BENCH_TRACE=1`` folds :data:`TRACE_ROW_COLUMNS` into the row JSON, and
+``scripts/profile_model.py`` prints the same breakdown interactively.
+
+No jax at module scope (the lint CLI and stdlib scripts import this for
+the schema constants); :func:`capture` imports it lazily.
+"""
+
+from __future__ import annotations
+
+import glob
+import gzip
+import json
+import math
+import os
+import re
+from typing import Any, Dict, Iterable, List, Optional, Tuple
+
+# Dispatch-anchor span names (host-side jax.profiler.TraceAnnotation):
+# worker.py wraps each train_iter dispatch, exchanger.exchange wraps the
+# standalone collective dispatch.  Constant strings — the parser matches
+# them exactly.
+TRAIN_DISPATCH_SPAN = "theanompi.train_dispatch"
+EXCHANGE_SPAN = "theanompi.exchange"
+
+# The device.* gauge vocabulary feed_telemetry emits — ONE list, guarded
+# by the tpulint schema-drift checker so emitters and report consumers
+# cannot desync (docs/design.md §13).
+DEVICE_GAUGES = (
+    "device.compute_secs",
+    "device.comm_secs",
+    "device.exposed_comm_secs",
+    "device.overlap_ratio",
+    "device.lanes",
+)
+PROFILE_EVENT = "device_profile"
+
+# The bench-row columns BENCH_TRACE=1 adds (profile_row_fields emits
+# exactly these keys; scripts/merge_matrix.py treats them — like any
+# column — as unknown when absent, never as a regression).
+TRACE_ROW_COLUMNS = (
+    "overlap_ratio",
+    "exposed_comm_secs",
+    "device_compute_secs",
+    "device_comm_secs",
+    "device_mfu",
+)
+
+# HLO opcodes whose device time is collective/communication time.  Async
+# pairs (`<op>-start` / `<op>-done`) share the prefix and match too.
+COMM_OP_PREFIXES = (
+    "all-reduce",
+    "all-gather",
+    "all-to-all",
+    "reduce-scatter",
+    "collective-permute",
+    "collective-broadcast",
+    "ragged-all-to-all",
+    "send",
+    "recv",
+)
+
+_SUFFIX_RE = re.compile(r"\.\d+$")
+
+
+def op_class(name: str) -> str:
+    """HLO instruction name → op class: strip the unique ``.N`` suffix
+    (``all-reduce.1`` → ``all-reduce``), keep fusion/async qualifiers —
+    they distinguish genuinely different kinds of device time."""
+    return _SUFFIX_RE.sub("", str(name))
+
+
+def is_comm_op(name: str) -> bool:
+    """Whether one HLO op name is collective/communication time."""
+    return op_class(name).startswith(COMM_OP_PREFIXES)
+
+
+# -- trace file discovery / loading ----------------------------------------
+
+
+def find_trace_files(trace_dir: str) -> List[str]:
+    """The ``*.trace.json.gz`` files of the NEWEST capture session under
+    ``trace_dir`` (jax writes ``plugins/profile/<timestamp>/`` per
+    ``stop_trace``; one file per host)."""
+    sessions = sorted(
+        d for d in glob.glob(os.path.join(trace_dir, "plugins", "profile", "*"))
+        if os.path.isdir(d))
+    if not sessions:
+        return []
+    newest = max(sessions, key=os.path.getmtime)
+    return sorted(glob.glob(os.path.join(newest, "*.trace.json.gz")))
+
+
+def load_trace_events(path: str) -> List[dict]:
+    """All trace events from one gzipped Chrome-trace file."""
+    with gzip.open(path, "rt") as f:
+        data = json.load(f)
+    evs = data.get("traceEvents", []) if isinstance(data, dict) else []
+    return [e for e in evs if isinstance(e, dict)]
+
+
+# -- interval algebra -------------------------------------------------------
+
+
+def _union(intervals: List[Tuple[float, float]]) -> List[Tuple[float, float]]:
+    """Merge possibly-overlapping/nested (start, end) intervals."""
+    if not intervals:
+        return []
+    out: List[Tuple[float, float]] = []
+    for s, e in sorted(intervals):
+        if out and s <= out[-1][1]:
+            if e > out[-1][1]:
+                out[-1] = (out[-1][0], e)
+        else:
+            out.append((s, e))
+    return out
+
+
+def _measure(union: List[Tuple[float, float]]) -> float:
+    return sum(e - s for s, e in union)
+
+
+def _intersection_measure(a: List[Tuple[float, float]],
+                          b: List[Tuple[float, float]]) -> float:
+    """Total overlap between two already-merged interval lists."""
+    i = j = 0
+    total = 0.0
+    while i < len(a) and j < len(b):
+        s = max(a[i][0], b[j][0])
+        e = min(a[i][1], b[j][1])
+        if e > s:
+            total += e - s
+        if a[i][1] <= b[j][1]:
+            i += 1
+        else:
+            j += 1
+    return total
+
+
+# -- attribution ------------------------------------------------------------
+
+
+def attribute(events: Iterable[dict]) -> Dict[str, Any]:
+    """Per-dispatch device-time breakdown from raw trace events.
+
+    Returns a plain JSON-able dict: ``compute_secs`` / ``comm_secs`` /
+    ``exposed_comm_secs`` / ``overlap_ratio`` / ``lanes`` totals, the
+    per-``hlo_module`` breakdown, the top op classes by device time, and
+    the host dispatch-anchor counts (``train_dispatches`` /
+    ``exchange_dispatches``)."""
+    # lane = (pid, tid); per lane the comm/compute interval lists (us)
+    comm_iv: Dict[Tuple, List[Tuple[float, float]]] = {}
+    comp_iv: Dict[Tuple, List[Tuple[float, float]]] = {}
+    # module -> ("comm"|"compute") -> lane -> intervals: the per-module
+    # breakdown keeps the lane split so device A's compute can't masquerade
+    # as overlap for device B's collective
+    per_module: Dict[str, Dict[str, Dict[Tuple, List]]] = {}
+    op_totals: Dict[str, List[float]] = {}            # class -> [us, count]
+    train_dispatches = 0
+    exchange_dispatches = 0
+    n_op_events = 0
+    for ev in events:
+        if ev.get("ph") != "X":
+            continue
+        name = ev.get("name", "")
+        if name == TRAIN_DISPATCH_SPAN:
+            train_dispatches += 1
+            continue
+        if name == EXCHANGE_SPAN:
+            exchange_dispatches += 1
+            continue
+        args = ev.get("args")
+        if not isinstance(args, dict) or "hlo_op" not in args:
+            continue                       # host python/runtime span
+        try:
+            ts = float(ev.get("ts", 0.0))
+            dur = float(ev.get("dur", 0.0))
+        except (TypeError, ValueError):
+            continue
+        if dur < 0:
+            continue
+        n_op_events += 1
+        # _src disambiguates per-host capture files merged by profile_dir:
+        # two hosts' device planes reuse the same small pid/tid integers,
+        # and merging them into one lane would let host A's compute mask
+        # host B's collective as overlap
+        lane = (ev.get("_src"), ev.get("pid"), ev.get("tid"))
+        iv = (ts, ts + dur)
+        comm = is_comm_op(name)
+        (comm_iv if comm else comp_iv).setdefault(lane, []).append(iv)
+        cls = op_class(name)
+        tot = op_totals.setdefault(cls, [0.0, 0])
+        tot[0] += dur
+        tot[1] += 1
+        mod = str(args.get("hlo_module", "?"))
+        m = per_module.setdefault(mod, {"comm": {}, "compute": {}})
+        m["comm" if comm else "compute"].setdefault(lane, []).append(iv)
+
+    def _breakdown(comm_by_lane, comp_by_lane):
+        comm_us = comp_us = exposed_us = 0.0
+        for lane in set(comm_by_lane) | set(comp_by_lane):
+            cu = _union(comm_by_lane.get(lane, []))
+            pu = _union(comp_by_lane.get(lane, []))
+            c = _measure(cu)
+            comm_us += c
+            comp_us += _measure(pu)
+            exposed_us += c - _intersection_measure(cu, pu)
+        return comm_us, comp_us, exposed_us
+
+    comm_us, comp_us, exposed_us = _breakdown(comm_iv, comp_iv)
+    modules: Dict[str, dict] = {}
+    for mod, m in per_module.items():
+        mc, mp, mx = _breakdown(m["comm"], m["compute"])
+        modules[mod] = {
+            "comm_secs": round(mc / 1e6, 6),
+            "compute_secs": round(mp / 1e6, 6),
+            "exposed_comm_secs": round(mx / 1e6, 6),
+        }
+    top_ops = sorted(
+        ({"op": cls, "secs": round(us / 1e6, 6), "count": n,
+          "comm": is_comm_op(cls)}
+         for cls, (us, n) in op_totals.items()),
+        key=lambda r: -r["secs"])[:15]
+    comm_secs = comm_us / 1e6
+    exposed = exposed_us / 1e6
+    return {
+        "compute_secs": round(comp_us / 1e6, 6),
+        "comm_secs": round(comm_secs, 6),
+        "exposed_comm_secs": round(exposed, 6),
+        "overlap_ratio": (round(1.0 - exposed / comm_secs, 4)
+                          if comm_secs > 0 else None),
+        "lanes": len(set(comm_iv) | set(comp_iv)),
+        # lanes that actually carry compute — the denominator for
+        # per-device compute-busy time (a dedicated async collective
+        # stream is a lane, but averaging compute over it would halve it)
+        "compute_lanes": len(comp_iv),
+        "n_op_events": n_op_events,
+        "train_dispatches": train_dispatches,
+        "exchange_dispatches": exchange_dispatches,
+        "modules": modules,
+        "top_ops": top_ops,
+    }
+
+
+def profile_dir(trace_dir: str) -> Optional[Dict[str, Any]]:
+    """Parse the newest capture session under ``trace_dir`` into one
+    attribution dict (events merged across per-host files).  None when no
+    capture is found."""
+    paths = find_trace_files(trace_dir)
+    if not paths:
+        return None
+    events: List[dict] = []
+    for src, p in enumerate(paths):
+        try:
+            file_events = load_trace_events(p)
+        except (OSError, ValueError):
+            continue          # a truncated capture file is not fatal
+        for ev in file_events:
+            ev["_src"] = src  # lane disambiguator (see attribute())
+        events.extend(file_events)
+    if not events:
+        return None
+    prof = attribute(events)
+    prof["trace_files"] = [os.path.basename(p) for p in paths]
+    return prof
+
+
+# -- programmatic capture ---------------------------------------------------
+
+
+class _Capture:
+    """Result holder for :func:`capture` — ``.profile`` is populated when
+    the context exits (None if the backend emitted no usable trace)."""
+
+    def __init__(self, trace_dir: str):
+        self.trace_dir = trace_dir
+        self.profile: Optional[Dict[str, Any]] = None
+
+
+class capture:
+    """Context manager driving one programmatic profiler window::
+
+        with devprof.capture("/tmp/trace") as cap:
+            for i in range(3):
+                step(i)
+            jax.block_until_ready(state)     # caller drains BEFORE exit
+        cap.profile["overlap_ratio"]
+
+    The caller must block on the traced work before the context exits —
+    ``stop_trace`` only sees spans that have already executed."""
+
+    def __init__(self, trace_dir: Optional[str] = None):
+        self._own_dir = trace_dir is None
+        if trace_dir is None:
+            import tempfile
+            trace_dir = tempfile.mkdtemp(prefix="devprof_")
+        self._cap = _Capture(trace_dir)
+
+    def __enter__(self) -> _Capture:
+        import jax
+        jax.profiler.start_trace(self._cap.trace_dir)
+        return self._cap
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        import jax
+        jax.profiler.stop_trace()
+        if exc_type is None:
+            try:
+                self._cap.profile = profile_dir(self._cap.trace_dir)
+            except Exception:
+                self._cap.profile = None    # attribution must never raise
+                                            # into the training loop
+        if self._own_dir:
+            # anonymous capture: the caller only wants the attribution, so
+            # the multi-MB .trace.json.gz files must not accumulate under
+            # /tmp across bench rows (pass trace_dir to keep the raw
+            # capture for Perfetto)
+            import shutil
+            shutil.rmtree(self._cap.trace_dir, ignore_errors=True)
+
+
+# -- consumers --------------------------------------------------------------
+
+
+def feed_telemetry(profile: Dict[str, Any], tm=None) -> None:
+    """Record one attribution result into the PR 4 registry: the
+    :data:`DEVICE_GAUGES` gauges plus one :data:`PROFILE_EVENT` stream
+    event (scalars + top 3 op classes — bounded, JSONL-friendly).  The
+    schema-drift checker drives this live and pins the gauge set."""
+    if tm is None:
+        from . import telemetry
+        tm = telemetry.active()
+    if not tm.enabled:
+        return
+    for gname, key in zip(DEVICE_GAUGES,
+                          ("compute_secs", "comm_secs", "exposed_comm_secs",
+                           "overlap_ratio", "lanes")):
+        v = profile.get(key)
+        if v is not None:
+            tm.gauge(gname, float(v))
+    tm.event(PROFILE_EVENT,
+             compute_secs=profile.get("compute_secs"),
+             comm_secs=profile.get("comm_secs"),
+             exposed_comm_secs=profile.get("exposed_comm_secs"),
+             overlap_ratio=profile.get("overlap_ratio"),
+             lanes=profile.get("lanes"),
+             train_dispatches=profile.get("train_dispatches"),
+             top_ops=[o["op"] for o in profile.get("top_ops", [])[:3]])
+
+
+def profile_row_fields(profile: Dict[str, Any],
+                       total_flops: Optional[float] = None,
+                       peak_flops: Optional[float] = None) -> Dict[str, Any]:
+    """The bench-row columns (:data:`TRACE_ROW_COLUMNS`, all keys always
+    present).  ``device_mfu`` is the trace-derived cross-check of the
+    ``cost_analysis`` MFU column: ``total_flops`` (per-device flops over
+    the WHOLE traced window) against one lane's compute-busy time —
+    None when flops/peak are unknown or the trace saw no compute."""
+    lanes = profile.get("compute_lanes") or profile.get("lanes") or 0
+    compute = profile.get("compute_secs") or 0.0
+    mfu = None
+    if total_flops and peak_flops and lanes and compute > 0:
+        per_lane_secs = compute / lanes
+        mfu = round(float(total_flops) / per_lane_secs / float(peak_flops), 4)
+        if not math.isfinite(mfu):
+            mfu = None
+    return {
+        "overlap_ratio": profile.get("overlap_ratio"),
+        "exposed_comm_secs": profile.get("exposed_comm_secs"),
+        "device_compute_secs": profile.get("compute_secs"),
+        "device_comm_secs": profile.get("comm_secs"),
+        "device_mfu": mfu,
+    }
+
+
+def format_profile(profile: Dict[str, Any], top: int = 15) -> str:
+    """Human-readable breakdown (profile_model.py / worker verbose)."""
+    lines = [
+        f"device time: compute {profile['compute_secs']:.4f}s  "
+        f"comm {profile['comm_secs']:.4f}s  "
+        f"exposed comm {profile['exposed_comm_secs']:.4f}s  "
+        + (f"overlap {profile['overlap_ratio']:.1%}"
+           if profile.get("overlap_ratio") is not None else "overlap n/a")
+        + f"  ({profile['lanes']} lane(s), "
+          f"{profile['n_op_events']} op events, "
+          f"{profile['train_dispatches']} train dispatch(es))"]
+    if profile.get("top_ops"):
+        lines.append("top op classes by device time:")
+        total = sum(o["secs"] for o in profile["top_ops"]) or 1.0
+        for o in profile["top_ops"][:top]:
+            tag = " [comm]" if o["comm"] else ""
+            lines.append(f"  {o['secs'] * 1e3:9.2f} ms  "
+                         f"{100 * o['secs'] / total:5.1f}%  x{o['count']:<5d} "
+                         f"{o['op'][:90]}{tag}")
+    return "\n".join(lines)

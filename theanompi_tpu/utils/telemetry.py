@@ -77,6 +77,40 @@ def host_rss_bytes() -> Optional[int]:
             return None
 
 
+def aggregate_memory_stats(stats: List[Optional[dict]]) -> Dict[str, int]:
+    """Fold per-device ``memory_stats()`` dicts into the HBM gauge set.
+
+    Device 0 alone hides single-host multi-chip pressure (one hot chip can
+    OOM while device 0 reports headroom), so the aggregation is
+    worst-case-oriented: bytes-in-use SUMS across devices (total HBM
+    footprint), peak takes the MAX (the chip closest to OOM), the limit
+    takes the per-device MIN (the binding budget — limits are uniform on
+    real hardware, and when they aren't, the smallest one is the wall),
+    and ``hbm_min_headroom_bytes`` is the worst single device's
+    ``limit − peak``.  Devices reporting no stats (CPU sim) are skipped;
+    empty input → empty dict (host gauges still emit)."""
+    out: Dict[str, int] = {}
+    ms = [m for m in stats if m]
+    if not ms:
+        return out
+    in_use = [int(m["bytes_in_use"]) for m in ms if "bytes_in_use" in m]
+    if in_use:
+        out["hbm_bytes_in_use"] = sum(in_use)
+    peaks = [int(m["peak_bytes_in_use"]) for m in ms
+             if "peak_bytes_in_use" in m]
+    if peaks:
+        out["hbm_peak_bytes"] = max(peaks)
+    limits = [int(m["bytes_limit"]) for m in ms if "bytes_limit" in m]
+    if limits:
+        out["hbm_bytes_limit"] = min(limits)
+    headrooms = [int(m["bytes_limit"]) - int(m["peak_bytes_in_use"])
+                 for m in ms
+                 if "bytes_limit" in m and "peak_bytes_in_use" in m]
+    if headrooms:
+        out["hbm_min_headroom_bytes"] = min(headrooms)
+    return out
+
+
 class Histogram:
     """Bounded-reservoir histogram with exact count/sum/min/max.
 
@@ -213,23 +247,30 @@ class Telemetry:
     # -- gauge snapshots ----------------------------------------------------
 
     def system_snapshot(self, **extra) -> dict:
-        """Device memory (``memory_stats()``: bytes-in-use / peak / limit),
-        host RSS, and caller extras (iteration rate, count) — recorded as
+        """Device memory aggregated over ALL local devices
+        (:func:`aggregate_memory_stats`: summed bytes-in-use, max peak,
+        min limit, worst-device headroom, plus ``device_count``), host
+        RSS, the current prefetch queue depth (when the loader exports
+        it), and caller extras (iteration rate, count) — recorded as
         gauges AND streamed as one ``gauges`` event."""
         vals = dict(extra)
         try:
             import jax
-            ms = jax.local_devices()[0].memory_stats() or {}
-            for src, dst in (("bytes_in_use", "hbm_bytes_in_use"),
-                             ("peak_bytes_in_use", "hbm_peak_bytes"),
-                             ("bytes_limit", "hbm_bytes_limit")):
-                if src in ms:
-                    vals[dst] = int(ms[src])
+            devs = jax.local_devices()
+            vals["device_count"] = len(devs)
+            vals.update(aggregate_memory_stats(
+                [d.memory_stats() for d in devs]))
         except Exception:
             pass                # CPU sims often have no memory_stats
         rss = host_rss_bytes()
         if rss:
             vals["host_rss_bytes"] = rss
+        qd = self.gauges.get("prefetch.queue_depth")
+        if qd is not None:
+            # sampled into the stream here (the loader only sets the gauge
+            # on its hot path) — telemetry_report's Perfetto export draws
+            # its queue-depth counter track from these events
+            vals["prefetch.queue_depth"] = qd
         for k, v in vals.items():
             if isinstance(v, (int, float)):
                 self.gauge(k, v)

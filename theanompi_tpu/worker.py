@@ -20,9 +20,17 @@ from typing import Optional
 
 from .base import MeshProcess
 from .parallel.exchanger import get_exchanger
-from .utils import telemetry
+from .utils import devprof, telemetry
 from .utils.recorder import Recorder
+from .utils.sentry import TrainingSentry
 from .utils.watchdog import StallWatchdog
+
+
+def _jax_profiler():
+    """Lazy jax.profiler handle (module-cached import — a dict hit per
+    call, no backend work)."""
+    import jax
+    return jax.profiler
 
 
 class Worker(MeshProcess):
@@ -98,6 +106,26 @@ class Worker(MeshProcess):
             trace_stop_at = None
             if self.verbose:
                 print(f"profiler trace saved to {trace_dir}", flush=True)
+            # device-time attribution (utils/devprof): parse the capture
+            # into compute/comm/exposed-comm/overlap and feed the device.*
+            # gauges — the host-side phase.comm bracket goes blind once
+            # collectives overlap backprop; this is the honest breakdown
+            try:
+                prof = devprof.profile_dir(trace_dir)
+            except Exception as e:
+                prof = None
+                print(f"devprof: trace attribution failed ({e!r})",
+                      flush=True)
+            if prof is not None:
+                if telem.enabled:
+                    devprof.feed_telemetry(prof, telem)
+                if self.verbose:
+                    print(devprof.format_profile(prof, top=5), flush=True)
+            if sentry is not None:
+                # the block_until_ready + trace parse above is dead wall
+                # time inside the next record's images/sec window — same
+                # discontinuity as the val/ckpt boundary
+                sentry.notice_discontinuity()
 
         t0 = time.time()
         # count strides by spc; leftover batches < spc roll to the next
@@ -121,6 +149,16 @@ class Worker(MeshProcess):
             f"(diagnostic dump only) or 'exit' (kill for supervisor restart)")
 
         telem = self.telemetry
+        # training sentry (utils/sentry): NaN/inf + loss-spike + rolling
+        # throughput-regression detection over the print-cadence records —
+        # anomaly events + a flight dump instead of a silently sick run.
+        # Costs nothing per step (it only sees what print_train_info
+        # already materialized); on whenever telemetry is, sentry=false
+        # opts out.
+        sentry = None
+        if telem.enabled and config.get("sentry", True):
+            sentry = TrainingSentry(config, telem)
+        self.sentry = sentry
 
         def on_stall(elapsed, label):
             StallWatchdog._default_handler(watchdog, elapsed, label)
@@ -164,7 +202,13 @@ class Worker(MeshProcess):
                             # to whole windows instead
                             trace_stop_at = count + max(
                                 1, (trace_iters + spc - 1) // spc) * spc
-                        model.train_iter(count, self.recorder)
+                        # dispatch anchor: a devprof capture counts these
+                        # spans so per-dispatch attribution never guesses
+                        # the iteration count from op repetitions (a
+                        # TraceMe no-op while no profiler is active)
+                        with _jax_profiler().TraceAnnotation(
+                                devprof.TRAIN_DISPATCH_SPAN):
+                            model.train_iter(count, self.recorder)
                         if not fused:
                             self.exchanger.exchange(self.recorder, count)
                         watchdog.beat(f"epoch {epoch} iter {count}")
@@ -180,6 +224,8 @@ class Worker(MeshProcess):
                             telem.system_snapshot(
                                 iter=count, epoch=epoch,
                                 images_per_sec=rec["images_per_sec"])
+                        if rec and sentry is not None:
+                            sentry.observe_record(rec)
 
                     model.begin_val()
                     for _ in range(model.data.n_batch_val):
@@ -193,6 +239,11 @@ class Worker(MeshProcess):
                     if config.get("record_dir"):
                         self.recorder.save(config["record_dir"])
                     watchdog.beat(f"epoch {epoch} end (ckpt/records saved)")
+                    if sentry is not None:
+                        # the next print record's images/sec spans this
+                        # val pass + ckpt + shuffle wall time — not a
+                        # throughput regression
+                        sentry.notice_discontinuity()
         except BaseException as e:
             # crash: leave the flight-recorder trail (last N events — beats,
             # phase brackets, gauges) next to the records, then re-raise;
