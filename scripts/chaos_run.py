@@ -1,0 +1,219 @@
+#!/usr/bin/env python
+"""Chaos-mode training run: inject faults, verify the elastic reactions.
+
+The two-command recipe (README):
+
+    # elastic EASGD: 3 workers, SIGKILL worker 2 at t=20s — the run
+    # absorbs the death (leave → backoff respawn → rejoin-from-center)
+    python scripts/chaos_run.py --rule easgd --workers 3 --steps 120 \\
+        --faults kill@20:2 --record-dir /tmp/chaos
+
+    # then read the churn story (membership markers in the report/trace)
+    python scripts/telemetry_report.py /tmp/chaos --trace /tmp/chaos.json
+
+Modes (the rule reaction matrix, docs/design.md §14):
+
+* ``--rule easgd|asgd`` — elastic membership: island workers around a
+  center server under ``parallel/membership.py``'s supervisor.  Faults
+  hit worker subprocesses; the run completes WITHOUT a world restart.
+* ``--rule bsp`` — supervised world restart: ``launcher --supervise``
+  under chaos; a SIGKILLed worker resumes from the last committed window
+  cursor via the crash-atomic checkpoint.
+
+Faults come from ``--faults`` (explicit ``kind@sec:worker[:dur]`` list)
+or ``--seed``/``--n-faults`` (reproducible random draws over the non-zero
+workers).  After the run the merged telemetry stream is audited: every
+applied kill fault must have a matching ``worker_leave`` AND a
+``worker_join`` rejoin (elastic mode); ``--verify-loss`` additionally
+evaluates the final center on the model's validation set and gates on
+``--loss-threshold`` — convergence-to-accuracy under injected faults,
+the chaos acceptance gate.
+"""
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def eval_center_loss(modelfile, modelclass, config, center_npz):
+    """Validation cost of the persisted final center params — loads the
+    model in-process, replaces its replicas with the center, runs the val
+    loop.  The convergence half of the chaos gate."""
+    import importlib
+
+    import jax
+    import numpy as np
+
+    from theanompi_tpu.parallel import steps
+    from theanompi_tpu.parallel.exchanger import Exchanger
+    from theanompi_tpu.parallel.mesh import WORKER_AXIS
+    from theanompi_tpu.utils.recorder import Recorder
+
+    cfg = dict(config)
+    cfg.setdefault("verbose", False)
+    cls = getattr(importlib.import_module(modelfile), modelclass)
+    model = cls(cfg)
+    model.compile_iter_fns(Exchanger(cfg))
+    with np.load(center_npz) as z:
+        leaves = [z[f"leaf{i}"] for i in range(len(z.files))]
+    params = jax.tree.unflatten(jax.tree.structure(model.params), leaves)
+    params = jax.tree.map(lambda x, like: np.asarray(x, like.dtype),
+                          params, jax.tree.map(np.asarray, model.params))
+    n = model.mesh.shape[WORKER_AXIS]
+    sp = model._state_specs
+    model.step_state["params"] = steps.replicate_tree(
+        params, n, model.mesh, None if sp is None else sp["params"])
+    rec = Recorder({"verbose": False})
+    model.begin_val()
+    for _ in range(model.data.n_batch_val):
+        model.val_iter(0, rec)
+    model.end_val()
+    return rec.print_val_info(0)["val_cost"]
+
+
+def audit_membership(record_dir, kill_targets):
+    """Match telemetry membership transitions against the injected kills:
+    every killed worker needs a crash/wedge ``worker_leave`` and a respawn
+    ``worker_join``.  Returns (ok, transitions)."""
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    import telemetry_report as tr
+    events = tr.load_events(record_dir)
+    trans = [e for e in events
+             if e["ev"] in ("worker_join", "worker_leave", "worker_demote",
+                            "fault_injected")]
+    ok = True
+    for w in sorted(set(kill_targets)):
+        leaves = [e for e in trans if e["ev"] == "worker_leave"
+                  and e.get("worker") == w
+                  and e.get("reason") in ("crashed", "wedged",
+                                          "lease_expired")]
+        joins = [e for e in trans if e["ev"] == "worker_join"
+                 and e.get("worker") == w and e.get("rejoin")]
+        if not leaves:
+            print(f"AUDIT FAIL: no crash worker_leave for killed worker {w}")
+            ok = False
+        if not joins:
+            print(f"AUDIT FAIL: no rejoin worker_join for killed worker {w}")
+            ok = False
+    return ok, trans
+
+
+def run_bsp_chaos(args, kv):
+    """``launcher --supervise`` under chaos: SIGKILL the worker subprocess
+    mid-epoch, assert the supervisor resumes it to completion."""
+    from theanompi_tpu.utils import chaos
+
+    cmd = [sys.executable, "-m", "theanompi_tpu.launcher",
+           "--supervise", str(args.max_restarts), "--rule", "bsp",
+           "--modelfile", args.modelfile, "--modelclass", args.modelclass,
+           "--backoff", "0.2"] + kv
+    sup = subprocess.Popen(cmd)
+    schedule = chaos.parse_schedule(args.faults) if args.faults else \
+        chaos.seeded_schedule(args.seed, [0], n_faults=args.n_faults,
+                              t_min=args.t_min, t_max=args.t_max)
+
+    def pid_of(_target):
+        return chaos.find_child_pid(sup.pid, "theanompi_tpu.worker",
+                                    timeout_s=0.2)
+
+    monkey = chaos.ChaosMonkey(schedule, pid_of=pid_of)
+    monkey.start()
+    rc = sup.wait()
+    monkey.stop()
+    applied = [f for f in monkey.applied if f.error is None]
+    print(f"bsp chaos: rc={rc}, faults applied: {applied}")
+    return rc
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--rule", default="easgd",
+                    choices=["easgd", "asgd", "bsp"])
+    ap.add_argument("--modelfile", default="tests.conftest")
+    ap.add_argument("--modelclass", default="TinyModel")
+    ap.add_argument("--workers", type=int, default=3)
+    ap.add_argument("--steps", type=int, default=120,
+                    help="local steps per elastic worker before clean exit")
+    ap.add_argument("--faults", default=None,
+                    help="explicit schedule: kind@sec:worker[:dur],...")
+    ap.add_argument("--seed", type=int, default=7,
+                    help="seeded random faults when --faults is not given")
+    ap.add_argument("--n-faults", type=int, default=1)
+    ap.add_argument("--t-min", type=float, default=10.0)
+    ap.add_argument("--t-max", type=float, default=30.0)
+    ap.add_argument("--record-dir", required=True)
+    ap.add_argument("--host-devices", type=int, default=1,
+                    help="simulated chips per worker (CPU venue)")
+    ap.add_argument("--sync-freq", type=int, default=2)
+    ap.add_argument("--max-restarts", type=int, default=4)
+    ap.add_argument("--lease-timeout", type=float, default=20.0)
+    ap.add_argument("--timeout", type=float, default=600.0)
+    ap.add_argument("--verify-loss", action="store_true",
+                    help="evaluate the final center on the val set")
+    ap.add_argument("--loss-threshold", type=float, default=None,
+                    help="chaos gate: final center val cost must be below")
+    ap.add_argument("config", nargs="*", help="key=value model config")
+    args = ap.parse_args(argv)
+
+    os.makedirs(args.record_dir, exist_ok=True)
+    if args.rule == "bsp":
+        return run_bsp_chaos(args, args.config)
+
+    from theanompi_tpu.parallel.membership import parse_kv, run_elastic
+    from theanompi_tpu.utils import chaos
+
+    schedule = chaos.parse_schedule(args.faults) if args.faults else \
+        chaos.seeded_schedule(args.seed,
+                              list(range(1, args.workers + 1)),
+                              n_faults=args.n_faults, t_min=args.t_min,
+                              t_max=args.t_max)
+    print(f"chaos schedule: {schedule}")
+    config = parse_kv(args.config)
+    config.setdefault("sync_freq", args.sync_freq)
+    t0 = time.time()
+    rc = run_elastic(
+        args.rule, args.modelfile, args.modelclass, config, args.workers,
+        record_dir=args.record_dir, steps=args.steps,
+        host_devices=args.host_devices, chaos_schedule=schedule,
+        timeout_s=args.timeout,
+        supervisor_kw={"max_restarts": args.max_restarts,
+                       "lease_timeout": args.lease_timeout})
+    print(f"elastic run rc={rc} in {time.time() - t0:.1f}s")
+    if rc != 0:
+        return rc
+
+    kills = [f.target for f in schedule
+             if f.kind == "kill" and f.applied and f.error is None]
+    if not kills:
+        print("warning: no kill fault landed on a live worker — nothing "
+              "to audit (workers finished before the schedule fired?)")
+    ok, trans = audit_membership(args.record_dir, kills)
+    for e in trans:
+        print(f"  {e['ev']} worker={e.get('worker')} "
+              f"reason={e.get('reason') or e.get('kind')}")
+    if not ok:
+        return 4
+    if args.verify_loss or args.loss_threshold is not None:
+        center = os.path.join(args.record_dir, "center_final.npz")
+        loss = eval_center_loss(args.modelfile, args.modelclass,
+                                config, center)
+        print(f"final center val cost: {loss:.4f}")
+        with open(os.path.join(args.record_dir, "chaos_gate.json"),
+                  "w") as f:
+            json.dump({"val_cost": loss, "kills": kills,
+                       "threshold": args.loss_threshold}, f)
+        if args.loss_threshold is not None and \
+                not loss < args.loss_threshold:
+            print(f"CHAOS GATE FAIL: {loss:.4f} >= {args.loss_threshold}")
+            return 5
+    print("chaos gate: PASS")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

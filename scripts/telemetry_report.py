@@ -26,10 +26,12 @@ and emits the cross-worker run report the bucket sums can't answer:
 * **``--trace out.json``** — the merged per-rank streams converted to
   Chrome trace-event JSON: one process (track group) per rank holding
   the phase spans (a ``phase`` event's span is ``[ts − dt, ts]``),
-  counter tracks for HBM bytes-in-use, prefetch queue depth, and
-  images/sec, and instant markers for anomaly/crash/stall/fatal-signal
-  events — open directly in Perfetto (ui.perfetto.dev) or
-  ``chrome://tracing`` for the cross-rank straggler timeline.
+  counter tracks for HBM bytes-in-use, prefetch queue depth, heartbeat
+  progress, and images/sec, and instant markers for anomaly/crash/stall/
+  fatal-signal events plus the elastic-membership transitions
+  (``worker_join``/``worker_leave``/``worker_demote``) and chaos-harness
+  ``fault_injected`` audits — open directly in Perfetto (ui.perfetto.dev)
+  or ``chrome://tracing`` for the cross-rank straggler/churn timeline.
 
 Usage:
     python scripts/telemetry_report.py <record_dir> [--window SEC]
@@ -48,17 +50,23 @@ from collections import defaultdict
 
 # Event kinds this report (and the --trace converter) consumes — the
 # tpulint schema-drift checker asserts the emitters' vocabulary (telemetry
-# phase events, sentry anomalies, devprof device profiles) stays inside
-# it, so an emitter can't add a kind the report silently drops.
+# phase events, sentry anomalies, devprof device profiles, membership
+# transitions, chaos fault injections) stays inside it, so an emitter
+# can't add a kind the report silently drops.
 TRACKED_EVENTS = ("phase", "train_record", "val_record", "gauges",
                   "device_profile", "anomaly", "crash", "stall",
-                  "fatal_signal")
+                  "fatal_signal", "worker_join", "worker_leave",
+                  "worker_demote", "fault_injected")
 
 # gauges-event keys drawn as Perfetto counter tracks (plus
-# images_per_sec from train_record events)
-TRACE_COUNTER_KEYS = ("hbm_bytes_in_use", "prefetch.queue_depth")
+# images_per_sec from train_record events); heartbeat.iter is the
+# membership lease's liveness signal (parallel/membership.py)
+TRACE_COUNTER_KEYS = ("hbm_bytes_in_use", "prefetch.queue_depth",
+                      "heartbeat.iter")
 
-INSTANT_EVENTS = ("anomaly", "crash", "stall", "fatal_signal")
+INSTANT_EVENTS = ("anomaly", "crash", "stall", "fatal_signal",
+                  "worker_join", "worker_leave", "worker_demote",
+                  "fault_injected")
 
 
 def percentile(values, q):
@@ -277,8 +285,14 @@ def build_trace(events):
                              "name": "device.overlap_ratio",
                              "args": {"value": ev["overlap_ratio"]}})
         elif kind in INSTANT_EVENTS:
-            detail = ev.get("kind") or ev.get("label") or \
+            parts = []
+            if "worker" in ev:          # membership/chaos events name the
+                parts.append(f"w{ev['worker']}")   # affected worker
+            d = ev.get("kind") or ev.get("reason") or ev.get("label") or \
                 ev.get("error", "")[:40] or ev.get("signum", "")
+            if d:
+                parts.append(str(d))
+            detail = ":".join(parts)
             body.append({"ph": "i", "pid": rank, "tid": 0,
                          "ts": us(ev["ts"]), "s": "p",
                          "name": f"{kind}:{detail}" if detail else kind,
@@ -306,6 +320,15 @@ def build_report(record_dir, window_s=10.0, events=None):
                 k: ev.get(k) for k in ("compute_secs", "comm_secs",
                                        "exposed_comm_secs", "overlap_ratio",
                                        "lanes", "train_dispatches")}
+    # membership transitions + injected faults (elastic runtime,
+    # parallel/membership.py + utils/chaos.py) — the run's churn story
+    membership = [
+        {"ts": ev["ts"], "ev": ev["ev"], "worker": ev.get("worker"),
+         "reason": ev.get("reason"), "kind": ev.get("kind"),
+         "rejoin": ev.get("rejoin")}
+        for ev in events
+        if ev["ev"] in ("worker_join", "worker_leave", "worker_demote",
+                        "fault_injected")]
     return {
         "record_dir": os.path.abspath(record_dir),
         "runs": runs, "ranks": ranks, "events": len(events),
@@ -315,6 +338,7 @@ def build_report(record_dir, window_s=10.0, events=None):
         "straggler_ranking": straggler_ranking(events, window_s),
         "flags": health_flags(events, summaries),
         "counters": {r: s.get("counters", {}) for r, s in summaries.items()},
+        "membership_events": membership,
         "crash_events": crashes,
         "flight_dumps": dumps,
     }
@@ -376,6 +400,13 @@ def print_report(rep):
         for rank, kinds in sorted(an.items()):
             pretty = ", ".join(f"{k}×{n}" for k, n in sorted(kinds.items()))
             print(f"  rank {rank}: {pretty}")
+    if rep.get("membership_events"):
+        print("\nmembership transitions / injected faults:")
+        for ev in rep["membership_events"][-10:]:
+            detail = ev.get("reason") or ev.get("kind") or ""
+            print(f"  {ev['ev']} worker {ev.get('worker')}"
+                  + (f" ({detail})" if detail else "")
+                  + (" [rejoin]" if ev.get("rejoin") else ""))
     if rep["crash_events"]:
         print("\ncrash/stall/anomaly events:")
         for ev in rep["crash_events"][-5:]:

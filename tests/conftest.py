@@ -108,6 +108,27 @@ class CrashOnceLM(_CrashOnceTrainIter, TransformerLM):
     supervisor/resume recovery loop is model-agnostic."""
 
 
+class SleepyModel(TinyModel):
+    """Slows each train_iter by ``iter_sleep`` seconds — gives external
+    fault injectors (the chaos harness's SIGKILL-mid-epoch tests) a wide,
+    deterministic window to land a signal inside an epoch."""
+
+    def train_iter(self, count, recorder=None):
+        import time
+        time.sleep(float(self.config.get("iter_sleep", 0.05)))
+        super().train_iter(count, recorder)
+
+
+class AlwaysCrashModel(TinyModel):
+    """Crashes at every ``crash_at``-th iteration, every run — the
+    systemic failure a crash-loop breaker must stop retrying."""
+
+    def train_iter(self, count, recorder=None):
+        if count >= int(self.config.get("crash_at", 1)):
+            raise RuntimeError("injected systemic crash (chaos test)")
+        super().train_iter(count, recorder)
+
+
 class HangOnceModel(TinyModel):
     """Fault-injection model for the hang-recovery test: STALLS (sleeps far
     past any stall_timeout) at ``hang_at`` once; the marker file makes the

@@ -1,0 +1,291 @@
+"""Chaos harness + the chaos acceptance gates (utils/chaos.py,
+parallel/membership.py, docs/design.md §14).
+
+Tier-1 ("not slow"): schedule/monkey unit tests against dummy processes,
+the fast elastic kill-and-rejoin run, the supervised SIGKILL-mid-epoch
+resume (the BSP reaction), and the crash-loop breaker.  The full
+convergence-under-chaos gate is marked slow."""
+
+import glob
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from theanompi_tpu.parallel import membership as mb
+from theanompi_tpu.utils import chaos
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# -- schedules ---------------------------------------------------------------
+
+def test_parse_schedule():
+    faults = chaos.parse_schedule("kill@8:1,stop@12:2:3.5,delay@3:0:0.5")
+    assert [(f.kind, f.at, f.target, f.duration) for f in faults] == [
+        ("delay", 3.0, 0, 0.5), ("kill", 8.0, 1, 0.0),
+        ("stop", 12.0, 2, 3.5)]
+    with pytest.raises(ValueError, match="bad fault entry"):
+        chaos.parse_schedule("kill@oops")
+    with pytest.raises(AssertionError, match="unknown fault kind"):
+        chaos.parse_schedule("maim@3:0")
+
+
+def test_seeded_schedule_reproducible_and_in_bounds():
+    a = chaos.seeded_schedule(7, [1, 2, 3], n_faults=4, t_min=5, t_max=30,
+                              kinds=("kill", "stop"))
+    b = chaos.seeded_schedule(7, [1, 2, 3], n_faults=4, t_min=5, t_max=30,
+                              kinds=("kill", "stop"))
+    assert [repr(f) for f in a] == [repr(f) for f in b]
+    assert all(5 <= f.at <= 30 and f.target in (1, 2, 3) for f in a)
+    c = chaos.seeded_schedule(8, [1, 2, 3], n_faults=4)
+    assert [repr(f) for f in a] != [repr(f) for f in c]
+
+
+# -- the monkey --------------------------------------------------------------
+
+def test_monkey_kill_fault_against_live_process():
+    p = subprocess.Popen(["sleep", "30"])
+    try:
+        monkey = chaos.ChaosMonkey(chaos.parse_schedule("kill@0.1:0"),
+                                   pid_of=lambda t: p.pid)
+        monkey.start()
+        rc = p.wait(timeout=10)
+        monkey.stop()
+        assert rc == -signal.SIGKILL
+        assert monkey.applied and monkey.applied[0].error is None
+    finally:
+        if p.poll() is None:
+            p.kill()
+
+
+def test_monkey_stop_fault_wedges_then_releases():
+    t0 = time.time()
+    p = subprocess.Popen(["sleep", "0.2"])
+    try:
+        monkey = chaos.ChaosMonkey(chaos.parse_schedule("stop@0.05:0:0.6"),
+                                   pid_of=lambda t: p.pid)
+        monkey.start()
+        rc = p.wait(timeout=10)
+        monkey.stop()
+        # SIGSTOPped for 0.6s: a 0.2s sleep cannot finish before ~0.6s
+        assert rc == 0 and time.time() - t0 > 0.5
+    finally:
+        if p.poll() is None:
+            p.kill()
+
+
+def test_monkey_delay_hook_and_no_pid_drop():
+    hits = []
+    monkey = chaos.ChaosMonkey(
+        chaos.parse_schedule("delay@0.05:3:0.7,kill@0.05:1"),
+        pid_of=lambda t: None, delay_hook=lambda t, d: hits.append((t, d)),
+        grace_s=0.3)
+    monkey.start()
+    time.sleep(1.0)
+    monkey.stop()
+    assert hits == [(3, 0.7)]
+    killf = [f for f in monkey.schedule if f.kind == "kill"][0]
+    assert killf.applied and killf.error == "no-pid"
+
+
+def test_find_child_pid(tmp_path):
+    p = subprocess.Popen([sys.executable, "-c",
+                          "import time; time.sleep(20)"])
+    try:
+        found = chaos.find_child_pid(os.getpid(), "time.sleep(20)",
+                                     timeout_s=10)
+        assert found == p.pid
+        assert chaos.find_child_pid(os.getpid(), "no-such-needle",
+                                    timeout_s=0.2) is None
+    finally:
+        p.kill()
+
+
+# -- fast elastic chaos (tier-1): kill → leave → backoff rejoin --------------
+
+def _merged_events(record_dir):
+    events = []
+    for p in sorted(glob.glob(os.path.join(record_dir,
+                                           "telemetry_rank*.jsonl"))):
+        with open(p) as f:
+            for line in f:
+                line = line.strip()
+                if line:
+                    try:
+                        events.append(json.loads(line))
+                    except ValueError:
+                        pass
+    return events
+
+
+def test_elastic_easgd_survives_sigkill_and_rejoins(tmp_path):
+    """The fast chaos gate: SIGKILL a non-zero elastic worker mid-run; the
+    EASGD run completes WITHOUT a world restart, the telemetry stream
+    records the matching worker_leave/worker_join pair, and the rejoining
+    worker restored from the center and contributed again."""
+    record_dir = str(tmp_path)
+    schedule = chaos.parse_schedule("kill@6:1")
+    rc = mb.run_elastic(
+        "easgd", "tests.conftest", "TinyModel",
+        {"sync_freq": 2, "batch_size": 8}, 2,
+        record_dir=record_dir, steps=40, host_devices=1,
+        chaos_schedule=schedule, timeout_s=420,
+        supervisor_kw={"poll_s": 0.2, "backoff": mb.Backoff(base=0.3),
+                       "lease_timeout": 60.0})
+    assert rc == 0
+    assert schedule[0].error is None, "kill fault never landed"
+    events = _merged_events(record_dir)
+    kinds = [(e["ev"], e.get("worker"), e.get("reason")) for e in events
+             if e["ev"] in mb.MEMBERSHIP_EVENTS + (chaos.FAULT_EVENT,)]
+    # the injected fault is audited, the death observed, the rejoin made
+    assert ("fault_injected", 1, None) in kinds
+    crash_leaves = [k for k in kinds
+                    if k[0] == "worker_leave" and k[1] == 1
+                    and k[2] in ("crashed", "wedged", "lease_expired")]
+    rejoins = [e for e in events if e["ev"] == "worker_join"
+               and e.get("worker") == 1 and e.get("rejoin")]
+    assert crash_leaves, kinds
+    assert rejoins, kinds
+    # both workers finished cleanly (no world restart: worker 2 has ONE
+    # join — it was never restarted)
+    finished = [k for k in kinds if k[0] == "worker_leave"
+                and k[2] == "finished"]
+    assert {k[1] for k in finished} == {1, 2}
+    w2_joins = [e for e in events if e["ev"] == "worker_join"
+                and e.get("worker") == 2]
+    assert len(w2_joins) == 1
+    # the center heard pushes and the final snapshot landed for offline eval
+    assert os.path.exists(os.path.join(record_dir, "center_final.npz"))
+
+
+# -- supervised SIGKILL resume (the BSP reaction) ----------------------------
+
+def test_supervised_sigkill_mid_epoch_resumes_at_window_cursor(tmp_path):
+    """SIGKILL (not a Python crash: no atexit, no flight dump, no unwind)
+    a supervised worker mid-epoch; the launcher restarts it with backoff
+    and the run resumes at the last committed window cursor with the
+    recorder history intact — extends the PR 4 supervised-resume and PR 7
+    SIGTERM tests to the preemption signal you cannot handle."""
+    ckpt = str(tmp_path / "ckpt")
+    rec_dir = str(tmp_path / "rec")
+    env = {k: v for k, v in os.environ.items() if k != "JAX_PLATFORMS"}
+    # the worker subprocess imports jax before tests.conftest can set the
+    # flag — the 8-chip CPU sim must come in through the environment
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+    sup = subprocess.Popen(
+        [sys.executable, "-m", "theanompi_tpu.launcher",
+         "--supervise", "3", "--rule", "bsp", "--backoff", "0.05",
+         "--modelfile", "tests.conftest", "--modelclass", "SleepyModel",
+         "platform=cpu", "epochs=2", "batch_size=8", "n_train=2048",
+         "n_workers=8", "scale_lr=false", "printFreq=8",
+         "iter_sleep=0.05", f"ckpt_dir={ckpt}", f"record_dir={rec_dir}"],
+        cwd=REPO, env=env, stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT, text=True)
+    try:
+        # wait for the first committed checkpoint (epoch 0), then kill the
+        # WORKER subprocess mid-epoch-1 — epoch 1 runs ~1.6s of slowed
+        # iterations, a wide window
+        assert chaos.wait_for_file(os.path.join(ckpt, "LATEST"),
+                                   timeout_s=180,
+                                   predicate=lambda s: s.strip() == "0")
+        wpid = chaos.find_child_pid(sup.pid, "theanompi_tpu.worker",
+                                    timeout_s=30)
+        assert wpid is not None
+        os.kill(wpid, signal.SIGKILL)
+        out, _ = sup.communicate(timeout=300)
+    finally:
+        if sup.poll() is None:
+            sup.kill()
+            sup.communicate()
+    assert sup.returncode == 0, out[-3000:]
+    assert "restarting in" in out                  # the backoff restart
+    assert "resumed from epoch 0" in out           # committed-cursor resume
+    with open(os.path.join(ckpt, "LATEST")) as f:
+        assert int(f.read()) == 1                  # run completed epoch 1
+    # recorder history intact across the kill: the final records file
+    # still holds pre-kill train records (epoch 0 iters) AND both epochs'
+    # val records (Recorder.load round-trip on the resume path)
+    with open(os.path.join(rec_dir, "inforec_rank0.jsonl")) as f:
+        recs = [json.loads(l) for l in f if l.strip()]
+    train_iters = [r["iter"] for r in recs if "val_cost" not in r]
+    val_iters = [r["iter"] for r in recs if "val_cost" in r]
+    assert any(i <= 32 for i in train_iters), train_iters   # pre-kill
+    assert set(val_iters) == {32, 64}, val_iters            # both epochs
+
+
+def test_supervise_crash_loop_breaker_stops_with_flight_tail(tmp_path,
+                                                             capsys):
+    """A systemically-crashing worker must trip the breaker (N failures
+    within the window) instead of burning every restart — nonzero exit
+    with the flight-recorder tail printed."""
+    from theanompi_tpu import launcher
+
+    rec_dir = str(tmp_path / "rec")
+    rc = launcher.main([
+        "--supervise", "6", "--rule", "bsp",
+        "--backoff", "0.05", "--crash-limit", "2", "--crash-window", "300",
+        "--modelfile", "tests.conftest", "--modelclass", "AlwaysCrashModel",
+        "platform=cpu", "epochs=1", "batch_size=8", "n_train=64",
+        "n_workers=1", "verbose=false", "scale_lr=false", "crash_at=1",
+        f"record_dir={rec_dir}",
+    ])
+    assert rc != 0
+    err = capsys.readouterr().err
+    assert "crash loop: 2 failures" in err
+    assert err.count("restarting in") == 1         # breaker beat restart #2
+    assert "flight tail" in err                    # the evidence printed
+
+
+# -- slow: the full convergence-under-chaos gate -----------------------------
+
+@pytest.mark.slow
+def test_chaos_gate_easgd_convergence_under_kills(tmp_path):
+    """The acceptance gate: random SIGKILLs into non-zero workers mid-run;
+    the EASGD run completes without a world restart AND the final center
+    reaches the fault-free run's loss neighborhood — convergence under
+    churn, not mere survival.  Audited through scripts/chaos_run.py's own
+    matching logic."""
+    sys.path.insert(0, os.path.join(REPO, "scripts"))
+    import chaos_run
+
+    cfg = {"sync_freq": 2, "batch_size": 8}
+    # fault-free reference
+    clean_dir = str(tmp_path / "clean")
+    rc = mb.run_elastic("easgd", "tests.conftest", "TinyModel", dict(cfg),
+                        2, record_dir=clean_dir, steps=80, host_devices=1,
+                        timeout_s=420)
+    assert rc == 0
+    clean_loss = chaos_run.eval_center_loss(
+        "tests.conftest", "TinyModel", dict(cfg),
+        os.path.join(clean_dir, "center_final.npz"))
+    # chaotic run: two kills on the non-zero workers
+    chaos_dir = str(tmp_path / "chaos")
+    schedule = chaos.seeded_schedule(7, [1, 2], n_faults=2,
+                                     t_min=6.0, t_max=14.0)
+    rc = mb.run_elastic("easgd", "tests.conftest", "TinyModel", dict(cfg),
+                        2, record_dir=chaos_dir, steps=80, host_devices=1,
+                        chaos_schedule=schedule, timeout_s=420,
+                        supervisor_kw={"poll_s": 0.2,
+                                       "backoff": mb.Backoff(base=0.3),
+                                       "lease_timeout": 60.0})
+    assert rc == 0
+    # only faults that actually LANDED on a live pid are auditable (a
+    # worker can finish before its fault time; the monkey then drops it)
+    kills = [f.target for f in schedule
+             if f.kind == "kill" and f.applied and f.error is None]
+    assert kills, "no kill landed — schedule mistimed"
+    ok, _ = chaos_run.audit_membership(chaos_dir, kills)
+    assert ok
+    chaos_loss = chaos_run.eval_center_loss(
+        "tests.conftest", "TinyModel", dict(cfg),
+        os.path.join(chaos_dir, "center_final.npz"))
+    # convergence-to-accuracy: better than a random 2-class model and
+    # within the fault-free run's neighborhood
+    assert chaos_loss < 0.69, (chaos_loss, clean_loss)
+    assert chaos_loss < clean_loss + 0.15, (chaos_loss, clean_loss)

@@ -228,6 +228,49 @@ def test_checkpoint_latest_and_missing(tmp_path):
     assert os.path.isdir(os.path.join(d, "params_epoch2"))
 
 
+def test_corrupted_latest_checkpoint_falls_back_to_newest_valid(tmp_path):
+    """A SIGKILL mid-save must never brick `--supervise` resume: with the
+    newest checkpoint truncated (pre-atomic writer) or its sidecar torn,
+    latest_epoch falls back to the newest VALID epoch and model.load
+    resumes from it."""
+    d = str(tmp_path / "c")
+    m = _model()
+    m.save(d, epoch=0)
+    m.save(d, epoch=1)
+    assert ckpt.checkpoint_valid(d, 1)
+    # simulate the mid-save kill: epoch 1's archive truncated to half
+    path1 = os.path.join(d, "ckpt_epoch1.npz")
+    blob = open(path1, "rb").read()
+    with open(path1, "wb") as f:
+        f.write(blob[:len(blob) // 2])
+    assert not ckpt.checkpoint_valid(d, 1)
+    assert ckpt.latest_epoch(d) == 0               # newest VALID wins
+    m2 = _model()
+    assert m2.load(d) == 0                          # resume did not brick
+    # torn LATEST pointer alone must not brick either
+    with open(os.path.join(d, "LATEST"), "w") as f:
+        f.write("not-an-int")
+    assert ckpt.latest_epoch(d) == 0
+    # a fully healthy dir keeps the fast path
+    m.save(d, epoch=1)
+    assert ckpt.latest_epoch(d) == 1
+    # sidecar torn: same fallback
+    with open(os.path.join(d, "ckpt_epoch1.json"), "w") as f:
+        f.write('{"epoch": 1, "count"')
+    assert ckpt.latest_epoch(d) == 0
+
+
+def test_checkpoint_writes_are_atomic_no_temp_residue(tmp_path):
+    d = str(tmp_path / "a")
+    m = _model()
+    m.save(d, epoch=0)
+    assert not [f for f in os.listdir(d) if f.endswith(".tmp")]
+    # every artifact is complete and parseable immediately after save
+    assert ckpt.checkpoint_valid(d, 0)
+    with open(os.path.join(d, "LATEST")) as f:
+        assert int(f.read()) == 0
+
+
 def test_save_params_npy_roundtrip(tmp_path):
     d = str(tmp_path / "p")
     tree = {"a": np.arange(6, dtype=np.float32).reshape(2, 3),

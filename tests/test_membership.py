@@ -1,0 +1,407 @@
+"""Elastic membership: leases, controller transitions, rule-aware
+reactions (demote/readmit at the center and in the mesh), backoff and
+crash-loop plumbing (parallel/membership.py, docs/design.md §14)."""
+
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+
+import jax
+
+from tests.conftest import TinyModel
+from theanompi_tpu.parallel import membership as mb
+from theanompi_tpu.parallel.async_easgd import ElasticCenter
+from theanompi_tpu.parallel.center_server import CenterServer, RemoteCenter
+from theanompi_tpu.parallel.exchanger import (ASGD_Exchanger,
+                                              EASGD_Exchanger,
+                                              GOSGD_Exchanger)
+from theanompi_tpu.utils import telemetry
+
+
+def _tm():
+    return telemetry.Telemetry(rank=0, run_id="membership-test")
+
+
+def _events(tm, *kinds):
+    return [e for e in tm.tail(64) if e["ev"] in kinds]
+
+
+# -- leases ------------------------------------------------------------------
+
+def test_lease_beat_roundtrip_and_heartbeat_gauges(tmp_path):
+    tm = _tm()
+    lease = mb.WorkerLease(str(tmp_path), 3, telemetry_=tm)
+    lease.beat(7)
+    docs = mb.read_leases(str(tmp_path))
+    assert docs[3]["step"] == 7 and docs[3]["status"] == "live"
+    assert docs[3]["pid"] == os.getpid()
+    # no torn temp files left behind (atomic replace)
+    assert not [f for f in os.listdir(tmp_path) if ".tmp" in f]
+    # the beat streamed the declared heartbeat gauges
+    assert tm.gauges["heartbeat.iter"] == 7
+    gs = _events(tm, "gauges")
+    assert gs and gs[-1]["heartbeat.iter"] == 7
+    lease.release()
+    assert mb.read_leases(str(tmp_path))[3]["status"] == "left"
+
+
+def test_controller_join_expire_rejoin_cycle(tmp_path):
+    tm = _tm()
+    ctl = mb.MembershipController(lease_dir=str(tmp_path),
+                                  lease_timeout=0.2, telemetry_=tm)
+    lease = mb.WorkerLease(str(tmp_path), 1, telemetry_=tm,
+                           min_interval_s=0.0)
+    lease.beat(1)
+    trans = ctl.poll()
+    assert [t[0] for t in trans] == ["worker_join"]
+    assert ctl.active_ranks() == [1]
+    time.sleep(0.3)                      # lease expires: wedged or dead
+    trans = ctl.poll()
+    assert [t[0] for t in trans] == ["worker_leave"]
+    assert trans[0][2]["reason"] == "lease_expired"
+    assert ctl.active_ranks() == []
+    lease.beat(9)                        # the worker comes back
+    trans = ctl.poll()
+    assert [t[0] for t in trans] == ["worker_join"]
+    assert trans[0][2]["rejoin"] is True
+    # every transition is one telemetry event tagged with the worker id
+    evs = _events(tm, *mb.MEMBERSHIP_EVENTS)
+    assert [e["ev"] for e in evs] == ["worker_join", "worker_leave",
+                                     "worker_join"]
+    assert all(e["worker"] == 1 for e in evs)
+
+
+def test_stale_lease_cannot_resurrect_a_dead_worker(tmp_path):
+    """A killed process's last beat can still be inside the lease window —
+    the supervisor's death observation must win until a NEWER beat."""
+    ctl = mb.MembershipController(lease_dir=str(tmp_path),
+                                  lease_timeout=30.0, telemetry_=_tm())
+    lease = mb.WorkerLease(str(tmp_path), 1, min_interval_s=0.0)
+    lease.beat(5)
+    ctl.poll()
+    assert ctl.active_ranks() == [1]
+    ctl.leave(1, reason="crashed", rc=-9)   # supervisor saw the SIGKILL
+    assert ctl.poll() == []                  # fresh-but-stale lease ignored
+    assert ctl.active_ranks() == []
+    lease.beat(6)                            # respawn actually beat
+    trans = ctl.poll()
+    assert [t[0] for t in trans] == ["worker_join"]
+    assert trans[0][2]["rejoin"] is True
+
+
+def test_clean_finish_is_not_a_death(tmp_path):
+    ctl = mb.MembershipController(lease_dir=str(tmp_path),
+                                  lease_timeout=30.0, telemetry_=_tm())
+    lease = mb.WorkerLease(str(tmp_path), 4)
+    lease.beat(10)
+    ctl.poll()
+    lease.release()
+    trans = ctl.poll()
+    assert [t[0] for t in trans] == ["worker_leave"]
+    assert trans[0][2]["reason"] == "finished"
+    assert ctl.status()["left"] == [4]
+
+
+# -- straggler demotion ------------------------------------------------------
+
+def test_straggler_demotion_and_min_active_floor():
+    tm = _tm()
+    ctl = mb.MembershipController(telemetry_=tm, straggle_windows=3,
+                                  min_active=1)
+    for w in (0, 1, 2):
+        ctl.join(w)
+    ranking = [{"rank": 2, "windows_straggled": 5, "mean_train_secs": 0.9},
+               {"rank": 1, "windows_straggled": 1, "mean_train_secs": 0.1},
+               {"rank": 0, "windows_straggled": 0, "mean_train_secs": 0.1}]
+    assert ctl.check_stragglers(ranking) == [2]
+    assert ctl.status()["demoted"] == [2]
+    evs = _events(tm, "worker_demote")
+    assert evs[-1]["worker"] == 2 and evs[-1]["reason"] == "straggler"
+    # re-running does not double-demote
+    assert ctl.check_stragglers(ranking) == []
+    # readmission is a worker_join with rejoin
+    ctl.readmit(2)
+    assert ctl.active_ranks() == [0, 1, 2]
+    join = _events(tm, "worker_join")[-1]
+    assert join["worker"] == 2 and join["reason"] == "readmit"
+    # the ranking is CUMULATIVE: the evidence that demoted worker 2 must
+    # NOT re-demote it after readmission — only NEW straggles can
+    assert ctl.check_stragglers(ranking) == []
+    assert ctl.active_ranks() == [0, 1, 2]
+    worse = [dict(ranking[0], windows_straggled=8)] + ranking[1:]
+    assert ctl.check_stragglers(worse) == [2]     # 3 fresh windows → out
+    # the floor: never demote the last active workers
+    ctl2 = mb.MembershipController(straggle_windows=1, min_active=2)
+    ctl2.join(0)
+    ctl2.join(1)
+    assert ctl2.check_stragglers(
+        [{"rank": 1, "windows_straggled": 9},
+         {"rank": 0, "windows_straggled": 0}]) == []
+
+
+def test_straggler_ranking_sourced_from_telemetry_streams(tmp_path):
+    """The controller consumes telemetry_report's windowed ranking over
+    real per-rank stream files — rank 2's fat phase.train dts must get it
+    demoted."""
+    t0 = time.time()
+    for rank in range(3):
+        with open(tmp_path / f"telemetry_rank{rank}.jsonl", "w") as f:
+            for i in range(30):
+                dt = 0.5 if rank == 2 else 0.01
+                f.write(json.dumps(
+                    {"ts": t0 + i, "run": "r", "rank": rank, "ev": "phase",
+                     "sec": "train", "dt": dt}) + "\n")
+    ctl = mb.MembershipController(telemetry_=_tm(),
+                                  record_dir=str(tmp_path),
+                                  straggle_windows=2, straggle_window_s=5.0)
+    for w in range(3):
+        ctl.join(w)
+    assert ctl.check_stragglers() == [2]
+    assert ctl.status()["demoted"] == [2]
+
+
+# -- backoff / breaker / flight tail ----------------------------------------
+
+def test_backoff_bounded_exponential_with_jitter():
+    b = mb.Backoff(base=1.0, factor=2.0, cap=8.0, jitter=0.25, seed=3)
+    for attempt, nominal in [(0, 1.0), (1, 2.0), (2, 4.0), (3, 8.0),
+                             (9, 8.0)]:
+        for _ in range(16):
+            d = b.delay(attempt)
+            assert 0.75 * nominal <= d <= 1.25 * nominal, (attempt, d)
+
+
+def test_crash_loop_breaker_window_semantics():
+    br = mb.CrashLoopBreaker(limit=3, window_s=10.0)
+    assert br.record_failure(now=0.0) is False
+    assert br.record_failure(now=1.0) is False
+    assert br.record_failure(now=2.0) is True         # 3 within 10s
+    # spread failures never trip
+    br2 = mb.CrashLoopBreaker(limit=3, window_s=10.0)
+    assert br2.record_failure(now=0.0) is False
+    assert br2.record_failure(now=20.0) is False
+    assert br2.record_failure(now=40.0) is False
+
+
+def test_flight_tail_lines_reads_newest_dump(tmp_path):
+    tm = telemetry.Telemetry(rank=0, run_id="ft", stream_dir=str(tmp_path))
+    tm.event("phase", sec="train", dt=0.1)
+    tm.event("crash", error="boom")
+    tm.dump_flight(reason="test")
+    tm.close()
+    lines = mb.flight_tail_lines(str(tmp_path), n=8)
+    assert lines and "flight tail" in lines[0]
+    assert any("crash" in ln and "boom" in ln for ln in lines)
+    assert mb.flight_tail_lines(str(tmp_path / "nope")) == []
+
+
+# -- center reactions (EASGD/ASGD shrink without stopping) -------------------
+
+def _center_with_probe():
+    center = ElasticCenter(alpha=0.5)
+    p0 = {"w": np.ones((2, 2), np.float32)}
+    center.ensure_init(p0)
+    return center, p0
+
+
+def test_elastic_center_demote_drops_pushes_readmit_restores():
+    center, p0 = _center_with_probe()
+    d = {"w": np.full((2, 2), 2.0, np.float32)}
+    center.push_delta(d, island=1)
+    assert center.n_updates == 1
+    center.demote_island(1)
+    snap = center.pull()
+    center.push_delta(d, island=1)           # dropped
+    np.testing.assert_array_equal(center.pull()["w"], snap["w"])
+    assert center.n_updates == 1
+    assert center.dropped_by_island == {1: 1}
+    # pulls still serve the demoted island (it keeps training locally)
+    assert center.pull() is not None
+    # ASGD push_pull: pull half still answers, push half dropped
+    fresh = center.push_pull(d, island=1)
+    np.testing.assert_array_equal(fresh["w"], snap["w"])
+    assert center.dropped_by_island == {1: 2}
+    center.readmit_island(1)
+    center.push_delta(d, island=1)
+    assert center.n_updates == 2
+    assert not np.array_equal(center.pull()["w"], snap["w"])
+
+
+def test_center_reactor_drives_demote_and_readmit():
+    center, _ = _center_with_probe()
+    reactor = mb.CenterReactor(center)
+    ctl = mb.MembershipController(telemetry_=_tm(), reactors=[reactor])
+    ctl.join(1)
+    ctl.join(2)
+    ctl.demote(2)
+    assert center.demoted == {2}
+    ctl.readmit(2)
+    assert center.demoted == set()
+    ctl.leave(1, reason="crashed")           # zombie pushes must not land
+    assert center.demoted == {1}
+    ctl.join(1, reason="respawn")            # rejoin readmits
+    assert center.demoted == set()
+
+
+def test_remote_center_demote_over_the_wire():
+    srv = CenterServer(alpha=0.5)
+    host, port = srv.start()
+    try:
+        remote = RemoteCenter(f"{host}:{port}", alpha=0.5)
+        p0 = {"w": np.ones(3, np.float32)}
+        remote.ensure_init(p0)
+        remote.demote_island(5)
+        remote.push_delta({"w": np.ones(3, np.float32)}, island=5)
+        st = remote.stats()
+        assert st["demoted"] == [5]
+        assert st["dropped_by_island"] == {"5": 1} or \
+            st["dropped_by_island"] == {5: 1}
+        assert st["n_updates"] == 0
+        remote.readmit_island(5)
+        remote.push_delta({"w": np.ones(3, np.float32)}, island=5)
+        assert remote.n_updates == 1
+    finally:
+        srv.stop()
+
+
+# -- in-mesh reactions (SPMD demote-then-recover) ---------------------------
+
+def _setup(exchanger_cls, n=8, **cfg):
+    from theanompi_tpu.parallel.mesh import worker_mesh
+    mesh = worker_mesh(n)
+    config = {"mesh": mesh, "size": n, "rank": 0, "verbose": False,
+              "batch_size": 8, "sync_each_iter": True, **cfg}
+    model = TinyModel(config)
+    exch = exchanger_cls(config)
+    model.compile_iter_fns(exch)
+    model.data.shuffle_data(0)
+    return model, exch
+
+
+def _boxed_leaves(state):
+    return jax.tree_util.tree_leaves(jax.device_get(state["params"]))
+
+
+def test_easgd_demote_then_recover_in_mesh():
+    """Demoted rank: bit-frozen replica, zero contribution to the center
+    mean; readmitted rank: pulled back toward the center — a healthy
+    worker is readmitted and participates again."""
+    model, exch = _setup(EASGD_Exchanger, sync_freq=1, alpha=0.5)
+    for i in range(2):
+        model.train_iter(i + 1, None)
+    exch.set_active_ranks([r for r in range(8) if r != 2])
+    before = _boxed_leaves(model.step_state)
+    c_before = jax.device_get(exch.canonical_params(model.step_state))
+    exch.exchange(None, 1)
+    after = _boxed_leaves(model.step_state)
+    for b, a in zip(before, after):
+        np.testing.assert_array_equal(b[2], a[2])     # frozen replica
+        assert not np.array_equal(b[0], a[0])          # active rank moved
+    # center moved by the mean over the 7 ACTIVE ranks only (exact
+    # algebra pinned on leaf 0)
+    c_after = jax.device_get(exch.canonical_params(model.step_state))
+    l0_b, c0_b = before[0], jax.tree_util.tree_leaves(c_before)[0]
+    mask = np.ones((8,) + (1,) * (l0_b.ndim - 1), np.float32)
+    mask[2] = 0.0
+    mean_delta = ((l0_b - c0_b[None]) * mask).sum(axis=0) / 7.0
+    np.testing.assert_allclose(
+        jax.tree_util.tree_leaves(c_after)[0], c0_b + 0.5 * mean_delta,
+        rtol=1e-5)
+    # readmit: rank 2 participates again
+    exch.set_active_ranks(None)
+    before = _boxed_leaves(model.step_state)
+    exch.exchange(None, 2)
+    after = _boxed_leaves(model.step_state)
+    assert any(not np.array_equal(b[2], a[2])
+               for b, a in zip(before, after))
+
+
+def test_gosgd_demote_freezes_alpha_and_params_then_recovers():
+    model, exch = _setup(GOSGD_Exchanger, exch_prob=1.0)
+    for i in range(2):
+        model.train_iter(i + 1, None)
+    exch.set_active_ranks([0, 1, 3, 4, 5, 6, 7])
+    before = _boxed_leaves(model.step_state)
+    a_before = jax.device_get(model.step_state["extra"]["alpha"])
+    for i in range(4):
+        exch.exchange(None, i + 1)
+    after = _boxed_leaves(model.step_state)
+    a_after = jax.device_get(model.step_state["extra"]["alpha"])
+    for b, a in zip(before, after):
+        np.testing.assert_array_equal(b[2], a[2])
+    assert a_after[2] == a_before[2]                   # α frozen
+    np.testing.assert_allclose(a_after.sum(), a_before.sum(), rtol=1e-5)
+    # readmit: regenerated topology includes rank 2 again; with p=1 every
+    # rank sends each exchange, so within a few draws rank 2 both moves
+    # and its α changes
+    exch.set_active_ranks(None)
+    before = _boxed_leaves(model.step_state)
+    a_b = jax.device_get(model.step_state["extra"]["alpha"])
+    for i in range(4):
+        exch.exchange(None, 10 + i)
+    after = _boxed_leaves(model.step_state)
+    a_a = jax.device_get(model.step_state["extra"]["alpha"])
+    assert any(not np.array_equal(b[2], a[2])
+               for b, a in zip(before, after)) or a_a[2] != a_b[2]
+
+
+def test_asgd_demoted_rank_keeps_local_replica():
+    model, exch = _setup(ASGD_Exchanger, sync_freq=1)
+    for i in range(2):
+        model.train_iter(i + 1, None)
+    exch.set_active_ranks([r for r in range(8) if r != 3])
+    before = _boxed_leaves(model.step_state)
+    exch.exchange(None, 1)
+    after = _boxed_leaves(model.step_state)
+    for b, a in zip(before, after):
+        np.testing.assert_array_equal(b[3], a[3])      # not reset to center
+        # active ranks DID reset to the (common) new center
+        np.testing.assert_array_equal(a[0], a[1])
+    exch.set_active_ranks(None)
+    exch.exchange(None, 2)
+    after2 = _boxed_leaves(model.step_state)
+    for a in after2:
+        np.testing.assert_array_equal(a[3], a[0])      # readmitted: resets
+
+
+def test_bsp_refuses_membership_change():
+    from theanompi_tpu.parallel.exchanger import BSP_Exchanger
+    model, exch = _setup(BSP_Exchanger)
+    assert not exch.supports_elastic()
+    with pytest.raises(NotImplementedError, match="supervise"):
+        exch.set_active_ranks([0, 1])
+
+
+def test_set_active_ranks_validation():
+    model, exch = _setup(EASGD_Exchanger, sync_freq=1)
+    with pytest.raises(AssertionError):
+        exch.set_active_ranks([])
+    with pytest.raises(AssertionError):
+        exch.set_active_ranks([0, 99])
+    # full set normalizes to None (no mask algebra traced)
+    exch.set_active_ranks(list(range(8)))
+    assert exch._active_ranks is None
+
+
+def test_mesh_reactor_applies_active_set():
+    calls = []
+
+    class StubExch:
+        size = 4
+        fused = False
+
+        def set_active_ranks(self, active):
+            calls.append(tuple(active))
+
+    reactor = mb.MeshReactor(StubExch())
+    ctl = mb.MembershipController(telemetry_=_tm(), reactors=[reactor])
+    for w in range(4):
+        ctl.join(w)
+    calls.clear()
+    ctl.demote(3)
+    assert calls[-1] == (0, 1, 2)
+    ctl.readmit(3)
+    assert calls[-1] == (0, 1, 2, 3)

@@ -39,6 +39,8 @@ RECORDER_PATH = "theanompi_tpu/utils/recorder.py"
 DEVPROF_PATH = "theanompi_tpu/utils/devprof.py"
 SENTRY_PATH = "theanompi_tpu/utils/sentry.py"
 REPORT_PATH = "scripts/telemetry_report.py"
+MEMBERSHIP_PATH = "theanompi_tpu/parallel/membership.py"
+CHAOS_PATH = "theanompi_tpu/utils/chaos.py"
 
 # one lane, one module: a compute span [0,50]us and a comm span [40,60]us
 # → compute 50us, comm 20us, exposed 10us, overlap 0.5 — a COMPLETE
@@ -180,27 +182,100 @@ def device_schema_errors(devprof, sentry, telemetry,
     return errors
 
 
-def _load_telemetry_report():
-    """scripts/telemetry_report.py loaded by FILE path (stdlib-only by
-    contract; it is a script, not a package module).  None when absent
-    from the linted tree."""
+def membership_schema_errors(membership, chaos, telemetry,
+                             telemetry_report=None) -> List[tuple]:
+    """Round-13 probes: the elastic-membership event vocabulary.  A LIVE
+    controller driven through join → demote → leave must emit exactly the
+    declared :data:`MEMBERSHIP_EVENTS` kinds (each tagged with the worker
+    id), a live ``WorkerLease.beat`` must stream its declared heartbeat
+    gauges, and the report/trace converter must consume all of it —
+    otherwise the chaos gate's leave/join matching silently sees nothing.
+    ``membership``/``chaos`` are the live modules (file-path loaded in the
+    jax-free lint CLI); either may be None in a partial tree."""
+    errors: List[tuple] = []
+    if membership is not None:
+        tm = telemetry.Telemetry(rank=0, run_id="drift-check")
+        ctl = membership.MembershipController(telemetry_=tm)
+        ctl.join(7, pid=123)
+        ctl.demote(7)            # refused: would empty the active set
+        ctl.join(8, pid=124)
+        ctl.demote(7)
+        ctl.leave(8, reason="probe")
+        evs = [e for e in tm.tail(8) if e["ev"] != "run_start"]
+        got = {e["ev"] for e in evs}
+        if got != set(membership.MEMBERSHIP_EVENTS):
+            errors.append((MEMBERSHIP_PATH,
+                           f"a live controller's join/demote/leave emitted "
+                           f"{sorted(got)} != MEMBERSHIP_EVENTS "
+                           f"{sorted(membership.MEMBERSHIP_EVENTS)}"))
+        if any("worker" not in e for e in evs):
+            errors.append((MEMBERSHIP_PATH,
+                           "a membership event carries no 'worker' field"))
+        # heartbeat gauges: one live beat streams the declared keys
+        import tempfile
+        tm2 = telemetry.Telemetry(rank=0, run_id="drift-check")
+        with tempfile.TemporaryDirectory() as d:
+            lease = membership.WorkerLease(d, 0, telemetry_=tm2)
+            lease.beat(5)
+        beats = [e for e in tm2.tail(4) if e["ev"] == "gauges"]
+        want_g = set(membership.HEARTBEAT_GAUGES)
+        if not beats or not want_g <= set(beats[-1]):
+            errors.append((MEMBERSHIP_PATH,
+                           f"WorkerLease.beat streamed no gauges event "
+                           f"carrying HEARTBEAT_GAUGES {sorted(want_g)}"))
+        if set(tm2.gauges) != want_g:
+            errors.append((MEMBERSHIP_PATH,
+                           f"WorkerLease.beat gauges {sorted(tm2.gauges)} "
+                           f"!= HEARTBEAT_GAUGES {sorted(want_g)}"))
+    if telemetry_report is not None:
+        tracked = set(getattr(telemetry_report, "TRACKED_EVENTS", ()))
+        want = set(getattr(membership, "MEMBERSHIP_EVENTS", ())) if \
+            membership is not None else set()
+        if chaos is not None:
+            want.add(chaos.FAULT_EVENT)
+        missing = sorted(want - tracked)
+        if missing:
+            errors.append((REPORT_PATH,
+                           f"TRACKED_EVENTS is missing membership/chaos "
+                           f"event kind(s) {missing} — the chaos gate's "
+                           "leave/join matching would silently drop them"))
+        counters = set(getattr(telemetry_report, "TRACE_COUNTER_KEYS", ()))
+        hb = set(getattr(membership, "HEARTBEAT_GAUGES", ())) if \
+            membership is not None else set()
+        if hb and not hb <= counters:
+            errors.append((REPORT_PATH,
+                           f"TRACE_COUNTER_KEYS is missing heartbeat "
+                           f"gauge(s) {sorted(hb - counters)} — the "
+                           "Perfetto export would not render liveness"))
+    return errors
+
+
+def _load_by_path(relpath: str, name: str):
+    """A probed module loaded by FILE path — for modules that are not
+    importable in the lint CLI's jax-free process through the synthetic
+    package (scripts are not package modules; ``parallel/__init__``
+    imports jax, so ``parallel/membership.py`` — itself stdlib-only at
+    module scope by contract — loads this way too).  None when absent or
+    broken (the parse step flags a syntax error as a normal finding; the
+    probe just skips its cross-checks)."""
     root = os.path.dirname(os.path.dirname(os.path.dirname(
         os.path.dirname(os.path.abspath(__file__)))))
-    path = os.path.join(root, "scripts", "telemetry_report.py")
+    path = os.path.join(root, relpath)
     if not os.path.exists(path):
         return None
     import importlib.util
     try:
-        spec = importlib.util.spec_from_file_location(
-            "_tpulint_telemetry_report", path)
+        spec = importlib.util.spec_from_file_location(name, path)
         mod = importlib.util.module_from_spec(spec)
         spec.loader.exec_module(mod)
     except Exception:
-        # a broken script must not crash the whole lint run — the parse
-        # step flags its syntax error as a normal finding; this probe
-        # just skips its cross-checks
         return None
     return mod
+
+
+def _load_telemetry_report():
+    return _load_by_path(os.path.join("scripts", "telemetry_report.py"),
+                         "_tpulint_telemetry_report")
 
 
 @register
@@ -227,8 +302,19 @@ class SchemaDriftChecker(Checker):
             from theanompi_tpu.utils import devprof, sentry
         except ImportError:
             devprof = sentry = None
+        report = _load_telemetry_report()
         if devprof is not None and sentry is not None:
             errors += device_schema_errors(devprof, sentry, telemetry,
-                                           _load_telemetry_report())
+                                           report)
+        # membership/chaos by file path: parallel/__init__ imports jax,
+        # which the lint CLI's no-backend contract forbids
+        membership = _load_by_path(
+            os.path.join("theanompi_tpu", "parallel", "membership.py"),
+            "_tpulint_membership")
+        chaos = _load_by_path(
+            os.path.join("theanompi_tpu", "utils", "chaos.py"),
+            "_tpulint_chaos")
+        errors += membership_schema_errors(membership, chaos, telemetry,
+                                           report)
         return [Finding(self.name, path, 1, 0, msg)
                 for path, msg in errors]

@@ -64,11 +64,42 @@ def main(argv=None) -> int:
                    help="run the worker as a supervised subprocess and "
                         "restart it (with resume=true) up to N times on "
                         "crash — pair with ckpt_dir for checkpoint-based "
-                        "recovery (single-host)")
+                        "recovery (single-host); restarts back off "
+                        "exponentially (--backoff) and a crash loop "
+                        "(--crash-limit failures within --crash-window) "
+                        "exits nonzero with the flight-recorder tail")
     p.add_argument("--min-uptime", type=float, default=0.0, metavar="SEC",
                    help="crash-loop guard: a nonzero exit within SEC "
                         "seconds is treated as unrecoverable (config/usage "
                         "error) and is NOT retried; 0 = always retry")
+    p.add_argument("--backoff", type=float, default=1.0, metavar="SEC",
+                   help="supervised-restart backoff base: delay before "
+                        "restart N is min(SEC·2^N, --backoff-max) ±25%% "
+                        "jitter (the bench probe-recovery pattern); "
+                        "0 = immediate restarts")
+    p.add_argument("--backoff-max", type=float, default=30.0, metavar="SEC",
+                   help="supervised-restart backoff cap (default 30)")
+    p.add_argument("--crash-limit", type=int, default=5, metavar="N",
+                   help="crash-loop breaker: N worker failures within "
+                        "--crash-window seconds exit nonzero immediately "
+                        "with the flight-recorder tail printed instead of "
+                        "burning the remaining restarts (default 5)")
+    p.add_argument("--crash-window", type=float, default=300.0,
+                   metavar="SEC",
+                   help="crash-loop breaker window (default 300)")
+    p.add_argument("--elastic", type=int, default=0, metavar="N",
+                   help="elastic membership mode (easgd/asgd): spawn N "
+                        "island workers around a center server under the "
+                        "membership controller — dead/preempted workers "
+                        "leave and rejoin WITHOUT stopping the run "
+                        "(parallel/membership.py; BSP instead uses "
+                        "--supervise world restarts)")
+    p.add_argument("--elastic-steps", type=int, default=256, metavar="K",
+                   help="elastic mode: local steps per worker before a "
+                        "clean exit (default 256)")
+    p.add_argument("--host-devices", type=int, default=0, metavar="K",
+                   help="elastic mode, CPU venue: each worker simulates K "
+                        "chips on the cpu backend (0 = real hardware)")
     p.add_argument("--compile-cache", default=None, metavar="DIR",
                    help="persistent AOT executable cache dir "
                         "(utils/compile_cache): compile_iter_fns "
@@ -117,16 +148,36 @@ def main(argv=None) -> int:
             return 0
         return subprocess.call(cmds[args.process_id])
 
+    if args.elastic > 0:
+        # Elastic membership (parallel/membership.py): workers join/leave
+        # mid-run; the async center algebra absorbs the churn — no
+        # world restart.  BSP has no shrink reaction: refuse early.
+        from .parallel.membership import parse_kv, run_elastic
+        return run_elastic(args.rule, args.modelfile, args.modelclass,
+                           parse_kv(kv), args.elastic,
+                           steps=args.elastic_steps,
+                           host_devices=args.host_devices)
+
     if args.supervise > 0:
         # Failure recovery (SURVEY §5): the worker runs as a subprocess so a
         # crash (or a watchdog-triggered exit) doesn't take the supervisor
-        # down; each restart resumes from the latest per-epoch checkpoint.
+        # down; each restart resumes — after a bounded-backoff wait — from
+        # the latest *valid* per-epoch checkpoint (utils/checkpoint's
+        # crash-atomic writes + newest-valid fallback make a SIGKILL
+        # mid-save unable to brick the resume).
         if not any(c.startswith("ckpt_dir=") for c in kv):
             print("warning: --supervise without ckpt_dir= restarts training "
                   "from scratch each time", file=sys.stderr)
         base = compose_worker_cmd(args.rule, args.modelfile, args.modelclass,
                                   kv)
         import time as _time
+
+        from .parallel.membership import (Backoff, CrashLoopBreaker,
+                                          flight_tail_lines)
+        backoff = Backoff(base=args.backoff, cap=args.backoff_max) \
+            if args.backoff > 0 else None
+        breaker = CrashLoopBreaker(limit=args.crash_limit,
+                                   window_s=args.crash_window)
 
         def sweep(attempt: int, rc: int) -> None:
             # a dead worker's flight recordings (utils/telemetry dumps
@@ -140,6 +191,11 @@ def main(argv=None) -> int:
                                       f"attempt{attempt}_rc{rc}")
             if dest:
                 print(f"swept flight recordings to {dest}", file=sys.stderr)
+
+        def print_flight_tail() -> None:
+            if record_dir:
+                for line in flight_tail_lines(record_dir):
+                    print(line, file=sys.stderr)
 
         rc = 1
         for attempt in range(args.supervise + 1):
@@ -155,9 +211,23 @@ def main(argv=None) -> int:
                       f"(< --min-uptime {args.min_uptime}s) — treating as "
                       f"unrecoverable, not retrying", file=sys.stderr)
                 return rc
+            if breaker.record_failure():
+                # systemic failure (bad config, poisoned state, dead
+                # backend): retrying just hides it — stop with evidence
+                print(f"crash loop: {args.crash_limit} failures within "
+                      f"{args.crash_window:.0f}s — giving up (rc={rc})",
+                      file=sys.stderr)
+                print_flight_tail()
+                return rc
             if attempt < args.supervise:
-                print(f"worker exited rc={rc}; restarting "
+                delay = backoff.delay(attempt) if backoff else 0.0
+                print(f"worker exited rc={rc}; restarting in {delay:.1f}s "
                       f"({attempt + 1}/{args.supervise})", file=sys.stderr)
+                if delay:
+                    _time.sleep(delay)
+        print(f"supervised restarts exhausted ({args.supervise}) — "
+              f"giving up (rc={rc})", file=sys.stderr)
+        print_flight_tail()
         return rc
 
     # single host: in-process (no spawn needed — the mesh IS the workers)

@@ -85,8 +85,43 @@ class ElasticCenter:
         self._lock = threading.Lock()
         self.n_updates = 0            # exchanges absorbed (all islands)
         self.updates_by_island: Dict[int, int] = {}
+        # elastic membership (parallel/membership.py): a demoted island's
+        # pushes are DROPPED (counted below) while its pulls still serve —
+        # it keeps training locally, can't pollute the center, and on
+        # readmit its next pull restores it from the consensus
+        self.demoted: set = set()
+        self.dropped_by_island: Dict[int, int] = {}
         if params is not None:
             self.ensure_init(params)
+
+    # -- membership (elastic demote/readmit) --------------------------------
+
+    def demote_island(self, island: int) -> None:
+        with self._lock:
+            self.demoted.add(int(island))
+
+    def readmit_island(self, island: int) -> None:
+        with self._lock:
+            self.demoted.discard(int(island))
+
+    def stats_snapshot(self) -> Dict[str, object]:
+        """Consistent copy of the bookkeeping under the lock — the socket
+        server's ``stats`` op serializes this while other handler threads
+        mutate the live sets."""
+        with self._lock:
+            return {"n_updates": self.n_updates,
+                    "by_island": dict(self.updates_by_island),
+                    "demoted": sorted(self.demoted),
+                    "dropped_by_island": dict(self.dropped_by_island)}
+
+    def _drop_if_demoted(self, island: int) -> bool:
+        """Caller holds the lock.  True = this push is from a demoted (or
+        departed-and-not-readmitted) island and must be dropped."""
+        if int(island) in self.demoted:
+            self.dropped_by_island[int(island)] = \
+                self.dropped_by_island.get(int(island), 0) + 1
+            return True
+        return False
 
     # -- pytree interface (in-process islands) -----------------------------
 
@@ -146,6 +181,8 @@ class ElasticCenter:
                           island: int) -> None:
         a = self.alpha
         with self._lock:
+            if self._drop_if_demoted(island):
+                return
             self._check_leaves(deltas)
             self._leaves = [c + a * np.asarray(d, np.float32)
                             for c, d in zip(self._leaves, deltas)]
@@ -156,6 +193,11 @@ class ElasticCenter:
     def push_pull_leaves(self, deltas: List[np.ndarray],
                          island: int) -> List[np.ndarray]:
         with self._lock:
+            if self._drop_if_demoted(island):
+                # the pull half still serves: the demoted island resets to
+                # the (unpolluted) center and keeps training locally
+                self._check_leaves(deltas)
+                return [np.array(x) for x in self._leaves]
             self._check_leaves(deltas)
             self._leaves = [c + np.asarray(d, np.float32)
                             for c, d in zip(self._leaves, deltas)]
@@ -177,7 +219,8 @@ class IslandRunner(threading.Thread):
     def __init__(self, island_id: int, model_factory: Callable, config: dict,
                  center: ElasticCenter, sync_freq: int,
                  stop_event: threading.Event,
-                 throttle_s: float = 0.0, rule: str = "easgd"):
+                 throttle_s: float = 0.0, rule: str = "easgd",
+                 lease=None):
         super().__init__(daemon=True)
         self.island_id = island_id
         self.config = config
@@ -186,6 +229,7 @@ class IslandRunner(threading.Thread):
         self.stop_event = stop_event
         self.throttle_s = float(throttle_s)   # test hook: deliberate straggler
         self.rule = rule                      # 'easgd' elastic | 'asgd' downpour
+        self.lease = lease                    # membership.WorkerLease | None
         self.steps_done = 0
         self.exchanges_done = 0
         self.error: Optional[BaseException] = None
@@ -211,6 +255,25 @@ class IslandRunner(threading.Thread):
         n = mesh.shape[WORKER_AXIS]
         alpha = self.center.alpha
 
+        def _rebox_center(center):
+            return jax.tree.map(
+                lambda c: np.broadcast_to(np.asarray(c, np.float32)[None],
+                                          (n,) + np.shape(c)), center)
+
+        def _set_params_from(center):
+            model.step_state["params"] = jax.tree.map(
+                lambda x, like: jax.device_put(
+                    np.asarray(x, like.dtype), like.sharding),
+                _rebox_center(center), model.step_state["params"])
+
+        if self.config.get("center_restore", False):
+            # elastic rejoin (membership.py): a (re)joining worker restores
+            # its replica from the live center — on a FRESH center this is
+            # an identity (ensure_init seeded it from these very params),
+            # on a rejoin it replaces the stale/initial replica with the
+            # consensus the surviving workers kept training
+            _set_params_from(self.center.pull())
+
         # Jitted elastic update: (boxed params, replicated center) ->
         # (boxed new params, boxed per-worker deltas summed on host later).
         def elastic(params_boxed, center):
@@ -229,11 +292,6 @@ class IslandRunner(threading.Thread):
         def worker_mean(params_boxed):
             return jax.tree.map(lambda x: jnp.mean(x, axis=0), params_boxed)
 
-        def rebox(center):
-            return jax.tree.map(
-                lambda c: np.broadcast_to(np.asarray(c, np.float32)[None],
-                                          (n,) + np.shape(c)), center)
-
         mean_fn = jax.jit(worker_mean)
         # ASGD anchor is captured at START (== the init center), not lazily
         # at the first exchange: a concurrent island's push landing before
@@ -246,6 +304,8 @@ class IslandRunner(threading.Thread):
             count += 1
             model.train_iter(count, None)
             self.steps_done += 1
+            if self.lease is not None:
+                self.lease.beat(self.steps_done)
             if self.throttle_s:
                 time.sleep(self.throttle_s)
             if count % self.sync_freq == 0:
@@ -254,9 +314,7 @@ class IslandRunner(threading.Thread):
                         model.step_state["params"]))
                     delta = jax.tree.map(np.subtract, mean_p, anchor)
                     anchor = self.center.push_pull(delta, self.island_id)
-                    model.step_state["params"] = jax.tree.map(
-                        lambda x, like: jax.device_put(x, like.sharding),
-                        rebox(anchor), model.step_state["params"])
+                    _set_params_from(anchor)
                 else:
                     center = self.center.pull()
                     new_params, delta_mean = elastic_fn(
@@ -343,11 +401,20 @@ class AsyncEASGDTrainer:
 
     def start(self, throttle: Optional[Dict[int, float]] = None) -> None:
         throttle = throttle or {}
+        lease_dir = self.config.get("lease_dir")
         for i in range(self.n_islands):
+            lease = None
+            if lease_dir:
+                # per-island heartbeat lease (parallel/membership.py) — the
+                # membership controller's liveness signal; island ids are
+                # the worker ids so they stay unique across processes
+                from .membership import WorkerLease
+                lease = WorkerLease(lease_dir, self._island_base + i)
             r = IslandRunner(self._island_base + i, self.model_factory,
                              self._island_config(i),
                              self.center, self.sync_freq, self.stop_event,
-                             throttle_s=throttle.get(i, 0.0), rule=self.rule)
+                             throttle_s=throttle.get(i, 0.0), rule=self.rule,
+                             lease=lease)
             self.islands.append(r)
             r.start()
 

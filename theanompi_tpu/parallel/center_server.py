@@ -24,7 +24,9 @@ knowledge; the server never needs it (its algebra is leafwise).
 Ops: ``init`` (idempotent center seed), ``pull`` → center leaves,
 ``push`` (EASGD: center += α·delta_mean), ``push_pull`` (ASGD downpour:
 center += delta_mean, returns the fresh center atomically — the reference's
-accumulated-gradient round-trip), ``stats``.
+accumulated-gradient round-trip), ``demote``/``readmit`` (elastic
+membership: a demoted island's pushes are dropped, pulls still serve —
+``parallel/membership.py``), ``stats``.
 """
 
 from __future__ import annotations
@@ -139,11 +141,17 @@ class CenterServer:
                         _unpack_leaves(body), int(header["island"]))
                     _send_msg(self.request, {"ok": True},
                               _pack_leaves(leaves))
+                elif op == "demote":
+                    # elastic membership (parallel/membership.py): further
+                    # pushes from this island are dropped at the center
+                    center.demote_island(int(header["island"]))
+                    _send_msg(self.request, {"ok": True})
+                elif op == "readmit":
+                    center.readmit_island(int(header["island"]))
+                    _send_msg(self.request, {"ok": True})
                 elif op == "stats":
-                    _send_msg(self.request, {
-                        "ok": True,
-                        "n_updates": center.n_updates,
-                        "by_island": center.updates_by_island})
+                    _send_msg(self.request,
+                              {"ok": True, **center.stats_snapshot()})
                 else:
                     _send_msg(self.request,
                               {"ok": False, "error": f"unknown op {op!r}"})
@@ -213,6 +221,12 @@ class RemoteCenter:
                                   _pack_leaves(leaves))
         assert self._treedef is not None, "push_pull before ensure_init"
         return jax.tree.unflatten(self._treedef, _unpack_leaves(body))
+
+    def demote_island(self, island: int) -> None:
+        self._roundtrip({"op": "demote", "island": int(island)})
+
+    def readmit_island(self, island: int) -> None:
+        self._roundtrip({"op": "readmit", "island": int(island)})
 
     def stats(self) -> dict:
         resp, _ = self._roundtrip({"op": "stats"})

@@ -117,6 +117,12 @@ class Exchanger:
         # scanned multi-step train dispatch (steps_per_call > 1): the
         # Python exchange() hook then must not run the collective again.
         self.fused = False
+        # elastic membership (parallel/membership.py): None = every rank
+        # participates; a tuple of rank ids = the ACTIVE set after a
+        # straggler demotion / host loss — demoted ranks train locally,
+        # issue the same collectives (SPMD lockstep demands it) but
+        # contribute nothing and keep their replica bit-unchanged.
+        self._active_ranks: Optional[tuple] = None
 
     # -- wiring ------------------------------------------------------------
 
@@ -131,6 +137,56 @@ class Exchanger:
         whole rule already lives inside the train step (BSP grads mode)
         and there is no cadence to fuse or hook."""
         return False
+
+    # -- elastic membership (parallel/membership.py) ------------------------
+
+    def supports_elastic(self) -> bool:
+        """True when this rule's exchange algebra tolerates membership
+        change (the async rules: per-worker push-pull/gossip).  False
+        (BSP) means the reaction to a lost/straggling worker is a
+        supervised world restart at the committed window cursor — there is
+        no barrier-free way to shrink an allreduce's contract."""
+        return False
+
+    def set_active_ranks(self, active) -> None:
+        """Shrink/re-grow the participating worker set WITHOUT stopping
+        the run: regenerate the rule's peer topology (GoSGD routing
+        tables, EASGD/ASGD collective masks) over ``active`` and rebuild
+        the standalone collective.  ``active=None`` (or the full range)
+        restores full membership.  Demoted ranks keep training locally
+        with their replicas bit-unchanged by exchanges, so a readmitted
+        worker re-enters the mixing with whatever it has — the elastic
+        algebra pulls it back to consensus.  When the cadence is fused
+        into the multi-step dispatch the caller must also recompile the
+        model (``MeshReactor`` does)."""
+        if not self.supports_elastic():
+            raise NotImplementedError(
+                f"{type(self).__name__} ({self.name}) cannot shrink its "
+                f"membership — BSP-family rules react to host loss via "
+                f"`launcher --supervise` world restart (docs/design.md "
+                f"§14 reaction matrix)")
+        assert self.mesh is not None, \
+            "set_active_ranks before prepare()"
+        n = self.mesh.shape[WORKER_AXIS]
+        if active is None:
+            self._active_ranks = None
+        else:
+            act = tuple(sorted({int(a) for a in active}))
+            assert act and all(0 <= a < n for a in act), (
+                f"active ranks {act} outside the {n}-worker mesh (or "
+                f"empty) — at least one worker must remain active")
+            self._active_ranks = None if len(act) == n else act
+        # regenerated prepare(): routing tables / masks / the jitted
+        # standalone collective are all rebuilt for the new active set
+        self.prepare(self.mesh, self.model)
+
+    def active_mask(self) -> np.ndarray:
+        """``[size]`` float32 participation mask (1 = active)."""
+        mask = np.ones(self.size, np.float32)
+        if self._active_ranks is not None:
+            mask[:] = 0.0
+            mask[list(self._active_ranks)] = 1.0
+        return mask
 
     def exchange_body(self, state, key, count):
         """The rule's exchange algebra as a PURE per-worker function:
@@ -455,16 +511,34 @@ class EASGD_Exchanger(Exchanger):
     def has_exchange(self) -> bool:
         return True
 
+    def supports_elastic(self) -> bool:
+        return True
+
     def exchange_body(self, state, key, count):
         axis, alpha = WORKER_AXIS, self.alpha
         params = steps.unbox(state["params"])
         extra = steps.unbox(state["extra"])
         center = extra["center"]
         delta = jax.tree.map(lambda p, c: p - c, params, center)
-        mean_delta = jax.tree.map(lambda d: lax.pmean(d, axis), delta)
+        # elastic membership: demoted ranks contribute zero to the center
+        # mean and skip the elastic pull (their replica is bit-unchanged),
+        # while still issuing the SAME psum — SPMD lockstep demands every
+        # rank run every collective.  Full membership traces the exact
+        # pmean algebra (psum / size IS lax.pmean's definition).
+        active = self._active_ranks
+        ridx = lax.axis_index(axis)      # uniform; hoisted out of the arms
+        if active is None:
+            contrib, pull, n_act = delta, 1.0, float(self.size)
+        else:
+            m = jnp.asarray(self.active_mask())[ridx]
+            contrib = jax.tree.map(lambda d: d * m, delta)
+            pull, n_act = m, float(len(active))
+        mean_delta = jax.tree.map(lambda d: lax.psum(d, axis) / n_act,
+                                  contrib)
         new_center = jax.tree.map(lambda c, d: c + alpha * d,
                                   center, mean_delta)
-        new_params = jax.tree.map(lambda p, d: p - alpha * d, params, delta)
+        new_params = jax.tree.map(lambda p, d: p - alpha * pull * d,
+                                  params, delta)
         extra = dict(extra, center=new_center)
         return dict(state, params=steps.box(new_params),
                     extra=steps.box(extra))
@@ -503,16 +577,38 @@ class ASGD_Exchanger(Exchanger):
     def has_exchange(self) -> bool:
         return True
 
+    def supports_elastic(self) -> bool:
+        return True
+
     def exchange_body(self, state, key, count):
         axis = WORKER_AXIS
         params = steps.unbox(state["params"])
         extra = steps.unbox(state["extra"])
         center = extra["center"]
-        delta_sum = jax.tree.map(
-            lambda p, c: lax.psum(p - c, axis), params, center)
+        # elastic membership: the center absorbs only ACTIVE workers'
+        # accumulated deltas, and only active workers reset to the fresh
+        # center — a demoted worker keeps its local replica bit-unchanged
+        # (one uniform psum either way; SPMD lockstep).
+        ridx = lax.axis_index(axis)      # uniform; hoisted out of the arms
+        gate = None
+        if self._active_ranks is not None:
+            gate = jnp.asarray(self.active_mask())[ridx]
+
+        def leaf_sum(p, c):
+            d = p - c
+            if gate is not None:
+                d = d * gate
+            return lax.psum(d, axis)
+
+        delta_sum = jax.tree.map(leaf_sum, params, center)
         new_center = jax.tree.map(jnp.add, center, delta_sum)
+        if gate is None:
+            new_params = new_center
+        else:
+            new_params = jax.tree.map(
+                lambda c, p: jnp.where(gate > 0, c, p), new_center, params)
         extra = dict(extra, center=new_center)
-        return dict(state, params=steps.box(new_center),
+        return dict(state, params=steps.box(new_params),
                     extra=steps.box(extra))
 
     def prepare(self, mesh: Mesh, model) -> None:
@@ -637,28 +733,51 @@ class GOSGD_Exchanger(Exchanger):
     def has_exchange(self) -> bool:
         return True
 
+    def supports_elastic(self) -> bool:
+        return True
+
     def prepare(self, mesh: Mesh, model) -> None:
         super().prepare(mesh, model)
         axis, n = WORKER_AXIS, self.size
-        n_bits = max(1, int(np.ceil(np.log2(max(n, 2)))))
+        # elastic membership: gossip draws route only among the ACTIVE
+        # ranks — a demoted rank is a fixed point of every routing table
+        # (its send gate is also forced off in exchange_body), so its α
+        # and replica are untouched until readmission regenerates the
+        # tables with it back in.  Full membership is the identity
+        # embedding: active == range(n).
+        active = list(self._active_ranks) if self._active_ranks is not None \
+            else list(range(n))
+        m = len(active)
+        n_bits = max(1, int(np.ceil(np.log2(max(m, 2)))))
         if self.peers_mode == "perm":
-            perms = self._derangements(n, self.n_perms,
-                                       seed=0x605 + self.family_seed)
+            sub_perms = self._derangements(m, self.n_perms,
+                                           seed=0x605 + self.family_seed)
+            perms = np.tile(np.arange(n), (len(sub_perms), 1))
+            for r, sp in enumerate(sub_perms):
+                for i, a in enumerate(active):
+                    perms[r][a] = active[int(sp[i])]
         elif self.peers_mode == "iid":
-            iid_maps = self._iid_maps(n, self.n_perms,
+            sub_maps = self._iid_maps(m, self.n_perms,
                                       seed=0x1d1 + self.family_seed)
+            iid_maps = np.tile(np.arange(n), (len(sub_maps), 1))
+            for r, sm in enumerate(sub_maps):
+                for i, a in enumerate(active):
+                    iid_maps[r][a] = active[int(sm[i])]
         mode = self.peers_mode
         assert mode in ("perm", "shift", "iid"), (
             f"unknown gosgd_peers={mode!r}; have 'perm', 'shift', 'iid'")
 
         def route_shift(payload, step_key):
-            """Shared ring-shift: log₂N conditional power-of-two hops."""
-            shift = jax.random.randint(step_key, (), 1, n) if n > 1 \
+            """Shared ring-shift over the ACTIVE sub-ring: log₂M
+            conditional power-of-two hops (inactive ranks receive zeros —
+            their zero payload contributes nothing either way)."""
+            shift = jax.random.randint(step_key, (), 1, m) if m > 1 \
                 else jnp.ones((), jnp.int32)
 
             def hop(payload, k):
                 stride = 1 << k
-                perm = [(i, (i + stride) % n) for i in range(n)]
+                perm = [(active[j], active[(j + stride) % m])
+                        for j in range(m)]
                 moved = jax.tree.map(
                     lambda x: lax.ppermute(x, axis, perm), payload)
                 take = ((shift >> k) & 1) == 1
@@ -670,7 +789,8 @@ class GOSGD_Exchanger(Exchanger):
             return payload
 
         def route_perm(payload, step_key):
-            """One of K static derangements, picked by a replicated index."""
+            """One of K static derangements (of the active set), picked by
+            a replicated index."""
             if n == 1:
                 return payload
             kidx = jax.random.randint(step_key, (), 0, len(perms))
@@ -707,9 +827,10 @@ class GOSGD_Exchanger(Exchanger):
 
             return lax.switch(kidx, [mk(d) for d in iid_maps], payload)
 
-        # routing tables are static per (mesh size, mode, family seed) —
-        # pre-built here so exchange_body stays a pure traced function
-        # whichever dispatch shape (standalone / in-scan fused) traces it
+        # routing tables are static per (mesh size, mode, family seed,
+        # active set) — pre-built here so exchange_body stays a pure traced
+        # function whichever dispatch shape (standalone / in-scan fused)
+        # traces it; set_active_ranks re-runs prepare to regenerate them
         self._route = {"perm": route_perm, "shift": route_shift,
                        "iid": route_iid}[mode]
         self._build_exchange_fn()
@@ -727,9 +848,15 @@ class GOSGD_Exchanger(Exchanger):
         alpha = extra["alpha"]
         ridx = lax.axis_index(axis)
         step_key = jax.random.fold_in(key, count)
-        # Per-worker Bernoulli send gate
+        # Per-worker Bernoulli send gate; a demoted rank (elastic
+        # membership) never sends — its α mass would otherwise leak to a
+        # peer the restricted routing tables no longer deliver to
         send = jax.random.bernoulli(
             jax.random.fold_in(step_key, ridx), self.p_share)
+        amask = None if self._active_ranks is None else \
+            jnp.asarray(self.active_mask() > 0)[ridx]
+        if amask is not None:
+            send = jnp.logical_and(send, amask)
         w_send = jnp.where(send, alpha * 0.5, 0.0)
         w_keep = alpha - w_send
         msg = jax.tree.map(lambda p: p * w_send, params)
@@ -740,6 +867,13 @@ class GOSGD_Exchanger(Exchanger):
         new_alpha = w_keep + w_recv
         new_params = jax.tree.map(
             lambda p, m: (w_keep * p + m) / new_alpha, params, recv_msg)
+        if amask is not None:
+            # demoted ranks are bit-frozen (the (α·p)/α round-trip is not
+            # exact in floats): keep p and α verbatim off the active set
+            new_alpha = jnp.where(amask, new_alpha, alpha)
+            new_params = jax.tree.map(
+                lambda np_, p_: jnp.where(amask, np_, p_),
+                new_params, params)
         extra = dict(extra, alpha=new_alpha)
         return dict(state, params=steps.box(new_params),
                     extra=steps.box(extra))

@@ -1,0 +1,891 @@
+"""Elastic membership: heartbeat leases, straggler demotion, rule-aware
+reactions.
+
+``launcher --supervise`` restarts a dead WORLD; production TPU pods lose
+*individual* hosts to preemption as a routine event (PAPERS.md, 2204.06514).
+The async rules were built for exactly this — EASGD/ASGD/GoSGD tolerate
+workers arriving late or dropping out because their algebra is per-worker
+push-pull/gossip, not a world-sized barrier (PAPERS.md, 1605.08325) — so
+this module promotes the supervisor into an **elastic membership
+controller**:
+
+* **Leases** — each worker heartbeats a small JSON lease file
+  (``<lease_dir>/lease_w{id}.json``, atomic tmp+rename) and mirrors the
+  beat into the telemetry event stream as the ``heartbeat.iter`` gauge.
+  A lease older than ``lease_timeout`` means the worker is dead or wedged
+  (a SIGSTOPped process stops beating without exiting — the chaos
+  harness's ``stop`` fault).
+* **:class:`MembershipController`** — the worker-state machine: ``poll()``
+  folds lease files and process observations into ``worker_join`` /
+  ``worker_leave`` / ``worker_demote`` transitions (each one telemetry
+  event + reactor callbacks), and ``check_stragglers()`` closes the loop
+  with ``scripts/telemetry_report.py``'s windowed straggler ranking —
+  a rank that straggles ``straggle_windows`` windows is demoted from the
+  active set instead of dragging the run.
+* **Reactors** — the rule reaction matrix (docs/design.md §14):
+  :class:`CenterReactor` demotes/readmits islands at the EASGD/ASGD
+  center (a demoted island's pushes are dropped, its pulls still serve so
+  it can keep training locally and recover); :class:`MeshReactor` drives
+  an in-mesh exchanger's ``set_active_ranks`` (GoSGD gossip topologies
+  regenerated without the demoted rank, EASGD/ASGD collective masks).
+  BSP has no shrink reaction — a membership change there is a supervised
+  bounded-backoff world restart resuming at the committed window cursor
+  (``launcher --supervise``).
+* **:class:`ElasticSupervisor`** — spawns worker subprocesses, detects
+  death (exit OR lease expiry), respawns with :class:`Backoff` (bounded
+  exponential + jitter — the bench probe-recovery pattern), and trips a
+  :class:`CrashLoopBreaker` when failures cluster.  A rejoining worker
+  restores params from the center (``center_restore``), hits the AOT
+  cache, and re-enters at a window boundary.
+
+Module-scope imports are stdlib-only (the tpulint schema-drift checker
+probes the membership event vocabulary from a jax-free process); jax and
+the trainer machinery import lazily inside the worker entry points.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+from collections import deque
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+try:
+    from ..utils import telemetry
+except ImportError:        # file-path load (jax-free lint probe): absolute
+    from theanompi_tpu.utils import telemetry
+
+# The membership transition vocabulary — consumed by
+# scripts/telemetry_report.py (instant markers in the Perfetto export) and
+# pinned by the tpulint schema-drift checker.  A readmitted straggler
+# re-enters via ``worker_join`` with ``reason='readmit'``.
+MEMBERSHIP_EVENTS = ("worker_join", "worker_leave", "worker_demote")
+
+# Heartbeat gauge keys a WorkerLease.beat mirrors into the telemetry
+# stream (rendered as a per-rank counter track by the trace export).
+HEARTBEAT_GAUGES = ("heartbeat.iter",)
+
+
+# -- leases ------------------------------------------------------------------
+
+def lease_path(lease_dir: str, worker_id: int) -> str:
+    return os.path.join(lease_dir, f"lease_w{int(worker_id)}.json")
+
+
+def read_leases(lease_dir: str) -> Dict[int, dict]:
+    """All parseable lease docs, keyed by worker id.  A torn write can't
+    occur (writes are atomic) but a foreign/garbage file is skipped."""
+    out: Dict[int, dict] = {}
+    if not lease_dir or not os.path.isdir(lease_dir):
+        return out
+    for name in os.listdir(lease_dir):
+        if not (name.startswith("lease_w") and name.endswith(".json")):
+            continue
+        try:
+            with open(os.path.join(lease_dir, name)) as f:
+                doc = json.load(f)
+            out[int(doc["worker"])] = doc
+        except (ValueError, KeyError, OSError):
+            continue
+    return out
+
+
+class WorkerLease:
+    """Worker-side half of the lease contract: ``beat()`` refreshes the
+    lease file atomically and mirrors the step into the telemetry stream
+    (one ``gauges`` event carrying :data:`HEARTBEAT_GAUGES`); ``release()``
+    marks a CLEAN departure so the controller reports ``finished`` instead
+    of a lease expiry.
+
+    Safe to call every iteration: a beat within ``min_interval_s`` of the
+    last write is one ``time.time()`` check and nothing else (no file
+    write, no event), so the hot loop can beat wherever it already beats
+    the watchdog without a per-step I/O cost.  Status changes always
+    write."""
+
+    def __init__(self, lease_dir: str, worker_id: int, telemetry_=None,
+                 min_interval_s: float = 2.0):
+        self.lease_dir = str(lease_dir)
+        self.worker_id = int(worker_id)
+        self.telemetry = telemetry_ if telemetry_ is not None \
+            else telemetry.active()
+        self.min_interval_s = float(min_interval_s)
+        os.makedirs(self.lease_dir, exist_ok=True)
+        self._step = 0
+        self._last_write = 0.0
+
+    def beat(self, step: Optional[int] = None, status: str = "live",
+             **extra) -> None:
+        if step is not None:
+            self._step = int(step)
+        now = time.time()
+        if status == "live" and not extra and \
+                now - self._last_write < self.min_interval_s:
+            return
+        self._last_write = now
+        # full-precision ts: the controller's dead_ts guard compares this
+        # against a later time.time() — rounding could order an immediate
+        # respawn's first beat "before" the death it follows
+        doc = {"worker": self.worker_id, "pid": os.getpid(),
+               "ts": now, "step": self._step,
+               "status": status}
+        doc.update(extra)
+        path = lease_path(self.lease_dir, self.worker_id)
+        tmp = f"{path}.tmp{os.getpid()}"
+        try:
+            with open(tmp, "w") as f:
+                json.dump(doc, f)
+            os.replace(tmp, path)
+        except OSError:
+            pass                       # a heartbeat must never kill training
+        tm = self.telemetry
+        if tm.enabled:
+            tm.gauge("heartbeat.iter", self._step)
+            tm.event("gauges", **{"heartbeat.iter": self._step})
+
+    def release(self) -> None:
+        self.beat(status="left")
+
+
+# -- backoff / crash-loop breaker -------------------------------------------
+
+class Backoff:
+    """Bounded exponential backoff + jitter (the bench probe-recovery
+    pattern, PR 2): ``base·factor^attempt`` capped at ``cap``, scaled by a
+    uniform ``1 ± jitter`` draw so fleet-mates restarting against the same
+    dead resource don't retry in lockstep."""
+
+    def __init__(self, base: float = 1.0, factor: float = 2.0,
+                 cap: float = 30.0, jitter: float = 0.25, seed=None):
+        import random
+        self.base = float(base)
+        self.factor = float(factor)
+        self.cap = float(cap)
+        self.jitter = float(jitter)
+        self._rng = random.Random(seed)
+
+    def delay(self, attempt: int) -> float:
+        d = min(self.base * (self.factor ** max(0, int(attempt))), self.cap)
+        return d * (1.0 - self.jitter + 2.0 * self.jitter * self._rng.random())
+
+
+class CrashLoopBreaker:
+    """``limit`` failures inside a trailing ``window_s`` window mean the
+    failure is systemic (bad config, poisoned checkpoint, dead backend) —
+    retrying forever just hides it.  ``record_failure()`` returns True when
+    the breaker trips; the caller exits nonzero with the flight-recorder
+    tail printed."""
+
+    def __init__(self, limit: int = 5, window_s: float = 300.0):
+        self.limit = int(limit)
+        self.window_s = float(window_s)
+        self._times: deque = deque()
+
+    def record_failure(self, now: Optional[float] = None) -> bool:
+        now = time.time() if now is None else now
+        self._times.append(now)
+        while self._times and now - self._times[0] > self.window_s:
+            self._times.popleft()
+        return len(self._times) >= self.limit
+
+
+def flight_tail_lines(record_dir: str, n: int = 12) -> List[str]:
+    """The last ``n`` events of the NEWEST flight recording under
+    ``record_dir`` (crash sweeps included), formatted one per line — what a
+    crash-loop exit prints so the death isn't silent."""
+    import glob
+    paths = (glob.glob(os.path.join(record_dir, "flight_rank*.jsonl")) +
+             glob.glob(os.path.join(record_dir, "crash_*",
+                                    "flight_rank*.jsonl")))
+    if not paths:
+        return []
+    newest = max(paths, key=os.path.getmtime)
+    lines: List[str] = [f"flight tail ({newest}):"]
+    try:
+        with open(newest) as f:
+            raw = [ln.strip() for ln in f if ln.strip()]
+    except OSError:
+        return []
+    for ln in raw[-n:]:
+        try:
+            ev = json.loads(ln)
+        except ValueError:
+            continue
+        detail = {k: v for k, v in ev.items()
+                  if k not in ("ts", "run", "rank", "ev")}
+        lines.append(f"  ts={ev.get('ts')} rank={ev.get('rank')} "
+                     f"{ev.get('ev')} {detail}")
+    return lines
+
+
+# -- reactors (the rule reaction matrix) ------------------------------------
+
+class Reactor:
+    """Rule-side hooks the controller drives on each transition.  The base
+    is all no-ops so a controller can run observation-only."""
+
+    def on_join(self, worker: int, info: dict) -> None:
+        pass
+
+    def on_leave(self, worker: int, info: dict) -> None:
+        pass
+
+    def on_demote(self, worker: int, info: dict) -> None:
+        pass
+
+    def on_readmit(self, worker: int, info: dict) -> None:
+        pass
+
+
+class CenterReactor(Reactor):
+    """EASGD/ASGD shrink without stopping: a left/demoted island's pushes
+    are DROPPED at the center (zombie pushes from a half-dead process can't
+    pollute it) while pulls still serve — the island keeps training locally
+    and, on readmit/rejoin, restores from the center and re-enters."""
+
+    def __init__(self, center):
+        self.center = center
+
+    def on_leave(self, worker, info):
+        self.center.demote_island(worker)
+
+    def on_demote(self, worker, info):
+        self.center.demote_island(worker)
+
+    def on_join(self, worker, info):
+        self.center.readmit_island(worker)
+
+    def on_readmit(self, worker, info):
+        self.center.readmit_island(worker)
+
+
+class MeshReactor(Reactor):
+    """In-mesh (SPMD) shrink: regenerate the exchanger's peer topology
+    without the demoted rank — GoSGD gossip draws route only among active
+    ranks, EASGD/ASGD mask the demoted rank out of the center collective.
+    When the exchange cadence is fused into the multi-step dispatch the
+    model is recompiled so the in-scan body picks up the new topology (an
+    AOT-cache hit makes that seconds, PR 3)."""
+
+    def __init__(self, exchanger, model=None):
+        self.exchanger = exchanger
+        self.model = model
+        self.demoted: set = set()
+
+    def _apply(self) -> None:
+        size = getattr(self.exchanger, "size", None)
+        assert size, "MeshReactor needs a prepared exchanger"
+        active = [r for r in range(size) if r not in self.demoted]
+        self.exchanger.set_active_ranks(active)
+        if getattr(self.exchanger, "fused", False):
+            # the in-scan fused cadence embeds the OLD topology until the
+            # model recompiles — skipping it silently would keep mixing
+            # the demoted rank with no error
+            assert self.model is not None, (
+                "MeshReactor on a fused-cadence exchanger needs the model "
+                "(MeshReactor(exchanger, model=...)) so the in-scan "
+                "exchange body can be recompiled for the new active set")
+            self.model.compile_iter_fns(self.exchanger)
+
+    def on_demote(self, worker, info):
+        self.demoted.add(int(worker))
+        self._apply()
+
+    def on_leave(self, worker, info):
+        self.demoted.add(int(worker))
+        self._apply()
+
+    def on_join(self, worker, info):
+        self.demoted.discard(int(worker))
+        self._apply()
+
+    def on_readmit(self, worker, info):
+        self.demoted.discard(int(worker))
+        self._apply()
+
+
+# -- the controller ----------------------------------------------------------
+
+_REPORT_MODULE: Any = None          # module-level cache: exec once/process
+
+
+def _load_report_module():
+    """``scripts/telemetry_report.py`` by FILE path (a script, not a
+    package module; stdlib-only by contract) — the ONE windowed straggler
+    ranking, not a re-implementation.  Cached after the first load (the
+    supervisor polls it); None — with ONE stderr warning, since a silent
+    None quietly disables straggler demotion — when absent/broken."""
+    global _REPORT_MODULE
+    if _REPORT_MODULE is not None:
+        return _REPORT_MODULE if _REPORT_MODULE is not False else None
+    root = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    path = os.path.join(root, "scripts", "telemetry_report.py")
+    import importlib.util
+    try:
+        spec = importlib.util.spec_from_file_location(
+            "_membership_telemetry_report", path)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+    except Exception as e:
+        print(f"membership: scripts/telemetry_report.py unavailable "
+              f"({e!r}) — straggler demotion disabled", file=sys.stderr)
+        _REPORT_MODULE = False
+        return None
+    _REPORT_MODULE = mod
+    return mod
+
+
+class MembershipController:
+    """The worker-state machine behind the elastic runtime.
+
+    States per worker: ``live`` → (``demoted`` ⇄ ``live``) → ``dead`` /
+    ``left``; every transition emits its :data:`MEMBERSHIP_EVENTS` event
+    (tagged with the worker id and a reason) and fans out to the
+    ``reactors``.  The controller is transport-agnostic: the
+    :class:`ElasticSupervisor` feeds it process observations, ``poll()``
+    folds in lease files, and in-process (SPMD) use drives
+    ``demote``/``readmit`` directly from the straggler ranking."""
+
+    def __init__(self, lease_dir: Optional[str] = None,
+                 lease_timeout: float = 15.0, telemetry_=None,
+                 reactors: Sequence[Reactor] = (),
+                 record_dir: Optional[str] = None,
+                 straggle_windows: int = 3,
+                 straggle_window_s: float = 5.0,
+                 min_active: int = 1):
+        self.lease_dir = lease_dir
+        self.lease_timeout = float(lease_timeout)
+        self.telemetry = telemetry_ if telemetry_ is not None \
+            else telemetry.active()
+        self.reactors = list(reactors)
+        self.record_dir = record_dir
+        self.straggle_windows = int(straggle_windows)
+        self.straggle_window_s = float(straggle_window_s)
+        self.min_active = max(1, int(min_active))
+        # worker id -> {"status", "last_ts", "step", "pid", "joins"}
+        self.workers: Dict[int, dict] = {}
+        self.transitions: List[Tuple[str, int, dict]] = []
+
+    # -- transition plumbing ------------------------------------------------
+
+    def _emit(self, event: str, worker: int, hook: str, **info) -> None:
+        self.transitions.append((event, worker, info))
+        tm = self.telemetry
+        if tm.enabled:
+            tm.event(event, worker=int(worker), **info)
+        for r in self.reactors:
+            getattr(r, hook)(worker, info)
+
+    def _entry(self, worker: int) -> dict:
+        return self.workers.setdefault(int(worker), {
+            "status": "new", "last_ts": 0.0, "step": 0, "pid": None,
+            "joins": 0})
+
+    # -- explicit transitions (supervisor / in-mesh callers) ----------------
+
+    def join(self, worker: int, pid: Optional[int] = None,
+             reason: str = "spawn") -> None:
+        st = self._entry(worker)
+        rejoin = st["joins"] > 0
+        st.update(status="live", last_ts=time.time(), pid=pid,
+                  joins=st["joins"] + 1)
+        self._emit("worker_join", worker, "on_join",
+                   reason=reason, rejoin=rejoin, pid=pid)
+
+    def leave(self, worker: int, reason: str = "exit", **info) -> None:
+        st = self._entry(worker)
+        if st["status"] in ("dead", "left"):
+            return
+        st["status"] = "left" if reason == "finished" else "dead"
+        # lease docs written BEFORE this death must not resurrect the
+        # worker (a killed process's last beat can still be 'fresh')
+        st["dead_ts"] = time.time()
+        self._emit("worker_leave", worker, "on_leave", reason=reason, **info)
+
+    def demote(self, worker: int, reason: str = "straggler", **info) -> bool:
+        st = self._entry(worker)
+        if st["status"] != "live":
+            return False
+        if len(self.active_ranks()) - 1 < self.min_active:
+            return False           # never demote below the active floor
+        st["status"] = "demoted"
+        self._emit("worker_demote", worker, "on_demote",
+                   reason=reason, **info)
+        return True
+
+    def readmit(self, worker: int, reason: str = "readmit") -> None:
+        st = self._entry(worker)
+        if st["status"] != "demoted":
+            return
+        st["status"] = "live"
+        self._emit("worker_join", worker, "on_readmit",
+                   reason=reason, rejoin=True, pid=st.get("pid"))
+
+    # -- lease polling ------------------------------------------------------
+
+    def poll(self, now: Optional[float] = None) -> List[Tuple[str, int, dict]]:
+        """Fold the lease files into transitions: a fresh lease from an
+        unknown (or previously dead) worker is a join; a ``left`` status is
+        a clean finish; a lease older than ``lease_timeout`` is a death —
+        covers both crashed AND wedged (SIGSTOPped) workers, which stop
+        beating without exiting.  Returns the transitions this poll made."""
+        if not self.lease_dir:
+            return []
+        now = time.time() if now is None else now
+        before = len(self.transitions)
+        for wid, doc in sorted(read_leases(self.lease_dir).items()):
+            st = self.workers.get(wid)
+            fresh = now - float(doc.get("ts", 0)) <= self.lease_timeout
+            if doc.get("status") == "left":
+                if st is not None and st["status"] in ("live", "demoted"):
+                    self.leave(wid, reason="finished")
+                continue
+            if st is None or st["status"] in ("dead", "left", "new"):
+                if fresh and (st is None or
+                              float(doc.get("ts", 0)) > st.get("dead_ts", 0)):
+                    self.join(wid, pid=doc.get("pid"), reason="lease")
+                continue
+            if fresh:
+                st["last_ts"] = float(doc["ts"])
+                st["step"] = int(doc.get("step", st["step"]))
+        for wid, st in self.workers.items():
+            if st["status"] in ("live", "demoted") and \
+                    now - st["last_ts"] > self.lease_timeout:
+                self.leave(wid, reason="lease_expired",
+                           age=round(now - st["last_ts"], 1))
+        return self.transitions[before:]
+
+    # -- straggler loop -----------------------------------------------------
+
+    def straggler_ranking(self) -> List[dict]:
+        """The windowed ranking from ``scripts/telemetry_report.py`` over
+        this run's merged per-rank streams (``record_dir``)."""
+        mod = _load_report_module()
+        if mod is None or not self.record_dir:
+            return []
+        events = mod.load_events(self.record_dir)
+        return mod.straggler_ranking(events, self.straggle_window_s)
+
+    def check_stragglers(self, ranking: Optional[List[dict]] = None
+                         ) -> List[int]:
+        """Demote every live rank charged ≥ ``straggle_windows`` straggles
+        by the windowed ranking (injectable for tests; sourced from the
+        telemetry streams otherwise).  Single-rank rankings are ignored —
+        with no peer to compare against, 'slowest' is meaningless."""
+        ranking = self.straggler_ranking() if ranking is None else ranking
+        if len(ranking) < 2:
+            return []
+        demoted: List[int] = []
+        for row in ranking:
+            wid = int(row["rank"])
+            ws = int(row.get("windows_straggled", 0))
+            # the ranking is CUMULATIVE over the run: judge a worker on the
+            # windows straggled SINCE its last demotion, or a readmitted
+            # (recovered) worker would be instantly re-demoted forever on
+            # the evidence that got it demoted the first time
+            base = self.workers.get(wid, {}).get("straggle_base", 0)
+            if ws - base < self.straggle_windows:
+                continue
+            if self.demote(wid, reason="straggler", windows_straggled=ws,
+                           mean_train_secs=row.get("mean_train_secs")):
+                self.workers[wid]["straggle_base"] = ws
+                demoted.append(wid)
+        return demoted
+
+    # -- views --------------------------------------------------------------
+
+    def active_ranks(self) -> List[int]:
+        return sorted(w for w, st in self.workers.items()
+                      if st["status"] == "live")
+
+    def status(self) -> Dict[str, List[int]]:
+        out: Dict[str, List[int]] = {"live": [], "demoted": [], "dead": [],
+                                     "left": []}
+        for w, st in sorted(self.workers.items()):
+            out.setdefault(st["status"], []).append(w)
+        return out
+
+
+# -- the elastic supervisor --------------------------------------------------
+
+class ElasticSupervisor:
+    """Spawn/monitor/respawn elastic worker subprocesses around a
+    :class:`MembershipController`.
+
+    ``cmd_for(worker_id, attempt)`` builds the worker's argv (attempt 0 is
+    the first spawn; respawns pass the attempt count so the command can add
+    e.g. ``resume=true``).  A worker exiting 0 is finished; any other death
+    (nonzero exit, signal, lease expiry while the process is wedged) is a
+    ``worker_leave`` followed — after :class:`Backoff` — by a respawn and
+    ``worker_join``, up to ``max_restarts`` per worker.  Failures clustering
+    inside the :class:`CrashLoopBreaker` window stop the world with the
+    flight-recorder tail printed."""
+
+    def __init__(self, cmd_for: Callable[[int, int], List[str]],
+                 worker_ids: Sequence[int], lease_dir: str, *,
+                 record_dir: Optional[str] = None,
+                 lease_timeout: float = 15.0, poll_s: float = 0.25,
+                 backoff: Optional[Backoff] = None, max_restarts: int = 3,
+                 crash_limit: int = 5, crash_window_s: float = 120.0,
+                 telemetry_=None, reactors: Sequence[Reactor] = (),
+                 straggle_windows: int = 0, straggle_poll_s: float = 10.0,
+                 verbose: bool = True):
+        self.cmd_for = cmd_for
+        self.worker_ids = [int(w) for w in worker_ids]
+        self.lease_dir = lease_dir
+        self.record_dir = record_dir
+        self.poll_s = float(poll_s)
+        self.backoff = backoff or Backoff()
+        self.max_restarts = int(max_restarts)
+        self.breaker = CrashLoopBreaker(crash_limit, crash_window_s)
+        self.verbose = verbose
+        self.controller = MembershipController(
+            lease_dir=lease_dir, lease_timeout=lease_timeout,
+            telemetry_=telemetry_, reactors=reactors,
+            record_dir=record_dir, straggle_windows=straggle_windows or 3)
+        self._straggle_enabled = straggle_windows > 0
+        self._straggle_poll_s = float(straggle_poll_s)
+        self._last_straggle_check = 0.0
+        self.procs: Dict[int, subprocess.Popen] = {}
+        self.attempts: Dict[int, int] = {w: 0 for w in self.worker_ids}
+        self.done: set = set()
+        self.failed: set = set()
+        self._pending: List[Tuple[float, int]] = []   # (due_ts, worker)
+
+    # chaos harness hook: the CURRENT pid of a worker (None between lives)
+    def pid_of(self, worker_id: int) -> Optional[int]:
+        p = self.procs.get(int(worker_id))
+        return p.pid if p is not None and p.poll() is None else None
+
+    def _log(self, msg: str) -> None:
+        if self.verbose:
+            print(f"elastic: {msg}", file=sys.stderr, flush=True)
+
+    def _spawn(self, wid: int) -> None:
+        attempt = self.attempts[wid]
+        cmd = self.cmd_for(wid, attempt)
+        self.procs[wid] = subprocess.Popen(cmd)
+        self.attempts[wid] = attempt + 1
+        self.controller.join(wid, pid=self.procs[wid].pid,
+                             reason="respawn" if attempt else "spawn")
+        self._log(f"worker {wid} spawned (pid {self.procs[wid].pid}, "
+                  f"attempt {attempt})")
+
+    def _kill_all(self) -> None:
+        for p in self.procs.values():
+            if p.poll() is None:
+                try:
+                    p.kill()
+                except OSError:
+                    pass
+        for p in self.procs.values():
+            try:
+                p.wait(timeout=30)
+            except Exception:
+                pass
+
+    def _on_death(self, wid: int, rc: Optional[int], reason: str) -> bool:
+        """Record a death; schedule the respawn.  True when the crash-loop
+        breaker tripped (caller stops the world)."""
+        self.controller.leave(wid, reason=reason, rc=rc)
+        if self.breaker.record_failure():
+            self._log(f"crash-loop breaker tripped "
+                      f"({self.breaker.limit} failures within "
+                      f"{self.breaker.window_s:.0f}s) — stopping the world")
+            if self.record_dir:
+                for line in flight_tail_lines(self.record_dir):
+                    print(line, file=sys.stderr, flush=True)
+            return True
+        if self.attempts[wid] > self.max_restarts:
+            self._log(f"worker {wid} exhausted {self.max_restarts} restarts "
+                      f"— giving up on it")
+            self.failed.add(wid)
+            return False
+        delay = self.backoff.delay(self.attempts[wid] - 1)
+        self._log(f"worker {wid} {reason} (rc={rc}); respawn in {delay:.1f}s")
+        self._pending.append((time.time() + delay, wid))
+        return False
+
+    def run(self, timeout_s: float = 600.0) -> int:
+        """Run the elastic world until every worker finished (rc 0): 0 — or
+        nonzero on breaker trip / restart exhaustion / timeout."""
+        t0 = time.time()
+        for wid in self.worker_ids:
+            self._spawn(wid)
+        try:
+            while True:
+                # 1. process deaths
+                for wid, p in list(self.procs.items()):
+                    if wid in self.done or wid in self.failed:
+                        continue
+                    rc = p.poll()
+                    if rc is None:
+                        continue
+                    del self.procs[wid]
+                    if rc == 0:
+                        self.done.add(wid)
+                        self.controller.leave(wid, reason="finished")
+                        self._log(f"worker {wid} finished")
+                    elif self._on_death(wid, rc, "crashed"):
+                        self._kill_all()
+                        return 1
+                # 2. lease expiry of WEDGED workers (process alive, no
+                # heartbeats — SIGSTOP, hung collective): kill + respawn
+                for ev, wid, info in self.controller.poll():
+                    if ev == "worker_leave" and \
+                            info.get("reason") == "lease_expired" and \
+                            wid in self.procs:
+                        p = self.procs.pop(wid)
+                        try:
+                            p.kill()
+                            p.wait(timeout=30)
+                        except Exception:
+                            pass
+                        self._log(f"worker {wid} lease expired while "
+                                  f"wedged — killed")
+                        if self._on_death(wid, p.returncode, "wedged"):
+                            self._kill_all()
+                            return 1
+                # 3. persistent-straggler demotion (off unless enabled;
+                # throttled — the ranking re-reads the whole record_dir,
+                # which grows with the run: not per-0.25s-tick work)
+                if self._straggle_enabled and \
+                        time.time() - self._last_straggle_check > \
+                        self._straggle_poll_s:
+                    self._last_straggle_check = time.time()
+                    self.controller.check_stragglers()
+                # 4. due respawns
+                now = time.time()
+                due = [w for ts, w in self._pending if ts <= now]
+                self._pending = [(ts, w) for ts, w in self._pending
+                                 if ts > now]
+                for wid in due:
+                    self._spawn(wid)
+                # 5. exit conditions
+                if len(self.done | self.failed) == len(self.worker_ids):
+                    return 0 if not self.failed else 1
+                if time.time() - t0 > timeout_s:
+                    self._log(f"timeout after {timeout_s:.0f}s — "
+                              f"stopping the world")
+                    self._kill_all()
+                    return 1
+                time.sleep(self.poll_s)
+        finally:
+            self._kill_all()
+
+
+# -- elastic worker CLI ------------------------------------------------------
+
+def parse_kv(items: Sequence[str]) -> Dict[str, Any]:
+    """``key=value`` config parsing with the worker CLI's coercions."""
+    config: Dict[str, Any] = {}
+    for kv in items:
+        k, _, v = kv.partition("=")
+        try:
+            config[k] = int(v)
+        except ValueError:
+            try:
+                config[k] = float(v)
+            except ValueError:
+                config[k] = {"true": True, "false": False}.get(v.lower(), v)
+    return config
+
+
+def elastic_worker_main(argv: Optional[Sequence[str]] = None) -> int:
+    """One elastic island worker: ``python -m
+    theanompi_tpu.parallel.membership <rule> <modelfile> <modelclass>
+    [key=value ...]``.
+
+    Keys: ``center_addr`` (the EASGD/ASGD center server), ``island``
+    (worker id, also the telemetry rank), ``lease_dir`` (heartbeats),
+    ``steps`` (local-step goal → exit 0), ``host_devices`` (CPU-venue
+    simulated chip count — set BEFORE jax imports), plus the usual model
+    config.  On (re)join the island restores params from the center
+    (``center_restore``, default true) and re-enters at its own pace —
+    the asynchronous algebra needs no barrier with the others."""
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if len(argv) < 3:
+        print("usage: python -m theanompi_tpu.parallel.membership "
+              "<rule> <modelfile> <modelclass> [key=value ...]")
+        return 2
+    rule, modelfile, modelclass = argv[:3]
+    cfg = parse_kv(argv[3:])
+
+    hd = int(cfg.pop("host_devices", 0) or 0)
+    if hd:
+        # simulated chips are a CPU-venue concept: forcing the host
+        # platform device count implies the cpu backend
+        cfg.setdefault("platform", "cpu")
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + f" --xla_force_host_platform_device_count={hd}"
+            ).strip()
+    island = int(cfg.get("island", 0))
+    steps_goal = int(cfg.get("steps", 32))
+    deadline = time.time() + float(cfg.get("max_seconds", 600))
+    tm = telemetry.init({"record_dir": cfg.get("record_dir"),
+                         "rank": island, "run_id": cfg.get("run_id"),
+                         "telemetry": cfg.get("telemetry")})
+    lease = WorkerLease(cfg["lease_dir"], island, telemetry_=tm) \
+        if cfg.get("lease_dir") else None
+    if lease:
+        # alive BEFORE the seconds-long jax import + warmup: everything
+        # above this line is stdlib, so the spawn-to-first-beat window
+        # can't outlive a lease on a cold-cache host
+        lease.beat(0)
+
+    import importlib
+
+    import jax
+    plat = cfg.get("platform")
+    if plat:
+        # explicit pin only — defaulting to cpu here would silently train
+        # every elastic worker on CPU on a real TPU host
+        jax.config.update("jax_platforms", str(plat))
+
+    mod = importlib.import_module(modelfile)
+    cls = getattr(mod, modelclass)
+
+    def factory(c):
+        c = dict(c)
+        c.setdefault("verbose", False)
+        return cls(c)
+
+    from .async_easgd import AsyncEASGDTrainer
+    cfg.setdefault("async_islands", 1)
+    cfg.setdefault("island_base", island)
+    cfg.setdefault("center_restore", True)
+    trainer_cfg = dict(cfg)
+    # this CLI owns the lease (it beats through compile, from before the
+    # trainer exists); don't let the island thread double-register it
+    trainer_cfg.pop("lease_dir", None)
+    trainer = AsyncEASGDTrainer(factory, trainer_cfg, rule=rule)
+    trainer.start()
+    rc = 0
+    try:
+        while True:
+            isl = trainer.islands[0]
+            if lease:
+                lease.beat(isl.steps_done)
+            if isl.error is not None:
+                rc = 1
+                break
+            if isl.steps_done >= steps_goal:
+                break
+            if time.time() > deadline:
+                rc = 3
+                break
+            time.sleep(0.1)
+        trainer.stop_and_join(timeout=120)
+    except BaseException:
+        rc = 1
+        raise
+    finally:
+        if lease:
+            if rc == 0:
+                lease.release()
+            else:
+                lease.beat(status="dying", rc=rc)
+        if tm.enabled:
+            tm.event("train_end", steps=trainer.islands[0].steps_done,
+                     exchanges=trainer.islands[0].exchanges_done)
+            tm.close()
+    return rc
+
+
+# -- launcher-facing composition --------------------------------------------
+
+def run_elastic(rule: str, modelfile: str, modelclass: str,
+                config: Dict[str, Any], n_workers: int, *,
+                record_dir: Optional[str] = None, steps: int = 32,
+                host_devices: int = 0, supervisor_kw: Optional[dict] = None,
+                chaos_schedule=None, timeout_s: float = 600.0,
+                verbose: bool = True) -> int:
+    """One elastic run: center server + ``n_workers`` island subprocesses
+    under an :class:`ElasticSupervisor` (``launcher --elastic`` and
+    ``scripts/chaos_run.py`` both land here).  ``host_devices > 0`` is the
+    CPU venue (each worker simulates that many chips and pins the cpu
+    backend); 0 (default) leaves platform selection to the real hardware.
+    BSP has no shrink algebra — use ``launcher --supervise`` (the
+    reaction matrix, design.md §14)."""
+    rule = rule.lower()
+    if rule not in ("easgd", "asgd"):
+        raise ValueError(
+            f"elastic process membership needs a center-based rule "
+            f"(easgd/asgd), got {rule!r} — BSP preemption tolerance is "
+            f"`launcher --supervise` (world restart at the committed "
+            f"window cursor); GoSGD demotion is in-mesh "
+            f"(Exchanger.set_active_ranks)")
+    from .center_server import CenterServer
+    record_dir = record_dir or config.get("record_dir")
+    lease_dir = config.get("lease_dir") or (
+        os.path.join(record_dir, "membership") if record_dir else None)
+    assert lease_dir, "run_elastic needs record_dir or lease_dir"
+    run_id = config.get("run_id") or f"elastic{int(time.time())}"
+
+    srv = CenterServer(alpha=float(config.get("alpha", 0.5)))
+    host, port = srv.start(str(config.get("center_host", "127.0.0.1")),
+                           int(config.get("center_port", 0)))
+    addr = f"{host}:{port}"
+    tm = telemetry.init({"record_dir": record_dir, "rank": 0,
+                         "run_id": run_id}) if record_dir else \
+        telemetry.active()
+
+    base_kv = dict(config)
+    for drop in ("lease_dir", "record_dir", "run_id", "center_addr",
+                 "rule", "n_workers"):
+        base_kv.pop(drop, None)
+
+    def cmd_for(wid: int, attempt: int) -> List[str]:
+        kv = dict(base_kv)
+        kv.update(island=wid, center_addr=addr, lease_dir=lease_dir,
+                  steps=steps, host_devices=host_devices, run_id=run_id)
+        if record_dir:
+            kv["record_dir"] = record_dir
+        return [sys.executable, "-m", "theanompi_tpu.parallel.membership",
+                rule, modelfile, modelclass] + \
+            [f"{k}={v}" for k, v in sorted(kv.items())]
+
+    kw = dict(record_dir=record_dir, telemetry_=tm,
+              reactors=(CenterReactor(srv.center),), verbose=verbose)
+    kw.update(supervisor_kw or {})
+    sup = ElasticSupervisor(cmd_for, list(range(1, n_workers + 1)),
+                            lease_dir, **kw)
+    monkey = None
+    if chaos_schedule:
+        from ..utils.chaos import ChaosMonkey
+        monkey = ChaosMonkey(chaos_schedule, pid_of=sup.pid_of,
+                             telemetry_=tm)
+        monkey.start()
+    try:
+        rc = sup.run(timeout_s=timeout_s)
+    finally:
+        if monkey is not None:
+            monkey.stop()
+        # persist the final center for offline eval (chaos_run's loss gate)
+        try:
+            import numpy as np
+            leaves = srv.center.pull_leaves()
+            if record_dir and leaves is not None:
+                with open(os.path.join(record_dir, "center_final.npz"),
+                          "wb") as f:
+                    np.savez(f, **{f"leaf{i}": x
+                                   for i, x in enumerate(leaves)})
+        except Exception:
+            pass
+        srv.stop()
+        if tm.enabled:
+            tm.event("elastic_end", rc=rc,
+                     status=sup.controller.status())
+            tm.close()
+    return rc
+
+
+if __name__ == "__main__":
+    raise SystemExit(elastic_worker_main())

@@ -1,0 +1,252 @@
+"""Chaos harness: scheduled/seeded SIGKILL / SIGSTOP / delay fault
+injection.
+
+Preemption tolerance that is only ever exercised by real preemptions is
+untested code: this module rehearses host loss on the CPU venue by
+injecting faults into running ranks mid-epoch — by explicit schedule
+(``parse_schedule``) or reproducible seed (``seeded_schedule``) — so the
+elastic runtime's reactions (``parallel/membership.py``) are gated on
+convergence-to-accuracy under faults, not on hope (tests/test_chaos.py,
+scripts/chaos_run.py).
+
+Fault kinds (POSIX process targets via ``pid_of``; in-process targets via
+``delay_hook``):
+
+* ``kill``  — SIGKILL: the preemption event.  The supervisor must detect
+  the death (process exit), emit ``worker_leave``, and respawn with
+  backoff (``worker_join``).
+* ``stop``  — SIGSTOP for ``duration`` seconds, then SIGCONT: the wedge /
+  network-partition event.  Short stops read as stragglers; stops past
+  the lease timeout read as deaths even though the process never exited —
+  exactly the case exit-code supervision misses.
+* ``delay`` — a straggler: ``delay_hook(target, duration)`` when given
+  (in-process throttle), else a STOP/CONT pair of that duration.
+
+Stdlib-only on purpose: the harness must import (and the schedule parse
+must run) in jax-free tooling and in the lint CLI's no-backend process.
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import signal
+import sys
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Sequence
+
+FAULT_KINDS = ("kill", "stop", "delay")
+
+# the injection-audit event kind (telemetry stream + Perfetto instant
+# marker) — the chaos gate matches worker_leave/worker_join transitions
+# against these
+FAULT_EVENT = "fault_injected"
+
+
+class Fault:
+    """One scheduled fault: ``kind`` at ``at`` seconds (from harness start)
+    against worker/rank ``target``, with ``duration`` for stop/delay."""
+
+    __slots__ = ("kind", "at", "target", "duration", "applied", "error")
+
+    def __init__(self, kind: str, at: float, target: int,
+                 duration: float = 0.0):
+        assert kind in FAULT_KINDS, \
+            f"unknown fault kind {kind!r}; have {FAULT_KINDS}"
+        self.kind = kind
+        self.at = float(at)
+        self.target = int(target)
+        self.duration = float(duration)
+        self.applied = False
+        self.error: Optional[str] = None
+
+    def __repr__(self):
+        dur = f":{self.duration:g}s" if self.duration else ""
+        return f"{self.kind}@{self.at:g}:w{self.target}{dur}"
+
+
+def parse_schedule(spec: str) -> List[Fault]:
+    """``"kill@8:1,stop@12:2:3.5,delay@15:0:0.5"`` →
+    [Fault(kill, t=8, target=1), Fault(stop, t=12, target=2, 3.5s), ...].
+    Grammar per entry: ``<kind>@<seconds>:<target>[:<duration>]``."""
+    faults: List[Fault] = []
+    for entry in str(spec).split(","):
+        entry = entry.strip()
+        if not entry:
+            continue
+        try:
+            kind, _, rest = entry.partition("@")
+            parts = rest.split(":")
+            at, target = float(parts[0]), int(parts[1])
+            duration = float(parts[2]) if len(parts) > 2 else 0.0
+        except (ValueError, IndexError):
+            raise ValueError(
+                f"bad fault entry {entry!r}: want "
+                f"<kind>@<seconds>:<target>[:<duration>]") from None
+        faults.append(Fault(kind, at, target, duration))
+    return sorted(faults, key=lambda f: f.at)
+
+
+def seeded_schedule(seed: int, targets: Sequence[int], n_faults: int = 2,
+                    t_min: float = 5.0, t_max: float = 30.0,
+                    kinds: Sequence[str] = ("kill",),
+                    duration: float = 2.0) -> List[Fault]:
+    """A reproducible random schedule: ``n_faults`` draws of (kind, time ∈
+    [t_min, t_max], target ∈ targets) from one seed — the chaos gate's
+    'random non-zero ranks mid-epoch' with replayable failures."""
+    rng = random.Random(int(seed))
+    targets = list(targets)
+    assert targets, "seeded_schedule needs at least one target"
+    faults = [Fault(rng.choice(list(kinds)),
+                    rng.uniform(t_min, t_max),
+                    rng.choice(targets),
+                    duration)
+              for _ in range(int(n_faults))]
+    return sorted(faults, key=lambda f: f.at)
+
+
+class ChaosMonkey(threading.Thread):
+    """Apply a fault schedule against live workers from a daemon thread.
+
+    ``pid_of(target) -> pid|None`` resolves the CURRENT pid (elastic
+    workers change pids across respawns; None while a target is between
+    lives — the fault is retried for ``grace_s`` then dropped with
+    ``error='no-pid'``).  ``delay_hook(target, duration)`` services
+    ``delay`` faults for in-process targets (SPMD ranks have no pid of
+    their own).  Each applied fault emits one :data:`FAULT_EVENT`
+    telemetry event — the audit trail the chaos gate matches
+    ``worker_leave``/``worker_join`` transitions against."""
+
+    def __init__(self, schedule: Sequence[Fault],
+                 pid_of: Optional[Callable[[int], Optional[int]]] = None,
+                 delay_hook: Optional[Callable[[int, float], None]] = None,
+                 telemetry_=None, poll_s: float = 0.05,
+                 grace_s: float = 10.0, t0: Optional[float] = None):
+        super().__init__(daemon=True, name="chaos-monkey")
+        self.schedule = sorted(schedule, key=lambda f: f.at)
+        self.pid_of = pid_of
+        self.delay_hook = delay_hook
+        self.telemetry = telemetry_
+        self.poll_s = float(poll_s)
+        self.grace_s = float(grace_s)
+        self.t0 = time.time() if t0 is None else float(t0)
+        self._halt = threading.Event()
+        self.applied: List[Fault] = []
+
+    # -- application --------------------------------------------------------
+
+    def _signal(self, pid: int, sig) -> None:
+        os.kill(int(pid), sig)
+
+    def _emit(self, fault: Fault, pid: Optional[int]) -> None:
+        self.applied.append(fault)
+        tm = self.telemetry
+        if tm is not None and getattr(tm, "enabled", False):
+            tm.event(FAULT_EVENT, kind=fault.kind, worker=fault.target,
+                     pid=pid, at=round(fault.at, 2),
+                     duration=fault.duration)
+        print(f"chaos: injected {fault!r} (pid {pid})",
+              file=sys.stderr, flush=True)
+
+    def _apply(self, fault: Fault) -> bool:
+        """True when the fault landed (or permanently failed)."""
+        if fault.kind == "delay" and self.delay_hook is not None:
+            self.delay_hook(fault.target, fault.duration)
+            fault.applied = True
+            self._emit(fault, None)
+            return True
+        pid = self.pid_of(fault.target) if self.pid_of else None
+        if pid is None:
+            if time.time() - self.t0 - fault.at > self.grace_s:
+                fault.error = "no-pid"
+                fault.applied = True      # dropped, but resolved
+                return True
+            return False                  # target between lives — retry
+        try:
+            if fault.kind == "kill":
+                self._signal(pid, signal.SIGKILL)
+            else:                         # stop / pid-targeted delay
+                self._signal(pid, signal.SIGSTOP)
+
+                def _cont(p=pid):
+                    try:
+                        self._signal(p, signal.SIGCONT)
+                    except (ProcessLookupError, OSError):
+                        pass              # supervisor killed it meanwhile
+                t = threading.Timer(max(fault.duration, 0.01), _cont)
+                t.daemon = True
+                t.start()
+        except (ProcessLookupError, OSError) as e:
+            fault.error = repr(e)
+        fault.applied = True
+        self._emit(fault, pid)
+        return True
+
+    # -- thread loop --------------------------------------------------------
+
+    def run(self) -> None:
+        pending = list(self.schedule)
+        while pending and not self._halt.is_set():
+            now = time.time() - self.t0
+            still: List[Fault] = []
+            for f in pending:
+                if f.at <= now:
+                    if not self._apply(f):
+                        still.append(f)
+                else:
+                    still.append(f)
+            pending = still
+            self._halt.wait(self.poll_s)
+
+    def stop(self, join_timeout: float = 5.0) -> None:
+        self._halt.set()
+        if self.is_alive():
+            self.join(timeout=join_timeout)
+
+
+def find_child_pid(parent_pid: int, needle: str,
+                   timeout_s: float = 60.0) -> Optional[int]:
+    """Scan ``/proc`` for a child of ``parent_pid`` whose cmdline contains
+    ``needle`` (the bench ``_reap`` idiom) — how the chaos harness targets
+    the worker subprocess under ``launcher --supervise`` without the
+    launcher's cooperation."""
+    deadline = time.time() + timeout_s
+    while time.time() < deadline:
+        for entry in os.listdir("/proc"):
+            if not entry.isdigit():
+                continue
+            try:
+                with open(f"/proc/{entry}/stat") as f:
+                    ppid = int(f.read().split()[3])
+                if ppid != int(parent_pid):
+                    continue
+                with open(f"/proc/{entry}/cmdline", "rb") as f:
+                    cmd = f.read().replace(b"\0", b" ").decode(
+                        errors="replace")
+                if needle in cmd:
+                    return int(entry)
+            except (OSError, ValueError, IndexError):
+                continue
+        time.sleep(0.05)
+    return None
+
+
+def wait_for_file(path: str, timeout_s: float = 60.0,
+                  predicate: Optional[Callable[[str], bool]] = None) -> bool:
+    """Poll until ``path`` exists (and ``predicate(contents)`` holds, when
+    given) — the mid-epoch synchronization chaos tests key faults off
+    (e.g. 'first checkpoint committed')."""
+    deadline = time.time() + timeout_s
+    while time.time() < deadline:
+        if os.path.exists(path):
+            if predicate is None:
+                return True
+            try:
+                with open(path) as f:
+                    if predicate(f.read()):
+                        return True
+            except OSError:
+                pass
+        time.sleep(0.05)
+    return False

@@ -16,6 +16,16 @@ bit-identical across a save/kill/resume boundary (tested in
 ``tests/test_checkpoint_and_data.py``).  Everything is host-side numpy: on
 multi-host, rank 0 saves (as the reference did) after an all-gather of the
 boxed state.
+
+**Crash atomicity (round 13):** every artifact (``.npz``, ``.json``
+sidecar, ``LATEST``) is written write-to-temp → fsync → ``os.replace``, so
+a SIGKILL mid-save (preemption, the chaos harness, a supervisor kill)
+leaves either the previous file or the new one — never a truncated zip.
+On resume :func:`latest_epoch` additionally VALIDATES its candidate (zip
+directory opens, sidecar parses) and falls back to the newest *valid*
+checkpoint when the latest is damaged (pre-atomic checkpoints, torn NFS
+writes), so ``--supervise``/elastic resume can never crash-loop on a
+half-written file.
 """
 
 from __future__ import annotations
@@ -28,6 +38,35 @@ import jax
 import numpy as np
 
 from . import helper_funcs
+
+
+def _fsync_write(path: str, write_fn) -> None:
+    """Crash-atomic file write: ``write_fn(fh)`` into ``path + '.tmp'``,
+    fsync, then ``os.replace`` — a kill at ANY point leaves either the old
+    complete file or the new complete file at ``path``."""
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        write_fn(f)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+
+
+def checkpoint_valid(ckpt_dir: str, epoch: int) -> bool:
+    """True when epoch's ``.npz`` opens as a complete zip AND the ``.json``
+    sidecar parses — the resume-safety probe behind the newest-valid
+    fallback.  A truncated archive (pre-atomic writer killed mid-save)
+    fails the zip central-directory read here instead of deep inside
+    ``load_checkpoint``."""
+    base = os.path.join(ckpt_dir, f"ckpt_epoch{epoch}")
+    try:
+        with np.load(base + ".npz") as z:
+            z.files          # forces the central-directory read
+        with open(base + ".json") as f:
+            json.load(f)
+    except Exception:
+        return False
+    return True
 
 
 def save_checkpoint(ckpt_dir: str, step_state: Dict[str, Any], epoch: int,
@@ -71,9 +110,12 @@ def save_checkpoint(ckpt_dir: str, step_state: Dict[str, Any], epoch: int,
                 meta_cursor[k] = v
         meta["cursor"] = meta_cursor
 
-    np.savez(path + ".npz", **flat)
-    with open(path + ".json", "w") as f:
-        json.dump(meta, f)
+    # arrays first, sidecar second, LATEST last — each step atomic, so the
+    # commit point is the LATEST replace and a kill between steps leaves a
+    # (possibly incomplete) epoch that latest_epoch's validity probe skips
+    _fsync_write(path + ".npz", lambda f: np.savez(f, **flat))
+    _fsync_write(path + ".json",
+                 lambda f: f.write(json.dumps(meta).encode()))
     if params_npy is not None:
         helper_funcs.save_params(params_npy,
                                  os.path.join(ckpt_dir, f"params_epoch{epoch}"))
@@ -145,17 +187,38 @@ def peek_meta(ckpt_dir: str,
 
 
 def latest_epoch(ckpt_dir: str) -> Optional[int]:
+    """Newest *valid* epoch: the ``LATEST`` pointer when its checkpoint
+    passes :func:`checkpoint_valid`, else a scan falling back through the
+    on-disk epochs newest-first — a damaged latest checkpoint (SIGKILL
+    mid-save under a pre-atomic writer) must never brick a supervised
+    resume; it costs one epoch of progress instead."""
+    candidates: list = []
     latest = os.path.join(ckpt_dir, "LATEST")
     if os.path.exists(latest):
-        with open(latest) as f:
-            return int(f.read().strip())
-    if not os.path.isdir(ckpt_dir):
-        return None
-    epochs = [int(f[len("ckpt_epoch"):-4]) for f in os.listdir(ckpt_dir)
-              if f.startswith("ckpt_epoch") and f.endswith(".npz")]
-    return max(epochs) if epochs else None
+        try:
+            with open(latest) as f:
+                candidates.append(int(f.read().strip()))
+        except (ValueError, OSError):
+            pass                  # torn pointer: fall through to the scan
+    if os.path.isdir(ckpt_dir):
+        epochs = [int(f[len("ckpt_epoch"):-4]) for f in os.listdir(ckpt_dir)
+                  if f.startswith("ckpt_epoch") and f.endswith(".npz")]
+        candidates.extend(sorted(epochs, reverse=True))
+    seen = set()
+    for ep in candidates:
+        if ep in seen:
+            continue
+        seen.add(ep)
+        if checkpoint_valid(ckpt_dir, ep):
+            if candidates and ep != candidates[0]:
+                import sys
+                print(f"checkpoint: epoch {candidates[0]} is damaged/"
+                      f"incomplete — resuming from newest valid epoch {ep}",
+                      file=sys.stderr, flush=True)
+            return ep
+    return None
 
 
 def _write_latest(ckpt_dir: str, epoch: int) -> None:
-    with open(os.path.join(ckpt_dir, "LATEST"), "w") as f:
-        f.write(str(epoch))
+    _fsync_write(os.path.join(ckpt_dir, "LATEST"),
+                 lambda f: f.write(str(epoch).encode()))

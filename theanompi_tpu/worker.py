@@ -149,6 +149,19 @@ class Worker(MeshProcess):
             f"(diagnostic dump only) or 'exit' (kill for supervisor restart)")
 
         telem = self.telemetry
+        # membership lease (parallel/membership.py): when lease_dir is set
+        # this worker heartbeats wherever it beats the watchdog (every
+        # iteration, every val batch) so an elastic controller can tell
+        # dead/wedged from slow at ANY print cadence — the lease throttles
+        # itself (min_interval_s), so the per-iteration cost is one
+        # time.time() check, not a file write
+        lease = None
+        if config.get("lease_dir"):
+            from .parallel.membership import WorkerLease
+            lease = WorkerLease(config["lease_dir"],
+                                int(config.get("rank", self.rank)),
+                                telemetry_=telem)
+            lease.beat(count)
         # training sentry (utils/sentry): NaN/inf + loss-spike + rolling
         # throughput-regression detection over the print-cadence records —
         # anomaly events + a flight dump instead of a silently sick run.
@@ -212,6 +225,8 @@ class Worker(MeshProcess):
                         if not fused:
                             self.exchanger.exchange(self.recorder, count)
                         watchdog.beat(f"epoch {epoch} iter {count}")
+                        if lease is not None:
+                            lease.beat(count)
                         if trace_stop_at is not None and count + spc >= trace_stop_at:
                             _stop_trace()
                         rec = self.recorder.print_train_info(count,
@@ -231,6 +246,8 @@ class Worker(MeshProcess):
                     for _ in range(model.data.n_batch_val):
                         model.val_iter(count, self.recorder)
                         watchdog.beat(f"epoch {epoch} val @ iter {count}")
+                        if lease is not None:
+                            lease.beat(count)
                     model.end_val()
                     self.recorder.print_val_info(count)
 
@@ -239,6 +256,8 @@ class Worker(MeshProcess):
                     if config.get("record_dir"):
                         self.recorder.save(config["record_dir"])
                     watchdog.beat(f"epoch {epoch} end (ckpt/records saved)")
+                    if lease is not None:
+                        lease.beat(count, epoch=epoch)
                     if sentry is not None:
                         # the next print record's images/sec spans this
                         # val pass + ckpt + shuffle wall time — not a
@@ -271,6 +290,8 @@ class Worker(MeshProcess):
                           f"{ckpt_exc!r}", file=_sys.stderr, flush=True)
         if trace_stop_at is not None:   # window outlived training: flush it
             _stop_trace()
+        if lease is not None:
+            lease.release()     # clean departure: 'finished', not a death
         if telem.enabled:
             telem.event("train_end", secs=round(time.time() - t0, 3),
                         epochs=epochs - start_epoch)
