@@ -85,6 +85,7 @@ def audit_membership(record_dir, kill_targets):
     events = tr.load_events(record_dir)
     trans = [e for e in events
              if e["ev"] in ("worker_join", "worker_leave", "worker_demote",
+                            "center_down", "center_restored",
                             "fault_injected")]
     ok = True
     for w in sorted(set(kill_targets)):
@@ -101,6 +102,66 @@ def audit_membership(record_dir, kill_targets):
             print(f"AUDIT FAIL: no rejoin worker_join for killed worker {w}")
             ok = False
     return ok, trans
+
+
+def audit_center(record_dir, n_center_kills, require_dedup):
+    """The round-14 half of the gate: every center SIGKILL must have its
+    ``center_down`` → ``center_restored`` pair (and the run must END
+    restored, not down); when duplicate frames were injected, the center's
+    dedup window must have actually deduplicated (counter > 0) and its
+    applied-once bookkeeping must balance.  Returns (ok, stats)."""
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    import telemetry_report as tr
+    events = tr.load_events(record_dir)
+    downs = [e for e in events if e["ev"] == "center_down"]
+    restores = [e for e in events if e["ev"] == "center_restored"]
+    ok = True
+    if n_center_kills:
+        if len(downs) < n_center_kills:
+            print(f"AUDIT FAIL: {n_center_kills} center kills but only "
+                  f"{len(downs)} center_down events")
+            ok = False
+        if len(restores) < n_center_kills:
+            print(f"AUDIT FAIL: {n_center_kills} center kills but only "
+                  f"{len(restores)} center_restored events")
+            ok = False
+        if downs and (not restores
+                      or restores[-1]["ts"] < downs[-1]["ts"]):
+            print("AUDIT FAIL: the run ended center_down (no "
+                  "center_restored after the last outage)")
+            ok = False
+    stats = None
+    stats_path = os.path.join(record_dir, "center_stats.json")
+    if os.path.exists(stats_path):
+        with open(stats_path) as f:
+            stats = json.load(f)
+    if stats is not None:
+        applied = sum(int(v) for v in stats.get("by_island", {}).values())
+        if applied != int(stats.get("n_updates", -1)):
+            print(f"AUDIT FAIL: applied-once bookkeeping off — n_updates="
+                  f"{stats.get('n_updates')} != Σ by_island = {applied}")
+            ok = False
+    if require_dedup:
+        hits = (stats or {}).get("dedup_hits", 0)
+        faulted = (stats or {}).get("net_frames_faulted")
+        if not stats:
+            print("AUDIT FAIL: duplicate frames injected but no "
+                  "center_stats.json to prove deduplication")
+            ok = False
+        elif faulted is not None and faulted.get("net_dup", 0) == 0:
+            # the window opened but no frame crossed it (workers still
+            # booting, schedule mistimed) — nothing to dedup, not a bug
+            print("warning: net_dup window(s) opened but no frame passed "
+                  "through them — dedup gate vacuous this run")
+        elif hits <= 0:
+            print("AUDIT FAIL: duplicate frames injected but the center's "
+                  "dedup window recorded 0 hits — duplicates were "
+                  "re-applied or never arrived")
+            ok = False
+        else:
+            print(f"dedup audit: {hits} duplicate(s) deduplicated, "
+                  f"applied-once bookkeeping balanced")
+    return ok, stats
 
 
 def run_bsp_chaos(args, kv):
@@ -140,12 +201,26 @@ def main(argv=None):
     ap.add_argument("--steps", type=int, default=120,
                     help="local steps per elastic worker before clean exit")
     ap.add_argument("--faults", default=None,
-                    help="explicit schedule: kind@sec:worker[:dur],...")
+                    help="explicit schedule: kind@sec:worker[:dur],... "
+                         "(worker 0 = the center — implies --center-proc)")
     ap.add_argument("--seed", type=int, default=7,
                     help="seeded random faults when --faults is not given")
     ap.add_argument("--n-faults", type=int, default=1)
     ap.add_argument("--t-min", type=float, default=10.0)
     ap.add_argument("--t-max", type=float, default=30.0)
+    ap.add_argument("--center-proc", action="store_true",
+                    help="run the center as its own supervised process "
+                         "(snapshots + respawn; auto-on when a fault "
+                         "targets worker 0)")
+    ap.add_argument("--net-faults", default=None,
+                    help="wire-level schedule through the ChaosProxy: "
+                         "net_dup@5:-1:6,net_partition@12:-1:3,... "
+                         "(target -1 = every client)")
+    ap.add_argument("--net-seed", type=int, default=None,
+                    help="seeded net-fault windows when --net-faults is "
+                         "not given")
+    ap.add_argument("--net-n-faults", type=int, default=3)
+    ap.add_argument("--net-duration", type=float, default=3.0)
     ap.add_argument("--record-dir", required=True)
     ap.add_argument("--host-devices", type=int, default=1,
                     help="simulated chips per worker (CPU venue)")
@@ -172,7 +247,20 @@ def main(argv=None):
                               list(range(1, args.workers + 1)),
                               n_faults=args.n_faults, t_min=args.t_min,
                               t_max=args.t_max)
-    print(f"chaos schedule: {schedule}")
+    net_schedule = None
+    if args.net_faults:
+        net_schedule = chaos.parse_schedule(args.net_faults)
+    elif args.net_seed is not None:
+        net_schedule = chaos.seeded_schedule(
+            args.net_seed, [-1], n_faults=args.net_n_faults,
+            t_min=args.t_min, t_max=args.t_max,
+            kinds=chaos.NET_FAULT_KINDS, duration=args.net_duration)
+    center_proc = args.center_proc or \
+        any(f.target == 0 for f in schedule)
+    print(f"chaos schedule: {schedule}"
+          + (f"\nnet schedule:   {net_schedule}" if net_schedule else "")
+          + (f"\ncenter: supervised subprocess (snapshots + respawn)"
+             if center_proc else ""))
     config = parse_kv(args.config)
     config.setdefault("sync_freq", args.sync_freq)
     t0 = time.time()
@@ -180,6 +268,7 @@ def main(argv=None):
         args.rule, args.modelfile, args.modelclass, config, args.workers,
         record_dir=args.record_dir, steps=args.steps,
         host_devices=args.host_devices, chaos_schedule=schedule,
+        net_chaos_schedule=net_schedule, center_proc=center_proc,
         timeout_s=args.timeout,
         supervisor_kw={"max_restarts": args.max_restarts,
                        "lease_timeout": args.lease_timeout})
@@ -187,15 +276,22 @@ def main(argv=None):
     if rc != 0:
         return rc
 
-    kills = [f.target for f in schedule
-             if f.kind == "kill" and f.applied and f.error is None]
-    if not kills:
+    landed = [f for f in schedule
+              if f.kind == "kill" and f.applied and f.error is None]
+    kills = [f.target for f in landed if f.target != 0]
+    center_kills = [f for f in landed if f.target == 0]
+    if not landed:
         print("warning: no kill fault landed on a live worker — nothing "
               "to audit (workers finished before the schedule fired?)")
     ok, trans = audit_membership(args.record_dir, kills)
     for e in trans:
         print(f"  {e['ev']} worker={e.get('worker')} "
               f"reason={e.get('reason') or e.get('kind')}")
+    dup_injected = bool(net_schedule) and \
+        any(f.kind == "net_dup" and f.applied for f in net_schedule)
+    center_ok, _stats = audit_center(args.record_dir, len(center_kills),
+                                     require_dedup=dup_injected)
+    ok = ok and center_ok
     if not ok:
         return 4
     if args.verify_loss or args.loss_threshold is not None:

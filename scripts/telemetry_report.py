@@ -56,17 +56,21 @@ from collections import defaultdict
 TRACKED_EVENTS = ("phase", "train_record", "val_record", "gauges",
                   "device_profile", "anomaly", "crash", "stall",
                   "fatal_signal", "worker_join", "worker_leave",
-                  "worker_demote", "fault_injected")
+                  "worker_demote", "fault_injected",
+                  "center_down", "center_restored", "wire")
 
 # gauges-event keys drawn as Perfetto counter tracks (plus
 # images_per_sec from train_record events); heartbeat.iter is the
-# membership lease's liveness signal (parallel/membership.py)
+# membership lease's liveness signal (parallel/membership.py);
+# wire.outage_s is the wire client's healed-outage duration
+# (parallel/wire.py)
 TRACE_COUNTER_KEYS = ("hbm_bytes_in_use", "prefetch.queue_depth",
-                      "heartbeat.iter")
+                      "heartbeat.iter", "wire.outage_s")
 
 INSTANT_EVENTS = ("anomaly", "crash", "stall", "fatal_signal",
                   "worker_join", "worker_leave", "worker_demote",
-                  "fault_injected")
+                  "fault_injected", "center_down", "center_restored",
+                  "wire")
 
 
 def percentile(values, q):
@@ -225,6 +229,35 @@ def health_flags(events, summaries):
     return flags
 
 
+def wire_health(events, summaries):
+    """Per-rank wire-layer health (parallel/wire.py): rtt percentiles,
+    retry/timeout/corrupt/dedup counters from the summaries, healed
+    outages from the ``wire`` events — the network half of the churn
+    story the membership transitions tell."""
+    out = {}
+    ranks = set(summaries) | {int(e.get("rank", 0)) for e in events
+                              if e.get("ev") == "wire"}
+    for rank in sorted(ranks):
+        s = summaries.get(rank, {})
+        row = {k: v for k, v in s.get("counters", {}).items()
+               if k.startswith("wire.")}
+        h = s.get("hist", {}).get("wire.rtt")
+        if h:
+            row["rtt_count"] = h.get("count")
+            row["rtt_p50"] = h.get("p50")
+            row["rtt_p99"] = h.get("p99")
+        outages = [e for e in events
+                   if e.get("ev") == "wire" and e.get("kind") == "outage"
+                   and int(e.get("rank", 0)) == rank]
+        if outages:
+            row["outages"] = len(outages)
+            row["outage_total_s"] = round(
+                sum(float(e.get("secs", 0.0)) for e in outages), 3)
+        if row:
+            out[rank] = row
+    return out
+
+
 def build_trace(events):
     """Merged per-rank events → Chrome trace-event JSON (Perfetto/
     chrome://tracing).  Layout: one process per rank (pid = rank) with a
@@ -328,7 +361,8 @@ def build_report(record_dir, window_s=10.0, events=None):
          "rejoin": ev.get("rejoin")}
         for ev in events
         if ev["ev"] in ("worker_join", "worker_leave", "worker_demote",
-                        "fault_injected")]
+                        "fault_injected", "center_down",
+                        "center_restored")]
     return {
         "record_dir": os.path.abspath(record_dir),
         "runs": runs, "ranks": ranks, "events": len(events),
@@ -338,6 +372,7 @@ def build_report(record_dir, window_s=10.0, events=None):
         "straggler_ranking": straggler_ranking(events, window_s),
         "flags": health_flags(events, summaries),
         "counters": {r: s.get("counters", {}) for r, s in summaries.items()},
+        "wire": wire_health(events, summaries),
         "membership_events": membership,
         "crash_events": crashes,
         "flight_dumps": dumps,
@@ -400,11 +435,28 @@ def print_report(rep):
         for rank, kinds in sorted(an.items()):
             pretty = ", ".join(f"{k}×{n}" for k, n in sorted(kinds.items()))
             print(f"  rank {rank}: {pretty}")
+    if rep.get("wire"):
+        print("\nwire health (center RPC layer):")
+        for rank, w in sorted(rep["wire"].items()):
+            rtt = (f"rtt p50 {w['rtt_p50'] * 1e3:.1f}ms "
+                   f"p99 {w['rtt_p99'] * 1e3:.1f}ms "
+                   f"over {w['rtt_count']} ops"
+                   if w.get("rtt_p50") is not None else "no rtt samples")
+            churn = ", ".join(
+                f"{k.split('.', 1)[1]}×{int(v)}" for k, v in sorted(
+                    w.items()) if k.startswith("wire.") and v)
+            outage = (f", outages {w['outages']} "
+                      f"({w['outage_total_s']}s total)"
+                      if w.get("outages") else "")
+            print(f"  rank {rank}: {rtt}"
+                  + (f" — {churn}" if churn else "") + outage)
     if rep.get("membership_events"):
         print("\nmembership transitions / injected faults:")
-        for ev in rep["membership_events"][-10:]:
+        for ev in rep["membership_events"][-12:]:
             detail = ev.get("reason") or ev.get("kind") or ""
-            print(f"  {ev['ev']} worker {ev.get('worker')}"
+            who = "center" if ev["ev"].startswith("center_") \
+                else f"worker {ev.get('worker')}"
+            print(f"  {ev['ev']} {who}"
                   + (f" ({detail})" if detail else "")
                   + (" [rejoin]" if ev.get("rejoin") else ""))
     if rep["crash_events"]:

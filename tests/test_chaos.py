@@ -242,6 +242,196 @@ def test_supervise_crash_loop_breaker_stops_with_flight_tail(tmp_path,
     assert "flight tail" in err                    # the evidence printed
 
 
+# -- wire-level chaos (round 14): the faulting proxy -------------------------
+
+def test_parse_schedule_net_kinds():
+    faults = chaos.parse_schedule("net_dup@5:-1:6,net_partition@12:2:3")
+    assert [(f.kind, f.at, f.target, f.duration) for f in faults] == [
+        ("net_dup", 5.0, -1, 6.0), ("net_partition", 12.0, 2, 3.0)]
+    # a pid-targeted monkey must ignore net faults (the proxy's job)
+    monkey = chaos.ChaosMonkey(faults + chaos.parse_schedule("kill@1:0"))
+    assert [f.kind for f in monkey.schedule] == ["kill"]
+
+
+def test_proxy_duplicates_frames_and_server_dedups():
+    import numpy as np
+
+    from theanompi_tpu.parallel.center_server import CenterServer, \
+        RemoteCenter
+    srv = CenterServer(alpha=0.5)
+    host, port = srv.start()
+    proxy = chaos.ChaosProxy(f"{host}:{port}",
+                             chaos.parse_schedule("net_dup@0:-1:30"))
+    paddr = proxy.start()
+    try:
+        rc = RemoteCenter(paddr, alpha=0.5, client_id="w1")
+        rc.ensure_init({"w": np.ones(3, np.float32)})
+        rc.push_delta({"w": np.full(3, 2.0, np.float32)}, island=1)
+        st = rc.stats()
+        # every frame arrived TWICE; each MUTATING op applied ONCE (init/
+        # pull/stats are naturally idempotent — no token, no dedup count)
+        assert st["n_updates"] == 1
+        assert st["dedup_hits"] == 1        # the duplicated push
+        np.testing.assert_allclose(rc.pull()["w"], 2.0)
+        assert proxy.frames_faulted.get("net_dup", 0) >= 3
+        assert proxy.applied and proxy.applied[0].kind == "net_dup"
+        rc.close()
+    finally:
+        proxy.stop()
+        srv.stop()
+
+
+def test_proxy_drop_and_corrupt_are_survived_by_retry():
+    import numpy as np
+
+    from theanompi_tpu.parallel.center_server import CenterServer, \
+        RemoteCenter
+    from theanompi_tpu.parallel.membership import Backoff
+    from theanompi_tpu.utils import telemetry
+    tm = telemetry.Telemetry(rank=0, run_id="proxy-test")
+    srv = CenterServer(alpha=0.5)
+    host, port = srv.start()
+    t0 = time.time()
+    proxy = chaos.ChaosProxy(
+        f"{host}:{port}",
+        chaos.parse_schedule("net_corrupt@0:-1:1.2,net_drop@1.3:-1:1.2"),
+        t0=t0, telemetry_=tm)
+    paddr = proxy.start()
+    try:
+        rc = RemoteCenter(paddr, alpha=0.5, client_id="w1",
+                          op_timeout_s=0.5, max_retries=20, deadline_s=30,
+                          telemetry_=tm)
+        rc._wire.backoff = Backoff(base=0.05, cap=0.3)
+        rc.ensure_init({"w": np.ones(3, np.float32)})       # corrupt window
+        time.sleep(max(0.0, t0 + 1.4 - time.time()))
+        rc.push_delta({"w": np.full(3, 2.0, np.float32)}, island=1)  # drops
+        st = rc.stats()
+        assert st["n_updates"] == 1                         # exactly once
+        np.testing.assert_allclose(rc.pull()["w"], 2.0)
+        assert proxy.frames_faulted.get("net_corrupt", 0) >= 1
+        assert proxy.frames_faulted.get("net_drop", 0) >= 1
+        # corrupt → server-detected CRC failure → client retried the token;
+        # drop → op timeout → reconnect+retry
+        assert tm.counters.get("wire.corrupt", 0) >= 1
+        assert tm.counters.get("wire.timeout", 0) >= 1
+        rc.close()
+    finally:
+        proxy.stop()
+        srv.stop()
+
+
+def test_partitioned_easgd_island_reconnects_and_resyncs():
+    """Satellite gate: an EASGD worker behind a partition keeps training
+    locally (exchanges SKIPPED, not fatal), reconnects when the partition
+    heals, and its pushes land on the live center again."""
+    from tests.conftest import TinyModel
+    from theanompi_tpu.parallel.async_easgd import AsyncEASGDTrainer
+    from theanompi_tpu.parallel.center_server import CenterServer
+
+    def factory(cfg):
+        cfg = dict(cfg)
+        cfg["verbose"] = False
+        cfg.setdefault("batch_size", 8)
+        return TinyModel(cfg)
+
+    srv = CenterServer(alpha=0.5)
+    host, port = srv.start()
+    # window armed only once the island is live (model build + first
+    # exchange can outlast any fixed schedule on a loaded CI box)
+    sched = chaos.parse_schedule("net_partition@0.2:-1:2.0")
+    proxy = chaos.ChaosProxy(f"{host}:{port}", sched,
+                             t0=time.time() + 3600)
+    paddr = proxy.start()
+    tr = AsyncEASGDTrainer(factory, {
+        "async_islands": 1, "sync_freq": 1, "seed": 3, "batch_size": 8,
+        "center_addr": paddr, "wire_timeout": 0.5, "wire_retries": 2,
+        "wire_deadline": 1.0})
+    try:
+        tr.start()
+        isl = tr.islands[0]
+        deadline = time.time() + 180
+        while isl.exchanges_done < 1 and time.time() < deadline:
+            assert isl.error is None, isl.error
+            time.sleep(0.05)
+        assert isl.exchanges_done >= 1, "island never reached the center"
+        proxy.t0 = time.time()                  # partition in 0.2s, 2s long
+        time.sleep(2.6)                          # ride through the window
+        skipped = isl.exchanges_skipped
+        e_heal = isl.exchanges_done
+        while isl.exchanges_done < e_heal + 2 and time.time() < deadline:
+            assert isl.error is None, isl.error
+            time.sleep(0.05)
+        tr.stop_and_join(timeout=120)
+        assert isl.error is None
+        assert skipped >= 1, "the partition never bit an exchange"
+        # reconnected: post-heal exchanges landed on the LIVE center
+        assert isl.exchanges_done >= e_heal + 2
+        assert srv.center.n_updates >= e_heal + 2
+        # the run's stats surface the outage
+        assert tr.stats()["islands"][0]["exchanges_skipped"] == \
+            isl.exchanges_skipped
+    finally:
+        proxy.stop()
+        srv.stop()
+
+
+def test_elastic_center_sigkill_recovers_without_world_restart(tmp_path):
+    """The round-14 fast chaos gate: SIGKILL the CENTER mid-run while a
+    net_dup window duplicates every frame; the elastic EASGD run completes
+    with no world restart (each worker joins exactly once), the telemetry
+    stream carries the center_down → center_restored pair, and every
+    landed duplicate push was applied exactly once (dedup counter > 0,
+    bookkeeping balanced)."""
+    record_dir = str(tmp_path)
+    schedule = chaos.parse_schedule("kill@18:0")      # worker 0 = center
+    net_schedule = chaos.parse_schedule("net_dup@0:-1:600")
+    # iter_sleep stretches each worker's run to ≥ steps·sleep ≈ 24 s of
+    # training AFTER the center first answers (ensure_init gates the
+    # loop), so the t=18 kill always lands MID-run whatever the box's
+    # load — and a worker blocked in an exchange retry rides out the
+    # whole respawn instead of finishing before `center_restored`
+    rc = mb.run_elastic(
+        "easgd", "tests.conftest", "SleepyModel",
+        {"sync_freq": 2, "batch_size": 8, "iter_sleep": 0.2,
+         "wire_timeout": 5, "wire_deadline": 90,
+         "center_snapshot_every_s": 0.5}, 2,
+        record_dir=record_dir, steps=120, host_devices=1,
+        chaos_schedule=schedule, net_chaos_schedule=net_schedule,
+        center_proc=True, timeout_s=420,
+        supervisor_kw={"poll_s": 0.2, "backoff": mb.Backoff(base=0.3),
+                       "lease_timeout": 120.0})
+    assert rc == 0
+    assert schedule[0].error is None, "center kill never landed"
+    events = _merged_events(record_dir)
+    downs = [e for e in events if e["ev"] == "center_down"]
+    restores = [e for e in events if e["ev"] == "center_restored"]
+    assert downs, "no center_down for the SIGKILLed center"
+    assert restores, "center never audited as restored"
+    assert restores[-1]["ts"] > downs[-1]["ts"], "run ended center-down"
+    # no world restart: every worker joined exactly once and finished
+    for w in (1, 2):
+        joins = [e for e in events if e["ev"] == "worker_join"
+                 and e.get("worker") == w]
+        finishes = [e for e in events if e["ev"] == "worker_leave"
+                    and e.get("worker") == w
+                    and e.get("reason") == "finished"]
+        assert len(joins) == 1, (w, joins)
+        assert finishes, (w, "did not finish cleanly")
+    # the duplicate pushes were deduplicated, applied exactly once
+    with open(os.path.join(record_dir, "center_stats.json")) as f:
+        stats = json.load(f)
+    assert stats["dedup_hits"] > 0, stats
+    assert stats["n_updates"] == sum(stats["by_island"].values())
+    assert stats["center_downs"] >= 1
+    assert os.path.exists(os.path.join(record_dir, "center_final.npz"))
+    # chaos_run's own audit logic agrees (the CI gate path)
+    sys.path.insert(0, os.path.join(REPO, "scripts"))
+    import chaos_run
+    ok, _ = chaos_run.audit_center(record_dir, n_center_kills=1,
+                                   require_dedup=True)
+    assert ok
+
+
 # -- slow: the full convergence-under-chaos gate -----------------------------
 
 @pytest.mark.slow
@@ -289,3 +479,41 @@ def test_chaos_gate_easgd_convergence_under_kills(tmp_path):
     # within the fault-free run's neighborhood
     assert chaos_loss < 0.69, (chaos_loss, clean_loss)
     assert chaos_loss < clean_loss + 0.15, (chaos_loss, clean_loss)
+
+
+@pytest.mark.slow
+def test_chaos_gate_center_kill_and_net_faults_convergence(tmp_path):
+    """The full round-14 acceptance gate, driven through chaos_run's own
+    CLI: center SIGKILLed once, a seeded drop/delay/dup/corrupt/partition
+    schedule active, and the run must complete without a world restart
+    with the leave/join + center_down/center_restored audits passing,
+    duplicates deduplicated, and final center val cost under the
+    fault-free reference threshold."""
+    sys.path.insert(0, os.path.join(REPO, "scripts"))
+    import chaos_run
+
+    cfg = {"sync_freq": 2, "batch_size": 8}
+    clean_dir = str(tmp_path / "clean")
+    rc = mb.run_elastic("easgd", "tests.conftest", "TinyModel", dict(cfg),
+                        2, record_dir=clean_dir, steps=80, host_devices=1,
+                        timeout_s=420)
+    assert rc == 0
+    clean_loss = chaos_run.eval_center_loss(
+        "tests.conftest", "TinyModel", dict(cfg),
+        os.path.join(clean_dir, "center_final.npz"))
+
+    chaos_dir = str(tmp_path / "chaos")
+    rc = chaos_run.main([
+        "--rule", "easgd", "--workers", "2", "--steps", "80",
+        "--faults", "kill@16:0,kill@20:1",      # the center AND a worker
+        "--net-seed", "11", "--net-n-faults", "4",
+        "--net-duration", "2.5", "--t-min", "8", "--t-max", "30",
+        "--record-dir", chaos_dir, "--host-devices", "1",
+        "--lease-timeout", "60",
+        "--loss-threshold", str(clean_loss + 0.15),
+        "sync_freq=2", "batch_size=8", "wire_timeout=5",
+        "wire_deadline=60", "center_snapshot_every_s=0.5"])
+    assert rc == 0, f"chaos_run gate failed rc={rc}"
+    with open(os.path.join(chaos_dir, "chaos_gate.json")) as f:
+        gate = json.load(f)
+    assert gate["val_cost"] < clean_loss + 0.15

@@ -246,6 +246,62 @@ def test_center_reactor_drives_demote_and_readmit():
     assert center.demoted == set()
 
 
+def test_center_down_restored_event_pair():
+    """The round-14 outage pair: controller-emitted, worker-less, audited
+    by chaos_run's center gate and rendered as instant markers."""
+    tm = _tm()
+    ctl = mb.MembershipController(telemetry_=tm)
+    ctl.center_down(reason="crashed", rc=-9)
+    ctl.center_restored(attempt=1)
+    evs = _events(tm, *mb.CENTER_EVENTS)
+    assert [e["ev"] for e in evs] == ["center_down", "center_restored"]
+    assert evs[0]["reason"] == "crashed" and evs[0]["rc"] == -9
+    assert [t[0] for t in ctl.transitions] == list(mb.CENTER_EVENTS)
+
+
+def test_center_reactor_defers_through_outage_and_flushes():
+    """A demote/readmit against a DOWN center must not raise into the
+    supervision loop — the intent is remembered and lands on flush once
+    the center answers again."""
+    class FlakyCenter:
+        def __init__(self):
+            self.up = False
+            self.demoted = set()
+
+        def demote_island(self, island):
+            if not self.up:
+                raise ConnectionError("center down")
+            self.demoted.add(island)
+
+        def readmit_island(self, island):
+            if not self.up:
+                raise ConnectionError("center down")
+            self.demoted.discard(island)
+
+    center = FlakyCenter()
+    center.up = True
+    reactor = mb.CenterReactor(center)
+    ctl = mb.MembershipController(telemetry_=_tm(), reactors=[reactor])
+    ctl.join(1)
+    ctl.join(2)
+    center.up = False                       # the outage begins
+    ctl.leave(1, reason="crashed")          # center down: deferred
+    assert center.demoted == set()
+    assert reactor._pending == {1: "demote"}
+    center.up = True
+    reactor.flush_pending()
+    assert center.demoted == {1}
+    assert reactor._pending == {}
+    # latest intent wins while deferred
+    center.up = False
+    ctl.leave(2, reason="crashed")
+    ctl.join(2, reason="respawn")
+    assert reactor._pending == {2: "readmit"}
+    center.up = True
+    reactor.flush_pending()
+    assert center.demoted == {1}            # 2 readmitted, 1 still out
+
+
 def test_remote_center_demote_over_the_wire():
     srv = CenterServer(alpha=0.5)
     host, port = srv.start()

@@ -1,0 +1,539 @@
+"""The resilient RPC wire layer (parallel/wire.py, docs/design.md §15):
+framing taxonomy, CRC/version integrity, client retry/reconnect/give-up,
+the server dedup window's exactly-once contract, and center snapshot
+crash recovery."""
+
+import json
+import socket
+import struct
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from theanompi_tpu.parallel import wire
+from theanompi_tpu.parallel.center_server import (CenterServer,
+                                                  RemoteCenter,
+                                                  snapshot_path)
+from theanompi_tpu.parallel.membership import Backoff
+from theanompi_tpu.utils import telemetry
+
+
+def _tm():
+    return telemetry.Telemetry(rank=0, run_id="wire-test")
+
+
+def _fast_client(addr, **kw):
+    kw.setdefault("op_timeout_s", 2.0)
+    kw.setdefault("connect_timeout_s", 1.0)
+    kw.setdefault("max_retries", 6)
+    kw.setdefault("deadline_s", 20.0)
+    kw.setdefault("backoff", Backoff(base=0.05, cap=0.3))
+    return wire.WireClient(addr, **kw)
+
+
+# -- framing -----------------------------------------------------------------
+
+def test_framing_roundtrip_and_crc_detection():
+    a, b = socket.socketpair()
+    try:
+        body = b"x" * 1000
+        wire.send_msg(a, {"op": "probe", "n": 3}, body)
+        header, got = wire.recv_msg(b)
+        assert header["op"] == "probe" and header["n"] == 3
+        assert got == body and header["v"] == wire.WIRE_VERSION
+        # corrupt ONE body byte in flight: the body CRC must catch it
+        # (header CRC intact → stream provably aligned → retryable)
+        wire.send_msg(a, {"op": "probe"}, body)
+        hl = wire.recv_exact(b, 4, at_boundary=True)
+        hcrc = wire.recv_exact(b, 4)
+        hb = wire.recv_exact(b, struct.unpack("!I", hl)[0])
+        bl = wire.recv_exact(b, 4)
+        raw = bytearray(wire.recv_exact(b, struct.unpack("!I", bl)[0]))
+        raw[500] ^= 0xFF
+        c, d = socket.socketpair()
+        try:
+            c.sendall(hl + hcrc + hb + bl + bytes(raw))
+            with pytest.raises(wire.CorruptPayload, match="CRC"):
+                wire.recv_msg(d)
+        finally:
+            c.close()
+            d.close()
+        # corrupt a HEADER byte: FramingError (drop, don't reuse) — a
+        # header flip is indistinguishable from a length flip
+        wire.send_msg(a, {"op": "probe"}, b"")
+        hl = wire.recv_exact(b, 4, at_boundary=True)
+        hcrc = wire.recv_exact(b, 4)
+        hb = bytearray(wire.recv_exact(b, struct.unpack("!I", hl)[0]))
+        bl = wire.recv_exact(b, 4)
+        hb[2] ^= 0xFF
+        c, d = socket.socketpair()
+        try:
+            c.sendall(hl + hcrc + bytes(hb) + bl)
+            with pytest.raises(wire.FramingError, match="header CRC"):
+                wire.recv_msg(d)
+        finally:
+            c.close()
+            d.close()
+    finally:
+        a.close()
+        b.close()
+
+
+def test_clean_close_vs_mid_message_truncation():
+    """The old code raised one ConnectionError for both; the client must
+    be able to tell 'peer left between requests' (retry freely) from
+    'payload lost mid-flight'."""
+    a, b = socket.socketpair()
+    a.close()                               # clean close at a boundary
+    with pytest.raises(wire.ConnectionClosed):
+        wire.recv_msg(b)
+    b.close()
+
+    a, b = socket.socketpair()
+    hb = json.dumps({"op": "x", "v": wire.WIRE_VERSION}).encode()
+    a.sendall(struct.pack("!I", len(hb)) + hb[: len(hb) // 2])
+    a.close()                               # died mid-header
+    with pytest.raises(wire.TruncatedMessage, match="mid-message"):
+        wire.recv_msg(b)
+    b.close()
+    # and the subclass relationship keeps legacy handlers working
+    assert issubclass(wire.ConnectionClosed, ConnectionError)
+    assert issubclass(wire.TruncatedMessage, ConnectionError)
+
+
+def test_version_mismatch_fails_loudly_with_both_versions():
+    a, b = socket.socketpair()
+    try:
+        a.sendall(wire.encode_frame({"op": "x", "v": 999999}))
+        with pytest.raises(wire.VersionMismatch) as ei:
+            wire.recv_msg(b)
+        msg = str(ei.value)
+        assert "999999" in msg and str(wire.WIRE_VERSION) in msg
+    finally:
+        a.close()
+        b.close()
+
+
+def test_server_replies_version_mismatch_with_both_versions():
+    """A mismatched CLIENT gets an error reply naming both versions — not
+    a silent close, not a misparse."""
+    srv = CenterServer(alpha=0.5)
+    host, port = srv.start()
+    try:
+        s = socket.create_connection((host, port), timeout=5)
+        s.sendall(wire.encode_frame({"op": "stats", "v": 0}))
+        header, _ = wire.recv_msg(s)
+        assert header["ok"] is False
+        assert "v0" in header["error"] and \
+            f"v{wire.WIRE_VERSION}" in header["error"]
+        s.close()
+    finally:
+        srv.stop()
+
+
+# -- client resilience -------------------------------------------------------
+
+class _FlakyServer(threading.Thread):
+    """Accepts connections; drops the first ``drop_conns`` connections
+    after reading one frame (no reply), then serves ``stats`` forever."""
+
+    def __init__(self, drop_conns=1, stall_first=False):
+        super().__init__(daemon=True)
+        self.sock = socket.socket()
+        self.sock.bind(("127.0.0.1", 0))
+        self.sock.listen(8)
+        self.addr = "127.0.0.1:%d" % self.sock.getsockname()[1]
+        self.drop_conns = drop_conns
+        self.stall_first = stall_first
+        self.requests = 0
+        self._halt = threading.Event()
+
+    def run(self):
+        conns = 0
+        while not self._halt.is_set():
+            try:
+                c, _ = self.sock.accept()
+            except OSError:
+                return
+            conns += 1
+            try:
+                while True:
+                    header, _ = wire.recv_msg(c)
+                    self.requests += 1
+                    if conns <= self.drop_conns:
+                        if self.stall_first:
+                            time.sleep(5.0)     # force a client op timeout
+                        c.close()               # reply lost / conn dropped
+                        break
+                    wire.send_msg(c, {"ok": True, "echo": header.get("op")})
+            except (ConnectionError, OSError):
+                pass
+
+    def stop(self):
+        self._halt.set()
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+
+
+def test_client_reconnects_and_retries_through_dropped_connection():
+    srv = _FlakyServer(drop_conns=1)
+    srv.start()
+    tm = _tm()
+    try:
+        client = _fast_client(srv.addr, client_id="w9", telemetry_=tm)
+        resp, _ = client.request({"op": "stats"})
+        assert resp["ok"] and resp["echo"] == "stats"
+        assert tm.counters.get("wire.retry", 0) >= 1
+        assert tm.counters.get("wire.reconnect", 0) >= 1
+        # the heal recorded an outage gauge + rtt sample
+        assert "wire.outage_s" in tm.gauges
+        assert tm.hists["wire.rtt"].count >= 1
+        client.close()
+    finally:
+        srv.stop()
+
+
+def test_client_times_out_and_gives_up_with_clear_error():
+    srv = _FlakyServer(drop_conns=99, stall_first=True)
+    srv.start()
+    tm = _tm()
+    try:
+        client = _fast_client(srv.addr, client_id="w9", telemetry_=tm,
+                              op_timeout_s=0.3, max_retries=1,
+                              deadline_s=2.0)
+        with pytest.raises(wire.WireGiveUp) as ei:
+            client.request({"op": "pull"})
+        msg = str(ei.value)
+        assert "gave up" in msg and "'pull'" in msg and "attempts" in msg
+        assert tm.counters.get("wire.timeout", 0) >= 1
+        assert tm.counters.get("wire.giveup", 0) == 1
+        evs = [e for e in tm.tail(8) if e["ev"] == wire.WIRE_EVENT]
+        assert evs and evs[-1]["kind"] == "giveup"
+        client.close()
+    finally:
+        srv.stop()
+
+
+def test_client_gives_up_fast_on_dead_address():
+    """The satellite contract: a dead center at spawn time must produce a
+    bounded, DIAGNOSABLE give-up — not a hang."""
+    tm = _tm()
+    client = wire.WireClient("127.0.0.1:9", client_id="w1",
+                             connect_timeout_s=0.2, op_timeout_s=0.2,
+                             max_retries=2, deadline_s=1.5,
+                             backoff=Backoff(base=0.02, cap=0.05),
+                             telemetry_=tm)
+    t0 = time.time()
+    with pytest.raises(wire.WireGiveUp, match="unreachable"):
+        client.request({"op": "pull"})
+    assert time.time() - t0 < 10.0
+    assert tm.counters.get("wire.giveup", 0) == 1
+
+
+# -- dedup window ------------------------------------------------------------
+
+def test_dedup_window_claim_record_release_and_hwm():
+    win = wire.DedupWindow(depth=4, telemetry_=telemetry.DISABLED)
+    tok = {"w": "w1", "seq": 0}
+    fresh, _ = win.check(tok, "push")
+    assert fresh is False                     # fresh = not duplicate
+    dup, cached = win.check(tok, "push")      # in-flight twin
+    assert dup and cached is wire.INFLIGHT    # busy, NOT an ack
+    win.record(tok, "push", {"ok": True}, b"r")
+    dup, cached = win.check(tok, "push")
+    assert dup and cached == ({"ok": True}, b"r")
+    # release withdraws an UNrecorded claim only
+    tok2 = {"w": "w1", "seq": 1}
+    win.check(tok2, "push")
+    win.release(tok2, "push")
+    fresh2, _ = win.check(tok2, "push")
+    assert fresh2 is False                    # claimable again
+    win.record(tok2, "push", {"ok": True})
+    # below-HWM tokens evicted from the window still dedup (synthesized)
+    for seq in range(2, 10):
+        t = {"w": "w1", "seq": seq}
+        win.check(t, "push")
+        win.record(t, "push", {"ok": True})
+    dup_old, cached_old = win.check({"w": "w1", "seq": 0}, "push")
+    assert dup_old and cached_old is None
+    # snapshots persist APPLIED tokens, never in-flight claims
+    win.check({"w": "w1", "seq": 99}, "push")      # claim, not recorded
+    snap = win.snapshot()
+    assert ["push", 99] not in snap["tokens"]["w1"]
+    win2 = wire.DedupWindow(telemetry_=telemetry.DISABLED)
+    win2.restore(snap)
+    dup_r, cached_r = win2.check({"w": "w1", "seq": 9}, "push")
+    assert dup_r and cached_r is not None and cached_r[1] is None
+    fresh_r, _ = win2.check({"w": "w1", "seq": 99}, "push")
+    assert fresh_r is False                   # the claim did not persist
+
+
+def _raw_push(sock, island, seq, leaves, w="w1", op="push"):
+    wire.send_msg(sock, {"op": op, "island": island,
+                         "tok": {"w": w, "seq": seq}},
+                  wire.pack_leaves(leaves))
+
+
+def test_duplicated_push_applied_exactly_once_by_server():
+    """THE dedup-window contract (satellite): the same framed push sent
+    twice (a retry whose original actually landed, or a chaos-proxy
+    duplicate) moves the center ONCE; the duplicate gets a valid reply."""
+    srv = CenterServer(alpha=0.5)
+    host, port = srv.start()
+    try:
+        boot = RemoteCenter(f"{host}:{port}", alpha=0.5, client_id="boot")
+        boot.ensure_init({"w": np.ones(3, np.float32)})
+        s = socket.create_connection((host, port), timeout=5)
+        delta = [np.full(3, 2.0, np.float32)]
+        _raw_push(s, island=1, seq=0, leaves=delta)
+        h1, _ = wire.recv_msg(s)
+        _raw_push(s, island=1, seq=0, leaves=delta)     # the duplicate
+        h2, _ = wire.recv_msg(s)
+        assert h1["ok"] and h2["ok"]
+        after = boot.pull_leaves()[0]
+        np.testing.assert_allclose(after, 2.0)          # 1 + 0.5·2, ONCE
+        st = boot.stats()
+        assert st["n_updates"] == 1
+        assert st["dedup_hits"] == 1
+        # push_pull: duplicate reply still carries a full center body
+        _raw_push(s, island=1, seq=1, leaves=delta, op="push_pull")
+        wire.recv_msg(s)
+        _raw_push(s, island=1, seq=1, leaves=delta, op="push_pull")
+        hd, body = wire.recv_msg(s)
+        assert hd["ok"]
+        np.testing.assert_allclose(wire.unpack_leaves(body)[0], 4.0)
+        assert boot.stats()["n_updates"] == 2
+        s.close()
+    finally:
+        srv.stop()
+
+
+def test_framing_error_on_corrupted_length_prefix():
+    """A blown length prefix means the STREAM is desynced — FramingError,
+    not CorruptPayload: the connection must be dropped, not reused."""
+    a, b = socket.socketpair()
+    try:
+        a.sendall(struct.pack("!I", 0xFFFFFFFF))      # 4 GiB header?!
+        with pytest.raises(wire.FramingError, match="desynced"):
+            wire.recv_msg(b)
+    finally:
+        a.close()
+        b.close()
+    a, b = socket.socketpair()
+    try:
+        import zlib
+        hb = json.dumps({"op": "x", "v": wire.WIRE_VERSION}).encode()
+        a.sendall(struct.pack("!I", len(hb))
+                  + struct.pack("!I", zlib.crc32(hb) & 0xFFFFFFFF) + hb
+                  + struct.pack("!I", 0xFFFFFFF0))    # huge body length
+        with pytest.raises(wire.FramingError, match="desynced"):
+            wire.recv_msg(b)
+        # the body bound is LIVE for u32 values (a 4<<30 bound never was)
+        assert 0xFFFFFFF0 > wire._MAX_BODY
+    finally:
+        a.close()
+        b.close()
+
+
+def test_uninitialized_center_is_structured_and_recoverable():
+    """A respawned center with no usable snapshot must answer pull/push
+    with a STRUCTURED uninit verdict the clients can recover from by
+    re-seeding — not an opaque assertion repr that crashes every
+    worker into the world restart the design forbids."""
+    srv = CenterServer(alpha=0.5)
+    host, port = srv.start()
+    try:
+        c = RemoteCenter(f"{host}:{port}", alpha=0.5, client_id="w1")
+        with pytest.raises(wire.CenterUninitialized, match="re-seed"):
+            c.pull_leaves()
+        with pytest.raises(wire.CenterUninitialized):
+            c.push_delta({"w": np.ones(3, np.float32)}, island=1)
+        c.ensure_init({"w": np.ones(3, np.float32)})   # the recovery
+        c.push_delta({"w": np.full(3, 2.0, np.float32)}, island=1)
+        assert c.stats()["n_updates"] == 1
+        c.close()
+    finally:
+        srv.stop()
+
+
+def test_island_reseeds_after_snapshotless_center_restart():
+    """The cascade fix end to end: the center dies BEFORE any snapshot
+    landed and comes back empty; the island re-seeds the consensus from
+    its own params and keeps training — no worker death, no restart."""
+    from tests.conftest import TinyModel
+    from theanompi_tpu.parallel.async_easgd import AsyncEASGDTrainer
+
+    def factory(cfg):
+        cfg = dict(cfg)
+        cfg["verbose"] = False
+        cfg.setdefault("batch_size", 8)
+        return TinyModel(cfg)
+
+    srv = CenterServer(alpha=0.5)              # NO snapshot dir
+    host, port = srv.start()
+    tr = AsyncEASGDTrainer(factory, {
+        "async_islands": 1, "sync_freq": 1, "seed": 3, "batch_size": 8,
+        "center_addr": f"{host}:{port}", "wire_timeout": 0.5,
+        "wire_retries": 2, "wire_deadline": 1.0})
+    srv2 = None
+    try:
+        tr.start()
+        isl = tr.islands[0]
+        deadline = time.time() + 180
+        while isl.exchanges_done < 1 and time.time() < deadline:
+            assert isl.error is None, isl.error
+            time.sleep(0.05)
+        assert isl.exchanges_done >= 1
+        srv.stop()                             # killed, nothing persisted
+        srv2 = CenterServer(alpha=0.5)
+        srv2.start(host, port)                 # fresh, SAME port, EMPTY
+        e0 = isl.exchanges_done
+        while isl.exchanges_done < e0 + 2 and time.time() < deadline:
+            assert isl.error is None, isl.error
+            time.sleep(0.05)
+        tr.stop_and_join(timeout=120)
+        assert isl.error is None               # no crash, no cascade
+        assert isl.exchanges_done >= e0 + 2    # training continued
+        assert isl.exchanges_skipped >= 1      # the uninit hit is counted
+        assert srv2.center.n_updates >= 2      # re-seeded center absorbed
+    finally:
+        if srv2 is not None:
+            srv2.stop()
+        srv.stop()
+
+
+# -- server hygiene ----------------------------------------------------------
+
+def test_server_idle_timeout_frees_wedged_handler():
+    """A client that connects and goes silent (SIGSTOP, wedge) must not
+    pin a handler thread forever — the server closes it at the idle
+    timeout while healthy clients keep being served."""
+    srv = CenterServer(alpha=0.5, idle_timeout_s=0.4)
+    host, port = srv.start()
+    try:
+        wedged = socket.create_connection((host, port), timeout=5)
+        wedged.settimeout(3.0)
+        assert wedged.recv(1) == b""          # server hung up on idle
+        wedged.close()
+        healthy = RemoteCenter(f"{host}:{port}", alpha=0.5, client_id="h")
+        healthy.ensure_init({"w": np.zeros(2, np.float32)})
+        assert healthy.stats()["n_updates"] == 0
+        healthy.close()
+    finally:
+        srv.stop()
+
+
+def test_server_corrupt_request_gets_retryable_error_reply():
+    srv = CenterServer(alpha=0.5)
+    host, port = srv.start()
+    try:
+        s = socket.create_connection((host, port), timeout=5)
+        body = wire.pack_leaves([np.ones(3, np.float32)])
+        s.sendall(wire.encode_frame(
+            {"op": "init", "v": wire.WIRE_VERSION,
+             "crc": 12345},                      # wrong on purpose
+            body))
+        header, _ = wire.recv_msg(s)
+        assert header["ok"] is False and header.get("retry") is True
+        # the connection stayed aligned: a good request still works
+        wire.send_msg(s, {"op": "stats"})
+        header, _ = wire.recv_msg(s)
+        assert header["ok"] is True
+        s.close()
+    finally:
+        srv.stop()
+
+
+# -- center snapshot / crash recovery ----------------------------------------
+
+def test_center_snapshot_restore_roundtrip_with_dedup(tmp_path):
+    """Kill-and-restore: params, counters, membership, AND the dedup
+    high-water marks survive — a client retrying a push that landed
+    before the crash is answered from the window, not reapplied."""
+    d = str(tmp_path)
+    srv = CenterServer(alpha=0.5, snapshot_dir=d)
+    host, port = srv.start()
+    client = RemoteCenter(f"{host}:{port}", alpha=0.5, client_id="boot")
+    client.ensure_init({"w": np.ones(3, np.float32)})
+    # the push whose token must survive the crash goes RAW with a known
+    # seq (WireClient seqs are clock-seeded per incarnation)
+    s = socket.create_connection((host, port), timeout=5)
+    push_seq = 1000
+    _raw_push(s, island=1, seq=push_seq,
+              leaves=[np.full(3, 2.0, np.float32)])
+    h, _ = wire.recv_msg(s)
+    assert h["ok"]
+    s.close()
+    client.demote_island(7)
+    srv.stop(final_snapshot=True)             # ≙ SIGTERM'd center
+    assert snapshot_path(d)
+
+    srv2 = CenterServer(alpha=0.5, snapshot_dir=d)
+    assert srv2.restore() is True
+    host2, port2 = srv2.start()
+    try:
+        c2 = RemoteCenter(f"{host2}:{port2}", alpha=0.5, client_id="w2")
+        st = c2.stats()
+        assert st["n_updates"] == 1
+        assert st["demoted"] == [7]
+        np.testing.assert_allclose(c2.pull_leaves()[0], 2.0)
+        # replay the pre-crash push token: must be deduped, not reapplied
+        s = socket.create_connection((host2, port2), timeout=5)
+        _raw_push(s, island=1, seq=push_seq,
+                  leaves=[np.full(3, 2.0, np.float32)])
+        h, _ = wire.recv_msg(s)
+        assert h["ok"]
+        assert c2.stats()["n_updates"] == 1          # NOT reapplied
+        assert c2.stats()["dedup_hits"] >= 1
+        # a NEW incarnation of the same client id (clock-seeded seq) is
+        # NOT deduped — the regression a 0-seeded seq would reintroduce
+        c1b = RemoteCenter(f"{host2}:{port2}", alpha=0.5, client_id="w1")
+        c1b.push_delta({"w": np.full(3, 2.0, np.float32)}, island=1)
+        assert c2.stats()["n_updates"] == 2
+        c1b.close()
+        s.close()
+        c2.close()
+    finally:
+        srv2.stop()
+
+
+def test_remote_center_rides_out_center_restart(tmp_path):
+    """The crash-recovery story end to end in-process: the center dies
+    mid-run, a new one restores from its snapshot on the SAME port, and
+    the client's next op succeeds through retries — no caller-visible
+    error, exactly-once bookkeeping intact."""
+    d = str(tmp_path)
+    srv = CenterServer(alpha=0.5, snapshot_dir=d)
+    host, port = srv.start()
+    tm = _tm()
+    client = RemoteCenter(f"{host}:{port}", alpha=0.5, client_id="w1",
+                          op_timeout_s=1.0, max_retries=10,
+                          deadline_s=30.0, telemetry_=tm)
+    client.ensure_init({"w": np.ones(3, np.float32)})
+    client.push_delta({"w": np.full(3, 2.0, np.float32)}, island=1)
+    srv.stop(final_snapshot=True)
+
+    def _revive():
+        time.sleep(1.0)
+        srv2 = CenterServer(alpha=0.5, snapshot_dir=d)
+        assert srv2.restore()
+        srv2.start(host, port)                # the SAME fixed port
+        _revive.srv = srv2
+
+    t = threading.Thread(target=_revive, daemon=True)
+    t.start()
+    client.push_delta({"w": np.full(3, 2.0, np.float32)}, island=1)
+    t.join()
+    try:
+        st = client.stats()
+        assert st["n_updates"] == 2                   # both pushes, once
+        np.testing.assert_allclose(client.pull_leaves()[0], 3.0)
+        assert tm.counters.get("wire.retry", 0) >= 1
+        assert tm.gauges.get("wire.outage_s", 0) > 0
+        client.close()
+    finally:
+        _revive.srv.stop()

@@ -41,6 +41,7 @@ SENTRY_PATH = "theanompi_tpu/utils/sentry.py"
 REPORT_PATH = "scripts/telemetry_report.py"
 MEMBERSHIP_PATH = "theanompi_tpu/parallel/membership.py"
 CHAOS_PATH = "theanompi_tpu/utils/chaos.py"
+WIRE_PATH = "theanompi_tpu/parallel/wire.py"
 
 # one lane, one module: a compute span [0,50]us and a comm span [40,60]us
 # → compute 50us, comm 20us, exposed 10us, overlap 0.5 — a COMPLETE
@@ -250,6 +251,126 @@ def membership_schema_errors(membership, chaos, telemetry,
     return errors
 
 
+def wire_schema_errors(wire, membership, telemetry,
+                       telemetry_report=None) -> List[tuple]:
+    """Round-14 probes: the resilient-RPC telemetry vocabulary.  A LIVE
+    wire client driven into a dead address must tick its declared
+    counters and emit the declared ``wire`` give-up event; a live dedup
+    window replaying a token must tick ``wire.dedup_hit``; a crafted
+    version-mismatch frame must fail loudly with BOTH versions in the
+    message; the controller's center-outage pair must emit exactly
+    :data:`CENTER_EVENTS`; and the report/trace converter must consume
+    all of it.  ``wire``/``membership`` are file-path-loaded live modules
+    (jax-free); either may be None in a partial tree."""
+    errors: List[tuple] = []
+    if wire is None:
+        return errors
+
+    # 0. declared names are wire-namespaced (report renders by prefix)
+    for name in (wire.WIRE_COUNTERS + wire.WIRE_HISTS + wire.WIRE_GAUGES):
+        if not name.startswith("wire."):
+            errors.append((WIRE_PATH,
+                           f"declared wire metric {name!r} is outside the "
+                           f"'wire.' namespace"))
+
+    # 1. a live client against a dead address: retries, then a loud
+    # give-up — declared counters tick, the declared event kind streams
+    if membership is not None:
+        tm = telemetry.Telemetry(rank=0, run_id="drift-check")
+        client = wire.WireClient(
+            "127.0.0.1:9", client_id="drift", op_timeout_s=0.2,
+            connect_timeout_s=0.2, max_retries=1, deadline_s=1.0,
+            backoff=membership.Backoff(base=0.01, cap=0.02),
+            telemetry_=tm)
+        gave_up = False
+        try:
+            client.request({"op": "stats"})
+        except ConnectionError:
+            gave_up = True
+        if not gave_up:
+            errors.append((WIRE_PATH,
+                           "a WireClient against a dead address did not "
+                           "raise WireGiveUp"))
+        if tm.counters.get("wire.giveup", 0) < 1 or \
+                tm.counters.get("wire.retry", 0) < 1:
+            errors.append((WIRE_PATH,
+                           f"give-up path ticked {sorted(tm.counters)} — "
+                           f"expected wire.retry and wire.giveup counts"))
+        evs = [e for e in tm.tail(8) if e["ev"] == wire.WIRE_EVENT]
+        if not evs or evs[-1].get("kind") != "giveup":
+            errors.append((WIRE_PATH,
+                           f"give-up emitted no {wire.WIRE_EVENT!r} event "
+                           f"with kind='giveup'"))
+
+    # 2. dedup window: a replayed token must be a hit that ticks the
+    # declared counter and does NOT read as fresh
+    tm2 = telemetry.Telemetry(rank=0, run_id="drift-check")
+    win = wire.DedupWindow(telemetry_=tm2)
+    tok = {"w": "drift", "seq": 0}
+    dup, _ = win.check(tok, "push")
+    win.record(tok, "push", {"ok": True})
+    dup2, _ = win.check(tok, "push")
+    if dup or not dup2 or win.hits != 1 or \
+            tm2.counters.get("wire.dedup_hit", 0) != 1:
+        errors.append((WIRE_PATH,
+                       "DedupWindow replay did not register exactly one "
+                       f"wire.dedup_hit (fresh={dup}, dup={dup2}, "
+                       f"hits={win.hits})"))
+
+    # 3. version mismatch fails LOUDLY with both versions in the message
+    import socket as _socket
+    a, b = _socket.socketpair()
+    try:
+        a.sendall(wire.encode_frame({"ok": True, "v": 999999}))
+        try:
+            wire.recv_msg(b)
+            errors.append((WIRE_PATH,
+                           "a version-mismatched frame did not raise"))
+        except wire.VersionMismatch as e:
+            msg = str(e)
+            if "999999" not in msg or str(wire.WIRE_VERSION) not in msg:
+                errors.append((WIRE_PATH,
+                               f"VersionMismatch message lacks both "
+                               f"versions: {msg!r}"))
+    finally:
+        a.close()
+        b.close()
+
+    # 4. the center-outage pair: a live controller must emit exactly
+    # CENTER_EVENTS, and the report must consume them + the wire schema
+    if membership is not None:
+        tm3 = telemetry.Telemetry(rank=0, run_id="drift-check")
+        ctl = membership.MembershipController(telemetry_=tm3)
+        ctl.center_down(reason="probe")
+        ctl.center_restored(attempt=1)
+        got = {e["ev"] for e in tm3.tail(4) if e["ev"] != "run_start"}
+        if got != set(membership.CENTER_EVENTS):
+            errors.append((MEMBERSHIP_PATH,
+                           f"a live controller's center outage pair "
+                           f"emitted {sorted(got)} != CENTER_EVENTS "
+                           f"{sorted(membership.CENTER_EVENTS)}"))
+    if telemetry_report is not None:
+        tracked = set(getattr(telemetry_report, "TRACKED_EVENTS", ()))
+        want = {wire.WIRE_EVENT}
+        if membership is not None:
+            want |= set(getattr(membership, "CENTER_EVENTS", ()))
+        missing = sorted(want - tracked)
+        if missing:
+            errors.append((REPORT_PATH,
+                           f"TRACKED_EVENTS is missing wire/center event "
+                           f"kind(s) {missing} — the chaos gate's "
+                           "center_down→center_restored matching and the "
+                           "wire outage markers would be dropped"))
+        counters = set(getattr(telemetry_report, "TRACE_COUNTER_KEYS", ()))
+        missing_g = sorted(set(wire.WIRE_GAUGES) - counters)
+        if missing_g:
+            errors.append((REPORT_PATH,
+                           f"TRACE_COUNTER_KEYS is missing wire gauge(s) "
+                           f"{missing_g} — the Perfetto export would not "
+                           "render outage durations"))
+    return errors
+
+
 def _load_by_path(relpath: str, name: str):
     """A probed module loaded by FILE path — for modules that are not
     importable in the lint CLI's jax-free process through the synthetic
@@ -316,5 +437,11 @@ class SchemaDriftChecker(Checker):
             "_tpulint_chaos")
         errors += membership_schema_errors(membership, chaos, telemetry,
                                            report)
+        # round 14: the resilient-RPC wire layer (stdlib+numpy at module
+        # scope by contract — file-path loads jax-free like membership)
+        wire = _load_by_path(
+            os.path.join("theanompi_tpu", "parallel", "wire.py"),
+            "_tpulint_wire")
+        errors += wire_schema_errors(wire, membership, telemetry, report)
         return [Finding(self.name, path, 1, 0, msg)
                 for path, msg in errors]

@@ -100,6 +100,13 @@ def main(argv=None) -> int:
     p.add_argument("--host-devices", type=int, default=0, metavar="K",
                    help="elastic mode, CPU venue: each worker simulates K "
                         "chips on the cpu backend (0 = real hardware)")
+    p.add_argument("--center-proc", action="store_true",
+                   help="elastic mode: run the center server as its OWN "
+                        "supervised process — crash-atomic snapshots, "
+                        "respawn-from-snapshot with backoff, the "
+                        "center_down/center_restored event pair; workers "
+                        "ride a center outage out on wire retries "
+                        "(parallel/wire.py, design.md §15)")
     p.add_argument("--compile-cache", default=None, metavar="DIR",
                    help="persistent AOT executable cache dir "
                         "(utils/compile_cache): compile_iter_fns "
@@ -156,7 +163,8 @@ def main(argv=None) -> int:
         return run_elastic(args.rule, args.modelfile, args.modelclass,
                            parse_kv(kv), args.elastic,
                            steps=args.elastic_steps,
-                           host_devices=args.host_devices)
+                           host_devices=args.host_devices,
+                           center_proc=args.center_proc)
 
     if args.supervise > 0:
         # Failure recovery (SURVEY §5): the worker runs as a subprocess so a

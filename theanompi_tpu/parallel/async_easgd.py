@@ -232,6 +232,9 @@ class IslandRunner(threading.Thread):
         self.lease = lease                    # membership.WorkerLease | None
         self.steps_done = 0
         self.exchanges_done = 0
+        # center outages survived mid-run: the island kept training locally
+        # (EASGD/ASGD tolerate missed exchanges) and resynced on reconnect
+        self.exchanges_skipped = 0
         self.error: Optional[BaseException] = None
         self._model_factory = model_factory
 
@@ -243,9 +246,17 @@ class IslandRunner(threading.Thread):
 
     def _run(self) -> None:
         from .exchanger import Exchanger
+        from .wire import CenterUninitialized, WireGiveUp
 
         model = self._model_factory(self.config)
-        self.center.ensure_init(jax.device_get(model.params))
+        try:
+            self.center.ensure_init(jax.device_get(model.params))
+        except WireGiveUp as e:
+            raise RuntimeError(
+                f"island {self.island_id}: center unreachable at startup "
+                f"— cannot seed/join the center store.  Is the center "
+                f"server up (or its supervisor respawning it)?  "
+                f"Underlying wire error: {e}") from e
         # Local-only updates inside the island: the base Exchanger's
         # step_update is exactly the local optimizer step.
         exch = Exchanger(self.config)
@@ -271,8 +282,21 @@ class IslandRunner(threading.Thread):
             # its replica from the live center — on a FRESH center this is
             # an identity (ensure_init seeded it from these very params),
             # on a rejoin it replaces the stale/initial replica with the
-            # consensus the surviving workers kept training
-            _set_params_from(self.center.pull())
+            # consensus the surviving workers kept training.  The pull is
+            # BOUNDED (the wire client's timeout + backoff + deadline): a
+            # dead center at spawn time must fail the rejoin loudly so the
+            # supervisor's backoff gets another shot, not hang the worker
+            # the supervisor just paid to respawn.
+            try:
+                _set_params_from(self.center.pull())
+            except WireGiveUp as e:
+                raise RuntimeError(
+                    f"island {self.island_id}: center_restore failed — the "
+                    f"center stayed unreachable through the wire client's "
+                    f"retry budget, so the rejoining worker cannot restore "
+                    f"its replica.  Giving up (the supervisor's backoff "
+                    f"owns the next attempt).  Underlying wire error: {e}"
+                ) from e
 
         # Jitted elastic update: (boxed params, replicated center) ->
         # (boxed new params, boxed per-worker deltas summed on host later).
@@ -309,20 +333,73 @@ class IslandRunner(threading.Thread):
             if self.throttle_s:
                 time.sleep(self.throttle_s)
             if count % self.sync_freq == 0:
-                if self.rule == "asgd":
-                    mean_p = jax.device_get(mean_fn(
-                        model.step_state["params"]))
-                    delta = jax.tree.map(np.subtract, mean_p, anchor)
-                    anchor = self.center.push_pull(delta, self.island_id)
-                    _set_params_from(anchor)
-                else:
-                    center = self.center.pull()
-                    new_params, delta_mean = elastic_fn(
-                        model.step_state["params"], center)
-                    model.step_state["params"] = new_params
-                    self.center.push_delta(jax.device_get(delta_mean),
-                                           self.island_id)
-                self.exchanges_done += 1
+                # A center outage mid-run is SURVIVABLE: the island skips
+                # the exchange and keeps training locally (the EASGD/ASGD
+                # algebra tolerates missed exchanges by design) — the next
+                # successful pull/push_pull resyncs it against whatever
+                # the center became (restored from snapshot, advanced by
+                # the other islands) while the supervisor respawns it.
+                try:
+                    if self.rule == "asgd":
+                        if anchor is None:
+                            # resync after an outage: the interrupted
+                            # round's push_pull may have LANDED with its
+                            # reply lost — pushing a delta against the
+                            # stale anchor would apply that movement a
+                            # SECOND time under a fresh token the dedup
+                            # window cannot match.  Re-anchor to the
+                            # current center and restart the local
+                            # accumulation (the abandoned round is a
+                            # missed exchange, which downpour absorbs).
+                            anchor = self.center.pull()
+                            _set_params_from(anchor)
+                        else:
+                            mean_p = jax.device_get(mean_fn(
+                                model.step_state["params"]))
+                            delta = jax.tree.map(np.subtract, mean_p,
+                                                 anchor)
+                            anchor = self.center.push_pull(
+                                delta, self.island_id)
+                            _set_params_from(anchor)
+                    else:
+                        center = self.center.pull()
+                        new_params, delta_mean = elastic_fn(
+                            model.step_state["params"], center)
+                        model.step_state["params"] = new_params
+                        self.center.push_delta(jax.device_get(delta_mean),
+                                               self.island_id)
+                    self.exchanges_done += 1
+                except WireGiveUp:
+                    self.exchanges_skipped += 1
+                    if self.rule == "asgd":
+                        # the in-flight push_pull's fate is UNKNOWN (it
+                        # may have landed, reply lost): the anchor can no
+                        # longer be trusted — mark it for resync above
+                        anchor = None
+                    from ..utils import telemetry
+                    tm = telemetry.active()
+                    if tm.enabled:
+                        tm.counter("wire.exchange_skipped")
+                except CenterUninitialized:
+                    # the center respawned with NO usable snapshot (killed
+                    # before its first one landed): re-seed the consensus
+                    # from this island's CURRENT params and carry on — the
+                    # lost center history is a missed exchange, which the
+                    # async algebra absorbs.  Crashing here instead would
+                    # cascade into the world restart the design forbids.
+                    self.exchanges_skipped += 1
+                    from ..utils import telemetry
+                    tm = telemetry.active()
+                    if tm.enabled:
+                        tm.counter("wire.center_reseed")
+                    try:
+                        self.center.ensure_init(
+                            jax.device_get(mean_fn(
+                                model.step_state["params"])))
+                        if self.rule == "asgd":
+                            anchor = self.center.pull()
+                    except (WireGiveUp, CenterUninitialized):
+                        pass           # next exchange gets another shot
 
 
 class AsyncEASGDTrainer:
@@ -367,7 +444,16 @@ class AsyncEASGDTrainer:
         addr = self.config.get("center_addr")
         if addr:
             from .center_server import RemoteCenter
-            self.center = RemoteCenter(str(addr), alpha=self.alpha)
+            # wire resilience knobs (docs/design.md §15): per-op timeout,
+            # bounded-backoff retries with reconnect, give-up deadline —
+            # client identity keys the server's dedup window, so island
+            # ids must stay unique across processes (island_base)
+            self.center = RemoteCenter(
+                str(addr), alpha=self.alpha,
+                client_id=f"w{self._island_base}",
+                op_timeout_s=float(self.config.get("wire_timeout", 20.0)),
+                max_retries=int(self.config.get("wire_retries", 8)),
+                deadline_s=float(self.config.get("wire_deadline", 60.0)))
         else:
             # Center initializes lazily from the first island's params
             # (ensure_init): all islands share the model seed, so their
@@ -456,7 +542,8 @@ class AsyncEASGDTrainer:
         if cu is None:
             cu = self.center.n_updates
         return {"islands": [{"island": r.island_id, "steps": r.steps_done,
-                             "exchanges": r.exchanges_done}
+                             "exchanges": r.exchanges_done,
+                             "exchanges_skipped": r.exchanges_skipped}
                             for r in self.islands],
                 "center_updates": cu}
 

@@ -1,5 +1,5 @@
 """Cross-process elastic center — the reference's EASGD/ASGD *server* over
-a socket.
+a socket, now crash-recoverable behind the resilient wire layer.
 
 The reference ran a dedicated MPI server RANK holding center parameters;
 workers on other nodes exchanged with it over ``MPI.Send/Recv`` at their own
@@ -9,16 +9,25 @@ launcher's supervised subprocesses, or genuinely different hosts — with:
 
 * :class:`CenterServer` — a TCP server wrapping an :class:`ElasticCenter`,
   one thread per client connection, the center lock serializing updates
-  exactly like the reference server serving one worker at a time.
+  exactly like the reference server serving one worker at a time.  Round 14
+  adds the ``parallel/wire.py`` contract (docs/design.md §15): version/CRC
+  framing, per-connection idle timeouts (a wedged client can't pin a
+  handler thread forever), a :class:`~.wire.DedupWindow` so a retried
+  ``push`` that actually landed is applied EXACTLY once, and periodic
+  crash-atomic snapshots (params + membership + dedup state) the center
+  restores from after a SIGKILL — the supervisor respawns it like a worker
+  and the clients ride out the outage on wire retries.
 * :class:`RemoteCenter` — a client with the SAME duck-typed surface as
   ``ElasticCenter`` (``ensure_init`` / ``pull`` / ``push_delta`` /
   ``push_pull``), so :class:`~.async_easgd.IslandRunner` works unchanged
-  whether its center is in-memory or remote.
+  whether its center is in-memory or remote.  Built on
+  :class:`~.wire.WireClient`: per-op timeouts, bounded-backoff retries
+  with reconnect, idempotency tokens.
 
 Wire format (no pickle — arrays only): each message is
-``[4-byte header len][JSON header][4-byte body len][npz body]`` where the
-npz holds the pytree's leaves keyed by flatten order (``leaf0``, ``leaf1``,
-…).  Both ends run the same model config, so the treedef is shared
+``[4B header len][4B header CRC][JSON header][4B body len][npz body]``
+where the npz holds the pytree's leaves keyed by flatten order
+(``leaf0``, ``leaf1``, …).  Both ends run the same model config, so the treedef is shared
 knowledge; the server never needs it (its algebra is leafwise).
 
 Ops: ``init`` (idempotent center seed), ``pull`` → center leaves,
@@ -27,62 +36,54 @@ center += delta_mean, returns the fresh center atomically — the reference's
 accumulated-gradient round-trip), ``demote``/``readmit`` (elastic
 membership: a demoted island's pushes are dropped, pulls still serve —
 ``parallel/membership.py``), ``stats``.
+
+jax imports lazily (client-side tree flatten only): the center server
+process is numpy-level work, and a light import keeps its supervised
+respawn-from-snapshot inside the clients' retry window.
 """
 
 from __future__ import annotations
 
-import io
 import json
-import socket
+import os
 import socketserver
-import struct
 import threading
+import time
 from typing import Dict, List, Optional, Tuple
 
-import jax
 import numpy as np
 
-from .async_easgd import ElasticCenter
+from . import wire
+from .wire import (ConnectionClosed, CorruptPayload, DedupWindow,
+                   TruncatedMessage, VersionMismatch, WireClient,
+                   pack_leaves, unpack_leaves)
+
+try:
+    from ..utils import telemetry
+except ImportError:        # file-path load (jax-free tooling): absolute
+    from theanompi_tpu.utils import telemetry
+
+# back-compat aliases — the framing now lives in parallel/wire.py
+_pack_leaves = pack_leaves
+_unpack_leaves = unpack_leaves
+_send_msg = wire.send_msg
+_recv_msg = wire.recv_msg
 
 
-# -- framing ----------------------------------------------------------------
-
-def _pack_leaves(leaves: List[np.ndarray]) -> bytes:
-    buf = io.BytesIO()
-    np.savez(buf, **{f"leaf{i}": np.asarray(x, np.float32)
-                     for i, x in enumerate(leaves)})
-    return buf.getvalue()
+def snapshot_path(snapshot_dir: str) -> str:
+    return os.path.join(snapshot_dir, "center_state.npz")
 
 
-def _unpack_leaves(body: bytes) -> List[np.ndarray]:
-    if not body:
-        return []
-    with np.load(io.BytesIO(body), allow_pickle=False) as z:
-        return [z[f"leaf{i}"] for i in range(len(z.files))]
-
-
-def _send_msg(sock: socket.socket, header: dict, body: bytes = b"") -> None:
-    h = json.dumps(header).encode()
-    sock.sendall(struct.pack("!I", len(h)) + h
-                 + struct.pack("!I", len(body)) + body)
-
-
-def _recv_exact(sock: socket.socket, n: int) -> bytes:
-    chunks = []
-    while n:
-        c = sock.recv(min(n, 1 << 20))
-        if not c:
-            raise ConnectionError("center connection closed mid-message")
-        chunks.append(c)
-        n -= len(c)
-    return b"".join(chunks)
-
-
-def _recv_msg(sock: socket.socket) -> Tuple[dict, bytes]:
-    (hlen,) = struct.unpack("!I", _recv_exact(sock, 4))
-    header = json.loads(_recv_exact(sock, hlen))
-    (blen,) = struct.unpack("!I", _recv_exact(sock, 4))
-    return header, _recv_exact(sock, blen) if blen else b""
+def load_snapshot(path: str):
+    """``(leaves, meta)`` from one center snapshot file — the ONE parser
+    of the on-disk format (``CenterServer.restore`` and ``run_elastic``'s
+    offline final-state read both go through it, so the layout can't
+    drift between writer and readers).  Raises on a missing/torn file."""
+    with np.load(path, allow_pickle=False) as z:
+        meta = json.loads(bytes(z["_meta"]).decode())
+        n = len([k for k in z.files if k.startswith("leaf")])
+        leaves = [z[f"leaf{i}"] for i in range(n)]
+    return leaves, meta
 
 
 # -- server -----------------------------------------------------------------
@@ -90,71 +91,276 @@ def _recv_msg(sock: socket.socket) -> Tuple[dict, bytes]:
 class CenterServer:
     """Serve an :class:`ElasticCenter` over TCP (≙ the reference's server
     rank).  ``start()`` binds and returns ``(host, port)``; serving happens
-    on daemon threads, one per connection."""
+    on daemon threads, one per connection.
 
-    def __init__(self, alpha: float = 0.5,
-                 center: Optional[ElasticCenter] = None):
+    ``snapshot_dir`` enables crash recovery: the full center state —
+    params, membership (``demoted``/``dropped_by_island``), update
+    counters, and the dedup window's token high-water marks — is written
+    every ``snapshot_every_s`` seconds (only when it changed) as ONE
+    crash-atomic npz (the ``utils/checkpoint.py`` write-tmp → fsync →
+    ``os.replace`` discipline: a SIGKILL mid-save leaves the previous
+    complete snapshot, never a torn one).  ``restore()`` reloads it, so a
+    supervisor can respawn the center and clients — riding the outage on
+    wire retries — resume against the recovered state with their retried
+    pushes still deduplicated."""
+
+    def __init__(self, alpha: float = 0.5, center=None,
+                 snapshot_dir: Optional[str] = None,
+                 snapshot_every_s: float = 2.0,
+                 idle_timeout_s: float = 120.0,
+                 dedup_depth: int = 128):
+        from .async_easgd import ElasticCenter
+
         # pass an existing center to ALSO serve in-process islands' store
         # (AsyncEASGDTrainer center_serve mode) — leaf-list wire ops and
         # pytree local ops share the canonical flat store
         self.center = center if center is not None \
             else ElasticCenter(alpha=alpha)
+        self.dedup = DedupWindow(depth=dedup_depth)
+        self.snapshot_dir = snapshot_dir
+        self.snapshot_every_s = float(snapshot_every_s)
+        self.idle_timeout_s = float(idle_timeout_s)
         self._srv: Optional[socketserver.ThreadingTCPServer] = None
         self._thread: Optional[threading.Thread] = None
+        self._snap_thread: Optional[threading.Thread] = None
+        self._snap_halt = threading.Event()
+        self._snap_mark: Optional[tuple] = None
+        self._conns: set = set()
+        self._conns_lock = threading.Lock()
+
+    # -- crash-recovery snapshots -------------------------------------------
+
+    def _state_mark(self) -> tuple:
+        """Cheap change detector — snapshot only when the state moved."""
+        st = self.center.stats_snapshot()
+        return (st["n_updates"], tuple(st["demoted"]),
+                sum(st["dropped_by_island"].values()),
+                sum(self.dedup.seq_hwm.values()) if self.dedup.seq_hwm
+                else 0)
+
+    def snapshot(self) -> Optional[str]:
+        """One crash-atomic snapshot file (single npz: leaves + a JSON
+        meta blob), or None when the center is uninitialized / no dir."""
+        if not self.snapshot_dir:
+            return None
+        with self.center._lock:
+            if self.center._leaves is None:
+                return None
+            leaves = [np.array(x) for x in self.center._leaves]
+            meta = {"alpha": self.center.alpha,
+                    "n_updates": self.center.n_updates,
+                    "updates_by_island":
+                        {str(k): v for k, v in
+                         self.center.updates_by_island.items()},
+                    "demoted": sorted(self.center.demoted),
+                    "dropped_by_island":
+                        {str(k): v for k, v in
+                         self.center.dropped_by_island.items()},
+                    "dedup": self.dedup.snapshot(),
+                    "ts": time.time()}
+        from ..utils.checkpoint import _fsync_write
+        os.makedirs(self.snapshot_dir, exist_ok=True)
+        path = snapshot_path(self.snapshot_dir)
+        blob = np.frombuffer(json.dumps(meta).encode(), dtype=np.uint8)
+        _fsync_write(path, lambda f: np.savez(
+            f, _meta=blob, **{f"leaf{i}": x for i, x in enumerate(leaves)}))
+        return path
+
+    def restore(self, snapshot_dir: Optional[str] = None) -> bool:
+        """Reload the newest snapshot (if any): params, counters,
+        membership, and the dedup token high-water marks — a client
+        retrying a push that landed BEFORE the crash is still answered
+        from the window, not reapplied."""
+        d = snapshot_dir or self.snapshot_dir
+        if not d:
+            return False
+        path = snapshot_path(d)
+        if not os.path.exists(path):
+            return False
+        try:
+            leaves, meta = load_snapshot(path)
+        except Exception as e:
+            import sys
+            print(f"center: snapshot {path} unreadable ({e!r}) — "
+                  f"starting fresh", file=sys.stderr, flush=True)
+            return False
+        c = self.center
+        with c._lock:
+            c._leaves = [np.array(x, np.float32) for x in leaves]
+            c.alpha = float(meta.get("alpha", c.alpha))
+            c.n_updates = int(meta.get("n_updates", 0))
+            c.updates_by_island = {int(k): int(v) for k, v in
+                                   meta.get("updates_by_island",
+                                            {}).items()}
+            c.demoted = set(int(x) for x in meta.get("demoted", ()))
+            c.dropped_by_island = {int(k): int(v) for k, v in
+                                   meta.get("dropped_by_island",
+                                            {}).items()}
+        self.dedup.restore(meta.get("dedup") or {})
+        return True
+
+    def _snapshot_loop(self) -> None:
+        while not self._snap_halt.wait(self.snapshot_every_s):
+            try:
+                mark = self._state_mark()
+                if mark != self._snap_mark:
+                    self.snapshot()
+                    self._snap_mark = mark
+            except Exception:
+                pass               # a snapshot must never kill serving
+
+    # -- serving ------------------------------------------------------------
 
     def start(self, host: str = "127.0.0.1", port: int = 0) -> Tuple[str, int]:
+        import socket as _socket
         center = self.center
+        dedup = self.dedup
+        idle_timeout = self.idle_timeout_s
+        socket_timeout_errors = (_socket.timeout, TimeoutError)
+
+        outer = self
 
         class Handler(socketserver.BaseRequestHandler):
             def handle(self):          # one connection: a request loop
+                # a wedged/SIGSTOPped client must not pin this handler
+                # thread forever — idle past the timeout closes it
+                self.request.settimeout(idle_timeout)
+                with outer._conns_lock:
+                    outer._conns.add(self.request)
                 try:
                     while True:
-                        header, body = _recv_msg(self.request)
+                        try:
+                            header, body = wire.recv_msg(self.request)
+                        except VersionMismatch as e:
+                            # deliberately loud, with both versions —
+                            # reply, then drop the connection (nothing
+                            # else this peer sends can be trusted)
+                            wire.send_msg(self.request,
+                                          {"ok": False, "error": str(e)})
+                            return
+                        except CorruptPayload as e:
+                            # bytes, not the op, are bad: framing stayed
+                            # aligned, so ask the client to retry the
+                            # SAME token on this connection
+                            tm = telemetry.active()
+                            if tm.enabled:
+                                tm.counter("wire.corrupt")
+                            wire.send_msg(self.request,
+                                          {"ok": False, "error": str(e),
+                                           "retry": True})
+                            continue
                         try:
                             self._dispatch(header, body)
                         except (ConnectionError, OSError):
                             raise
                         except Exception as e:
-                            # op-level failures (shape/leaf-count mismatch,
-                            # pull-before-init) reply with the REAL cause —
-                            # a bare connection close would surface to the
-                            # client as an opaque network error
-                            _send_msg(self.request,
-                                      {"ok": False, "error": repr(e)})
-                except (ConnectionError, OSError):
+                            # op-level failures (shape/leaf-count
+                            # mismatch, pull-before-init) reply with the
+                            # REAL cause — a bare connection close would
+                            # surface to the client as an opaque network
+                            # error
+                            wire.send_msg(self.request,
+                                          {"ok": False, "error": repr(e)})
+                except socket_timeout_errors:
+                    return             # idle/wedged client — free the thread
+                except (ConnectionClosed, TruncatedMessage):
                     return             # client went away — fine
+                except (ConnectionError, OSError):
+                    return
+                finally:
+                    with outer._conns_lock:
+                        outer._conns.discard(self.request)
 
             def _dispatch(self, header, body):
                 op = header.get("op")
-                if op == "init":
-                    center.ensure_init_leaves(_unpack_leaves(body))
-                    _send_msg(self.request, {"ok": True})
-                elif op == "pull":
-                    _send_msg(self.request, {"ok": True},
-                              _pack_leaves(center.pull_leaves()))
-                elif op == "push":
-                    center.push_delta_leaves(_unpack_leaves(body),
-                                             int(header["island"]))
-                    _send_msg(self.request, {"ok": True})
-                elif op == "push_pull":
-                    leaves = center.push_pull_leaves(
-                        _unpack_leaves(body), int(header["island"]))
-                    _send_msg(self.request, {"ok": True},
-                              _pack_leaves(leaves))
-                elif op == "demote":
-                    # elastic membership (parallel/membership.py): further
-                    # pushes from this island are dropped at the center
-                    center.demote_island(int(header["island"]))
-                    _send_msg(self.request, {"ok": True})
-                elif op == "readmit":
-                    center.readmit_island(int(header["island"]))
-                    _send_msg(self.request, {"ok": True})
-                elif op == "stats":
-                    _send_msg(self.request,
-                              {"ok": True, **center.stats_snapshot()})
-                else:
-                    _send_msg(self.request,
-                              {"ok": False, "error": f"unknown op {op!r}"})
+                tok = header.get("tok")
+                if op in ("push", "push_pull"):
+                    dup, cached = dedup.check(tok, op)
+                    if dup:
+                        if cached is wire.INFLIGHT:
+                            # the original is mid-application on another
+                            # handler thread — it may yet FAIL and release
+                            # the claim, so the twin must not be acked:
+                            # tell the client to retry the same token
+                            wire.send_msg(self.request,
+                                          {"ok": False, "retry": True,
+                                           "busy": True,
+                                           "error": "request in flight — "
+                                                    "retry"})
+                            return
+                        # a retry of a request that already LANDED: reply
+                        # without reapplying — exactly-once application
+                        hdr = cached[0] if cached is not None \
+                            else {"ok": True, "dedup": True}
+                        if cached is not None and cached[1] is not None:
+                            wire.send_msg(self.request, hdr, cached[1])
+                        elif op == "push":
+                            wire.send_msg(self.request, hdr)
+                        else:
+                            # push_pull replay: the CURRENT center is the
+                            # synthesized body — a valid (fresher) anchor
+                            wire.send_msg(self.request, hdr,
+                                          pack_leaves(center.pull_leaves()))
+                        return
+                if op in ("pull", "push", "push_pull") and \
+                        center._leaves is None:
+                    # a respawned center with no usable snapshot: tell the
+                    # clients STRUCTURALLY (they re-seed via ensure_init
+                    # and carry on) instead of an opaque assertion repr
+                    if op in ("push", "push_pull"):
+                        dedup.release(tok, op)     # claim withdrawn
+                    wire.send_msg(self.request,
+                                  {"ok": False, "uninit": True,
+                                   "error": "center not initialized (no "
+                                            "snapshot survived?) — "
+                                            "re-seed with ensure_init"})
+                    return
+                try:
+                    if op == "init":
+                        center.ensure_init_leaves(unpack_leaves(body))
+                        wire.send_msg(self.request, {"ok": True})
+                    elif op == "pull":
+                        wire.send_msg(self.request, {"ok": True},
+                                      pack_leaves(center.pull_leaves()))
+                    elif op == "push":
+                        center.push_delta_leaves(unpack_leaves(body),
+                                                 int(header["island"]))
+                        reply = {"ok": True}
+                        dedup.record(tok, op, reply)
+                        wire.send_msg(self.request, reply)
+                    elif op == "push_pull":
+                        leaves = center.push_pull_leaves(
+                            unpack_leaves(body), int(header["island"]))
+                        reply = {"ok": True}
+                        # record the token but not the (model-sized) body:
+                        # a replay is answered with the CURRENT center,
+                        # which the downpour algebra accepts as its fresh
+                        # anchor — exactly-once application is what matters
+                        dedup.record(tok, op, reply, reply_body=None)
+                        wire.send_msg(self.request, reply,
+                                      pack_leaves(leaves))
+                    elif op == "demote":
+                        # elastic membership (parallel/membership.py):
+                        # further pushes from this island are dropped
+                        center.demote_island(int(header["island"]))
+                        wire.send_msg(self.request, {"ok": True})
+                    elif op == "readmit":
+                        center.readmit_island(int(header["island"]))
+                        wire.send_msg(self.request, {"ok": True})
+                    elif op == "stats":
+                        wire.send_msg(
+                            self.request,
+                            {"ok": True, **center.stats_snapshot(),
+                             "dedup_hits": dedup.hits,
+                             "seq_hwm": dict(dedup.seq_hwm)})
+                    else:
+                        wire.send_msg(self.request,
+                                      {"ok": False,
+                                       "error": f"unknown op {op!r}"})
+                except Exception:
+                    if op in ("push", "push_pull"):
+                        dedup.release(tok, op)   # failed: claim withdrawn
+                    raise
 
         socketserver.ThreadingTCPServer.allow_reuse_address = True
         self._srv = socketserver.ThreadingTCPServer((host, port), Handler)
@@ -162,65 +368,98 @@ class CenterServer:
         self._thread = threading.Thread(target=self._srv.serve_forever,
                                         daemon=True)
         self._thread.start()
+        if self.snapshot_dir:
+            self._snap_thread = threading.Thread(target=self._snapshot_loop,
+                                                 daemon=True)
+            self._snap_thread.start()
         return self._srv.server_address[:2]
 
-    def stop(self) -> None:
+    def stop(self, final_snapshot: bool = True) -> None:
+        self._snap_halt.set()
+        if self._snap_thread is not None:
+            self._snap_thread.join(timeout=10)
+            self._snap_thread = None
+        if final_snapshot and self.snapshot_dir:
+            try:
+                self.snapshot()
+            except Exception:
+                pass
         if self._srv is not None:
             self._srv.shutdown()
             self._srv.server_close()
             self._srv = None
+            # a real center death severs every in-flight connection; an
+            # in-process stop must too, or handler threads keep serving a
+            # 'dead' center (and tests of the outage path test nothing)
+            with self._conns_lock:
+                conns = list(self._conns)
+                self._conns.clear()
+            for c in conns:
+                try:
+                    c.close()
+                except OSError:
+                    pass
 
 
 # -- client -----------------------------------------------------------------
 
 class RemoteCenter:
-    """``ElasticCenter``-shaped client: every call is one request/response
-    round-trip on a persistent connection (a lock serializes this process's
-    callers; the SERVER's lock serializes across processes)."""
+    """``ElasticCenter``-shaped client on the resilient wire: every call is
+    one tokened request/response round-trip, retried with bounded backoff
+    and reconnect through timeouts, drops, corruption, and center
+    restarts.  Gives up with a clear :class:`~.wire.WireGiveUp` (attempts,
+    elapsed, last error) when the center stays unreachable past the
+    deadline — callers decide whether that is fatal (startup restore) or
+    survivable (a missed exchange; the island keeps training locally)."""
 
     def __init__(self, addr: str, alpha: float = 0.5,
-                 connect_timeout: float = 30.0):
-        host, port = addr.rsplit(":", 1)
+                 client_id=None, connect_timeout: float = 5.0,
+                 op_timeout_s: float = 20.0, max_retries: int = 8,
+                 deadline_s: float = 120.0, telemetry_=None):
         self.alpha = float(alpha)      # kept for IslandRunner's elastic math
         self._treedef = None
-        self._lock = threading.Lock()
-        self._sock = socket.create_connection((host, int(port)),
-                                              timeout=connect_timeout)
-        self._sock.settimeout(None)
+        self._wire = WireClient(addr, client_id=client_id,
+                                op_timeout_s=op_timeout_s,
+                                connect_timeout_s=connect_timeout,
+                                max_retries=max_retries,
+                                deadline_s=deadline_s,
+                                telemetry_=telemetry_)
 
     def _roundtrip(self, header: dict, body: bytes = b"") -> Tuple[dict, bytes]:
-        with self._lock:
-            _send_msg(self._sock, header, body)
-            resp, rbody = _recv_msg(self._sock)
-        if not resp.get("ok"):
-            raise RuntimeError(f"center server error: {resp.get('error')}")
-        return resp, rbody
+        return self._wire.request(header, body)
 
     def _leaves(self, tree) -> Tuple[List[np.ndarray], object]:
+        import jax
         leaves, treedef = jax.tree.flatten(tree)
         return [np.asarray(x, np.float32) for x in leaves], treedef
 
     def ensure_init(self, params) -> None:
         leaves, self._treedef = self._leaves(params)
-        self._roundtrip({"op": "init"}, _pack_leaves(leaves))
+        self._roundtrip({"op": "init"}, pack_leaves(leaves))
 
     def pull(self):
+        import jax
         _, body = self._roundtrip({"op": "pull"})
-        leaves = _unpack_leaves(body)
+        leaves = unpack_leaves(body)
         assert self._treedef is not None, "pull before ensure_init"
         return jax.tree.unflatten(self._treedef, leaves)
+
+    def pull_leaves(self) -> List[np.ndarray]:
+        _, body = self._roundtrip({"op": "pull"})
+        return unpack_leaves(body)
 
     def push_delta(self, delta_mean, island: int) -> None:
         leaves, _ = self._leaves(delta_mean)
         self._roundtrip({"op": "push", "island": island},
-                        _pack_leaves(leaves))
+                        pack_leaves(leaves))
 
     def push_pull(self, delta_mean, island: int):
+        import jax
         leaves, _ = self._leaves(delta_mean)
         _, body = self._roundtrip({"op": "push_pull", "island": island},
-                                  _pack_leaves(leaves))
+                                  pack_leaves(leaves))
         assert self._treedef is not None, "push_pull before ensure_init"
-        return jax.tree.unflatten(self._treedef, _unpack_leaves(body))
+        return jax.tree.unflatten(self._treedef, unpack_leaves(body))
 
     def demote_island(self, island: int) -> None:
         self._roundtrip({"op": "demote", "island": int(island)})
@@ -241,7 +480,83 @@ class RemoteCenter:
         return {int(k): v for k, v in self.stats()["by_island"].items()}
 
     def close(self) -> None:
-        try:
-            self._sock.close()
-        except OSError:
-            pass
+        self._wire.close()
+
+
+# -- center process CLI ------------------------------------------------------
+
+def center_main(argv: Optional[List[str]] = None) -> int:
+    """Run the center as its OWN supervised process:
+    ``python -m theanompi_tpu.parallel.center_server --port P ...``.
+
+    The elastic supervisor (``membership.ElasticSupervisor``) spawns this
+    like a worker: it beats a lease (id ``--lease-id``, default 0) so a
+    wedged center is detected, restores from ``--snapshot-dir`` on
+    (re)start, snapshots periodically, and serves until SIGTERM.  Clients
+    ride a restart out on wire retries; the supervisor emits the
+    ``center_down``/``center_restored`` event pair around it."""
+    import argparse
+    import signal
+    import sys
+
+    ap = argparse.ArgumentParser(description=center_main.__doc__)
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int, required=True,
+                    help="fixed port — clients reconnect here across "
+                         "center restarts")
+    ap.add_argument("--alpha", type=float, default=0.5)
+    ap.add_argument("--snapshot-dir", default=None)
+    ap.add_argument("--snapshot-every", type=float, default=2.0)
+    ap.add_argument("--idle-timeout", type=float, default=120.0)
+    ap.add_argument("--lease-dir", default=None)
+    ap.add_argument("--lease-id", type=int, default=0)
+    ap.add_argument("--record-dir", default=None)
+    ap.add_argument("--run-id", default=None)
+    ap.add_argument("--max-seconds", type=float, default=0.0,
+                    help="self-terminate after this long (0 = forever)")
+    args = ap.parse_args(argv)
+
+    tm = telemetry.init({"record_dir": args.record_dir,
+                         "rank": -1, "run_id": args.run_id}) \
+        if args.record_dir else telemetry.active()
+
+    srv = CenterServer(alpha=args.alpha, snapshot_dir=args.snapshot_dir,
+                       snapshot_every_s=args.snapshot_every,
+                       idle_timeout_s=args.idle_timeout)
+    restored = srv.restore()
+    host, port = srv.start(args.host, args.port)
+    print(f"center: serving on {host}:{port} "
+          f"({'restored from snapshot' if restored else 'fresh'})",
+          file=sys.stderr, flush=True)
+
+    lease = None
+    if args.lease_dir:
+        from .membership import WorkerLease
+        lease = WorkerLease(args.lease_dir, args.lease_id, telemetry_=tm)
+        lease.beat(srv.center.n_updates)
+
+    halt = threading.Event()
+    signal.signal(signal.SIGTERM, lambda *_: halt.set())
+    try:
+        signal.signal(signal.SIGINT, lambda *_: halt.set())
+    except (ValueError, OSError):
+        pass
+    t0 = time.time()
+    while not halt.wait(1.0):
+        if lease is not None:
+            lease.beat(srv.center.n_updates)
+        if args.max_seconds and time.time() - t0 > args.max_seconds:
+            break
+    srv.stop(final_snapshot=True)
+    if lease is not None:
+        lease.release()
+    if tm.enabled:
+        tm.event("train_end", center=True,
+                 n_updates=srv.center.n_updates,
+                 dedup_hits=srv.dedup.hits)
+        tm.close()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(center_main())

@@ -65,6 +65,13 @@ except ImportError:        # file-path load (jax-free lint probe): absolute
 # re-enters via ``worker_join`` with ``reason='readmit'``.
 MEMBERSHIP_EVENTS = ("worker_join", "worker_leave", "worker_demote")
 
+# The center-outage event pair (round 14): the supervisor emits
+# ``center_down`` when the supervised center process dies (or its lease
+# expires while wedged) and ``center_restored`` when the respawned center
+# answers on its fixed port again — the chaos gate matches the pair, the
+# workers ride the gap out on wire retries (parallel/wire.py).
+CENTER_EVENTS = ("center_down", "center_restored")
+
 # Heartbeat gauge keys a WorkerLease.beat mirrors into the telemetry
 # stream (rendered as a per-rank counter track by the trace export).
 HEARTBEAT_GAUGES = ("heartbeat.iter",)
@@ -245,22 +252,48 @@ class CenterReactor(Reactor):
     """EASGD/ASGD shrink without stopping: a left/demoted island's pushes
     are DROPPED at the center (zombie pushes from a half-dead process can't
     pollute it) while pulls still serve — the island keeps training locally
-    and, on readmit/rejoin, restores from the center and re-enters."""
+    and, on readmit/rejoin, restores from the center and re-enters.
+
+    Works against an in-process :class:`~.async_easgd.ElasticCenter` or a
+    :class:`~.center_server.RemoteCenter`.  A remote op failing because
+    the center is DOWN (the supervisor may be mid-respawn of that very
+    center) is remembered, not raised — the supervisor's tick calls
+    :meth:`flush_pending` so the latest intended state lands once the
+    center answers again."""
 
     def __init__(self, center):
         self.center = center
+        self._pending: Dict[int, str] = {}    # island -> demote | readmit
+
+    def _call(self, island: int, what: str) -> None:
+        try:
+            if what == "demote":
+                self.center.demote_island(island)
+            else:
+                self.center.readmit_island(island)
+            self._pending.pop(island, None)
+        except ConnectionError as e:           # incl. wire.WireGiveUp
+            if self._pending.get(island) != what:  # log intent once, not
+                print(f"membership: center {what}({island}) deferred — "
+                      f"center unreachable ({e!r})", file=sys.stderr,
+                      flush=True)                  # every flush retry
+            self._pending[island] = what       # latest intent wins
+
+    def flush_pending(self) -> None:
+        for island, what in list(self._pending.items()):
+            self._call(island, what)
 
     def on_leave(self, worker, info):
-        self.center.demote_island(worker)
+        self._call(worker, "demote")
 
     def on_demote(self, worker, info):
-        self.center.demote_island(worker)
+        self._call(worker, "demote")
 
     def on_join(self, worker, info):
-        self.center.readmit_island(worker)
+        self._call(worker, "readmit")
 
     def on_readmit(self, worker, info):
-        self.center.readmit_island(worker)
+        self._call(worker, "readmit")
 
 
 class MeshReactor(Reactor):
@@ -426,6 +459,22 @@ class MembershipController:
         self._emit("worker_join", worker, "on_readmit",
                    reason=reason, rejoin=True, pid=st.get("pid"))
 
+    # -- center outage pair (the center is not a worker: no state-machine
+    # entry, no reactor fan-out — just the audited event pair) -------------
+
+    def center_down(self, reason: str = "crashed", **info) -> None:
+        self.transitions.append(("center_down", -1, dict(info,
+                                                         reason=reason)))
+        tm = self.telemetry
+        if tm.enabled:
+            tm.event("center_down", reason=reason, **info)
+
+    def center_restored(self, **info) -> None:
+        self.transitions.append(("center_restored", -1, dict(info)))
+        tm = self.telemetry
+        if tm.enabled:
+            tm.event("center_restored", **info)
+
     # -- lease polling ------------------------------------------------------
 
     def poll(self, now: Optional[float] = None) -> List[Tuple[str, int, dict]]:
@@ -526,6 +575,10 @@ class ElasticSupervisor:
     inside the :class:`CrashLoopBreaker` window stop the world with the
     flight-recorder tail printed."""
 
+    #: the supervised center's id in chaos schedules and lease files —
+    #: worker ids are 1-based in ``run_elastic``, so 0 is free
+    CENTER_ID = 0
+
     def __init__(self, cmd_for: Callable[[int, int], List[str]],
                  worker_ids: Sequence[int], lease_dir: str, *,
                  record_dir: Optional[str] = None,
@@ -534,6 +587,10 @@ class ElasticSupervisor:
                  crash_limit: int = 5, crash_window_s: float = 120.0,
                  telemetry_=None, reactors: Sequence[Reactor] = (),
                  straggle_windows: int = 0, straggle_poll_s: float = 10.0,
+                 center_cmd_for: Optional[Callable[[int], List[str]]] = None,
+                 center_addr: Optional[str] = None,
+                 center_max_restarts: int = 5,
+                 center_lease_dir: Optional[str] = None,
                  verbose: bool = True):
         self.cmd_for = cmd_for
         self.worker_ids = [int(w) for w in worker_ids]
@@ -556,9 +613,29 @@ class ElasticSupervisor:
         self.done: set = set()
         self.failed: set = set()
         self._pending: List[Tuple[float, int]] = []   # (due_ts, worker)
+        # -- supervised center process (round 14): the center is respawned
+        # from its snapshot like a worker — lease + backoff + breaker —
+        # while the clients ride the outage out on wire retries
+        self.center_cmd_for = center_cmd_for
+        self.center_addr = center_addr
+        self.center_max_restarts = int(center_max_restarts)
+        # the center's lease lives in its OWN dir: controller.poll() folds
+        # every lease under lease_dir into WORKER transitions, and the
+        # center is not a worker
+        self.center_lease_dir = center_lease_dir
+        self.center_proc: Optional[subprocess.Popen] = None
+        self.center_attempts = 0
+        self._center_due: Optional[float] = None      # pending respawn ts
+        self._center_probe = False                    # awaiting restored?
+        self._center_downs = 0
 
-    # chaos harness hook: the CURRENT pid of a worker (None between lives)
+    # chaos harness hook: the CURRENT pid of a worker (None between lives);
+    # target CENTER_ID resolves the supervised center process
     def pid_of(self, worker_id: int) -> Optional[int]:
+        if int(worker_id) == self.CENTER_ID and \
+                self.center_cmd_for is not None:
+            p = self.center_proc
+            return p.pid if p is not None and p.poll() is None else None
         p = self.procs.get(int(worker_id))
         return p.pid if p is not None and p.poll() is None else None
 
@@ -576,6 +653,109 @@ class ElasticSupervisor:
         self._log(f"worker {wid} spawned (pid {self.procs[wid].pid}, "
                   f"attempt {attempt})")
 
+    # -- center supervision (round 14) --------------------------------------
+
+    def _spawn_center(self) -> None:
+        cmd = self.center_cmd_for(self.center_attempts)
+        self.center_proc = subprocess.Popen(cmd)
+        self.center_attempts += 1
+        self._center_due = None
+        self._center_probe = True      # emit center_restored on first answer
+        self._log(f"center spawned (pid {self.center_proc.pid}, "
+                  f"attempt {self.center_attempts - 1})")
+
+    def _center_answers(self) -> bool:
+        """Non-blocking-ish probe: does the center accept on its fixed
+        port?  Called once per tick only while awaiting a restore."""
+        import socket
+        host, port = str(self.center_addr).rsplit(":", 1)
+        try:
+            socket.create_connection((host, int(port)), timeout=0.2).close()
+            return True
+        except OSError:
+            return False
+
+    def _tick_center(self) -> bool:
+        """One supervision tick for the center process.  True when the
+        center crash-looped past its budget (caller stops the world)."""
+        if self.center_cmd_for is None:
+            return False
+        now = time.time()
+        p = self.center_proc
+        # a WEDGED center (alive, not beating — SIGSTOP, hung handler) is
+        # as gone as a dead one: kill it, the death branch below respawns
+        if p is not None and p.poll() is None and self.center_lease_dir \
+                and not self._center_probe:
+            doc = read_leases(self.center_lease_dir).get(self.CENTER_ID)
+            if doc is not None and \
+                    now - float(doc.get("ts", 0)) > \
+                    self.controller.lease_timeout:
+                self._log("center lease expired while wedged — killing it")
+                self._center_wedged = True
+                try:
+                    p.kill()
+                    p.wait(timeout=30)
+                except Exception:
+                    pass
+        if p is not None and p.poll() is not None:
+            rc = p.returncode
+            self.center_proc = None
+            self._center_downs += 1
+            reason = "wedged" if getattr(self, "_center_wedged", False) \
+                else "crashed"
+            self._center_wedged = False
+            self.controller.center_down(
+                reason=reason, rc=rc, downs=self._center_downs)
+            if self.breaker.record_failure():
+                self._log("center crash tripped the crash-loop breaker "
+                          "— stopping the world")
+                return True
+            if self.center_attempts > self.center_max_restarts:
+                self._log(f"center exhausted {self.center_max_restarts} "
+                          f"restarts — stopping the world")
+                return True
+            delay = self.backoff.delay(self.center_attempts - 1)
+            self._log(f"center died (rc={rc}); respawn from snapshot "
+                      f"in {delay:.1f}s — clients ride it out on wire "
+                      f"retries")
+            self._center_due = now + delay
+        if self._center_due is not None and now >= self._center_due:
+            self._spawn_center()
+        if self._center_probe and self.center_proc is not None and \
+                self.center_addr and self._center_answers():
+            self._center_probe = False
+            # first spawn is not a restoration — the pair the chaos gate
+            # audits is down → restored
+            if self._center_downs:
+                self.controller.center_restored(
+                    attempt=self.center_attempts - 1)
+                self._log("center restored — serving again")
+        # let deferred demote/readmit intents land on the revived center —
+        # only once it answers (each flush attempt against a dead center
+        # blocks this loop for the reactor client's retry budget)
+        if not self._center_probe:
+            for r in self.controller.reactors:
+                flush = getattr(r, "flush_pending", None)
+                if flush is not None:
+                    flush()
+        return False
+
+    def _stop_center(self) -> None:
+        p = self.center_proc
+        if p is None:
+            return
+        try:
+            if p.poll() is None:
+                p.terminate()          # SIGTERM: final snapshot + lease
+                try:
+                    p.wait(timeout=15)
+                except Exception:
+                    p.kill()
+                    p.wait(timeout=15)
+        except OSError:
+            pass
+        self.center_proc = None
+
     def _kill_all(self) -> None:
         for p in self.procs.values():
             if p.poll() is None:
@@ -588,6 +768,7 @@ class ElasticSupervisor:
                 p.wait(timeout=30)
             except Exception:
                 pass
+        self._stop_center()
 
     def _on_death(self, wid: int, rc: Optional[int], reason: str) -> bool:
         """Record a death; schedule the respawn.  True when the crash-loop
@@ -615,10 +796,17 @@ class ElasticSupervisor:
         """Run the elastic world until every worker finished (rc 0): 0 — or
         nonzero on breaker trip / restart exhaustion / timeout."""
         t0 = time.time()
+        if self.center_cmd_for is not None:
+            self._spawn_center()
         for wid in self.worker_ids:
             self._spawn(wid)
         try:
             while True:
+                # 0. the supervised center: death → center_down → backoff
+                # respawn-from-snapshot → center_restored when it answers
+                if self._tick_center():
+                    self._kill_all()
+                    return 1
                 # 1. process deaths
                 for wid, p in list(self.procs.items()):
                     if wid in self.done or wid in self.failed:
@@ -801,11 +989,23 @@ def elastic_worker_main(argv: Optional[Sequence[str]] = None) -> int:
 
 # -- launcher-facing composition --------------------------------------------
 
+def _free_port(host: str = "127.0.0.1") -> int:
+    """A port the center process can bind — chosen ONCE so clients
+    reconnect to the same address across center restarts."""
+    import socket
+    s = socket.socket()
+    s.bind((host, 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
 def run_elastic(rule: str, modelfile: str, modelclass: str,
                 config: Dict[str, Any], n_workers: int, *,
                 record_dir: Optional[str] = None, steps: int = 32,
                 host_devices: int = 0, supervisor_kw: Optional[dict] = None,
-                chaos_schedule=None, timeout_s: float = 600.0,
+                chaos_schedule=None, net_chaos_schedule=None,
+                center_proc: bool = False, timeout_s: float = 600.0,
                 verbose: bool = True) -> int:
     """One elastic run: center server + ``n_workers`` island subprocesses
     under an :class:`ElasticSupervisor` (``launcher --elastic`` and
@@ -813,7 +1013,16 @@ def run_elastic(rule: str, modelfile: str, modelclass: str,
     CPU venue (each worker simulates that many chips and pins the cpu
     backend); 0 (default) leaves platform selection to the real hardware.
     BSP has no shrink algebra — use ``launcher --supervise`` (the
-    reaction matrix, design.md §14)."""
+    reaction matrix, design.md §14).
+
+    ``center_proc=True`` runs the center as its OWN supervised process
+    (fixed port, crash-atomic snapshots, respawn-from-snapshot with
+    backoff; its death/rebirth is the audited ``center_down`` /
+    ``center_restored`` pair) — required when ``chaos_schedule`` targets
+    worker 0, i.e. the center itself.  ``net_chaos_schedule`` puts the
+    :class:`~theanompi_tpu.utils.chaos.ChaosProxy` between the workers
+    and the center, injecting wire-level drop/delay/dup/corrupt/partition
+    faults on the schedule (docs/design.md §15)."""
     rule = rule.lower()
     if rule not in ("easgd", "asgd"):
         raise ValueError(
@@ -822,20 +1031,68 @@ def run_elastic(rule: str, modelfile: str, modelclass: str,
             f"`launcher --supervise` (world restart at the committed "
             f"window cursor); GoSGD demotion is in-mesh "
             f"(Exchanger.set_active_ranks)")
-    from .center_server import CenterServer
+    from .center_server import (CenterServer, RemoteCenter, load_snapshot,
+                                snapshot_path)
     record_dir = record_dir or config.get("record_dir")
     lease_dir = config.get("lease_dir") or (
         os.path.join(record_dir, "membership") if record_dir else None)
     assert lease_dir, "run_elastic needs record_dir or lease_dir"
     run_id = config.get("run_id") or f"elastic{int(time.time())}"
-
-    srv = CenterServer(alpha=float(config.get("alpha", 0.5)))
-    host, port = srv.start(str(config.get("center_host", "127.0.0.1")),
-                           int(config.get("center_port", 0)))
-    addr = f"{host}:{port}"
     tm = telemetry.init({"record_dir": record_dir, "rank": 0,
                          "run_id": run_id}) if record_dir else \
         telemetry.active()
+
+    alpha = float(config.get("alpha", 0.5))
+    chost = str(config.get("center_host", "127.0.0.1"))
+    srv = None
+    center_kw: Dict[str, Any] = {}
+    snap_dir = None
+    if center_proc:
+        assert record_dir, "center_proc needs a record_dir (snapshots)"
+        port = int(config.get("center_port", 0)) or _free_port(chost)
+        addr = f"{chost}:{port}"
+        snap_dir = os.path.join(record_dir, "center_snap")
+        center_lease_dir = os.path.join(lease_dir, "center")
+
+        def center_cmd_for(attempt: int) -> List[str]:
+            cmd = [sys.executable, "-m",
+                   "theanompi_tpu.parallel.center_server",
+                   "--host", chost, "--port", str(port),
+                   "--alpha", str(alpha),
+                   "--snapshot-dir", snap_dir,
+                   "--snapshot-every",
+                   str(config.get("center_snapshot_every_s", 1.0)),
+                   "--lease-dir", center_lease_dir,
+                   "--lease-id", str(ElasticSupervisor.CENTER_ID),
+                   "--run-id", str(run_id)]
+            if record_dir:
+                cmd += ["--record-dir", record_dir]
+            return cmd
+
+        # the supervisor's own client: SHORT deadline — reactor calls and
+        # probes must never stall the supervision loop that is busy
+        # respawning the very center they are waiting for
+        center_handle = RemoteCenter(addr, alpha=alpha,
+                                     client_id="supervisor",
+                                     op_timeout_s=5.0, max_retries=2,
+                                     deadline_s=8.0, telemetry_=tm)
+        center_kw = dict(center_cmd_for=center_cmd_for, center_addr=addr,
+                         center_lease_dir=center_lease_dir)
+    else:
+        srv = CenterServer(alpha=alpha)
+        host, port = srv.start(chost, int(config.get("center_port", 0)))
+        addr = f"{host}:{port}"
+        center_handle = srv.center
+
+    # wire-level chaos: the proxy sits between the WORKERS and the center
+    # (the supervisor's membership ops take the direct road — the faults
+    # under test are the training wire's)
+    proxy = None
+    worker_addr = addr
+    if net_chaos_schedule:
+        from ..utils.chaos import ChaosProxy
+        proxy = ChaosProxy(addr, net_chaos_schedule, telemetry_=tm)
+        worker_addr = proxy.start()
 
     base_kv = dict(config)
     for drop in ("lease_dir", "record_dir", "run_id", "center_addr",
@@ -844,7 +1101,7 @@ def run_elastic(rule: str, modelfile: str, modelclass: str,
 
     def cmd_for(wid: int, attempt: int) -> List[str]:
         kv = dict(base_kv)
-        kv.update(island=wid, center_addr=addr, lease_dir=lease_dir,
+        kv.update(island=wid, center_addr=worker_addr, lease_dir=lease_dir,
                   steps=steps, host_devices=host_devices, run_id=run_id)
         if record_dir:
             kv["record_dir"] = record_dir
@@ -853,7 +1110,8 @@ def run_elastic(rule: str, modelfile: str, modelclass: str,
             [f"{k}={v}" for k, v in sorted(kv.items())]
 
     kw = dict(record_dir=record_dir, telemetry_=tm,
-              reactors=(CenterReactor(srv.center),), verbose=verbose)
+              reactors=(CenterReactor(center_handle),), verbose=verbose)
+    kw.update(center_kw)
     kw.update(supervisor_kw or {})
     sup = ElasticSupervisor(cmd_for, list(range(1, n_workers + 1)),
                             lease_dir, **kw)
@@ -868,18 +1126,62 @@ def run_elastic(rule: str, modelfile: str, modelclass: str,
     finally:
         if monkey is not None:
             monkey.stop()
-        # persist the final center for offline eval (chaos_run's loss gate)
+        if proxy is not None:
+            proxy.stop()
+        # persist the final center + its bookkeeping for offline eval
+        # (chaos_run's loss gate and applied-once audit)
         try:
             import numpy as np
-            leaves = srv.center.pull_leaves()
+            leaves = None
+            stats = None
+            if center_proc:
+                # sup.run's exit SIGTERMed the center, which wrote a
+                # final crash-atomic snapshot — the authoritative final
+                # state whether the run ended cleanly or under chaos
+                if snap_dir and os.path.exists(snapshot_path(snap_dir)):
+                    leaves, meta = load_snapshot(snapshot_path(snap_dir))
+                    dd = meta.get("dedup") or {}
+                    stats = {"n_updates": meta.get("n_updates", 0),
+                             "by_island": meta.get("updates_by_island",
+                                                   {}),
+                             "demoted": meta.get("demoted", []),
+                             "dropped_by_island":
+                                 meta.get("dropped_by_island", {}),
+                             "dedup_hits": dd.get("hits", 0),
+                             "seq_hwm": dd.get("hwm", {})}
+            else:
+                leaves = srv.center.pull_leaves()
+                stats = {"ok": True, **srv.center.stats_snapshot(),
+                         "dedup_hits": srv.dedup.hits,
+                         "seq_hwm": dict(srv.dedup.seq_hwm)}
             if record_dir and leaves is not None:
                 with open(os.path.join(record_dir, "center_final.npz"),
                           "wb") as f:
                     np.savez(f, **{f"leaf{i}": x
                                    for i, x in enumerate(leaves)})
+            if record_dir and stats is not None:
+                stats = {k: v for k, v in stats.items()
+                         if k not in ("ok", "v", "crc", "tok")}
+                stats["center_downs"] = sup._center_downs
+                if proxy is not None:
+                    # frames the proxy actually faulted per kind — the
+                    # audit tells 'dup window opened but no traffic
+                    # passed' apart from 'duplicates were re-applied'
+                    stats["net_frames_faulted"] = \
+                        dict(proxy.frames_faulted)
+                with open(os.path.join(record_dir, "center_stats.json"),
+                          "w") as f:
+                    json.dump(stats, f, indent=1, sort_keys=True)
         except Exception:
             pass
-        srv.stop()
+        if center_proc:
+            sup._stop_center()
+            try:
+                center_handle.close()
+            except Exception:
+                pass
+        if srv is not None:
+            srv.stop()
         if tm.enabled:
             tm.event("elastic_end", rc=rc,
                      status=sup.controller.status())

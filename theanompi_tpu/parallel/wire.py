@@ -1,0 +1,584 @@
+"""Resilient RPC wire layer for the center server — survive the network.
+
+The reference ran its EASGD/ASGD server as a bare MPI peer: one lost
+message ended the run.  ``parallel/center_server.py``'s first socket port
+inherited that shape — blocking sockets, no timeouts, no retries, no
+payload integrity — so a dropped packet, a wedged peer, or a center
+restart was fatal to every island talking to it.  This module is the
+shared wire contract both ends now speak (docs/design.md §15):
+
+* **Framing** — ``[4B header len][JSON header][4B body len][body]``.
+  The header always carries the protocol version (``v``) and, when a
+  body is present, its CRC32 (``crc``).  A version mismatch fails
+  LOUDLY with both versions in the message; a CRC mismatch is
+  :class:`CorruptPayload` (retryable — the bytes, not the op, are bad).
+* **Close taxonomy** — a clean close *between* messages is
+  :class:`ConnectionClosed` (the peer went away at a frame boundary:
+  nothing was lost, retry freely); a close *mid-message* is
+  :class:`TruncatedMessage` (payload lost in flight).  The old code
+  raised one ``ConnectionError`` for both, so a client could not tell
+  "retry safely" from "half a push evaporated".
+* **Idempotency tokens** — every mutating request carries
+  ``tok = {w: <client>, seq: <n>}``; the server's :class:`DedupWindow`
+  remembers recently applied ``(client, op, seq)`` tokens with their
+  replies, so a retried ``push`` that actually landed is applied
+  EXACTLY once (the retry gets the original reply back).
+* **:class:`WireClient`** — a persistent connection with per-op socket
+  timeouts, bounded exponential-backoff retries
+  (``membership.Backoff``), and transparent reconnect.  Every attempt
+  feeds telemetry: ``wire.rtt`` histograms, the :data:`WIRE_COUNTERS`
+  counters, and an outage-duration gauge + ``wire`` event when a
+  connection heals after failures.
+
+Module scope is stdlib + the telemetry shim (numpy only inside the leaf
+helpers) — the tpulint schema-drift checker loads this file jax-free to
+probe the declared telemetry vocabulary against the live report.
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import struct
+import threading
+import time
+import zlib
+from collections import OrderedDict
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+try:
+    from ..utils import telemetry
+except ImportError:        # file-path load (jax-free lint probe): absolute
+    from theanompi_tpu.utils import telemetry
+
+#: Protocol version stamped into every header.  Bump on any framing or
+#: semantics change; both ends refuse a mismatch loudly (never silently
+#: misparse a peer from another release).
+WIRE_VERSION = 1
+
+# -- telemetry vocabulary (probed live by the schema-drift checker) ----------
+
+#: Counters the wire layer's machinery ticks (client side unless noted).
+#: ``wire.dedup_hit`` is server side; ``wire.exchange_skipped`` and
+#: ``wire.center_reseed`` are emitted by the EASGD/ASGD islands
+#: (``async_easgd.IslandRunner``) when an exchange is skipped through an
+#: outage or the center had to be re-seeded after a snapshotless respawn
+#: — declared here so the schema governance covers the whole wire story.
+WIRE_COUNTERS = ("wire.retry", "wire.timeout", "wire.corrupt",
+                 "wire.reconnect", "wire.giveup",
+                 "wire.dedup_hit", "wire.exchange_skipped",
+                 "wire.center_reseed")
+#: Histograms: per-request round-trip seconds on success.
+WIRE_HISTS = ("wire.rtt",)
+#: Gauges: seconds the last outage lasted, set when a connection heals —
+#: streamed in a ``gauges`` event so the Perfetto export renders an
+#: outage-duration counter track.
+WIRE_GAUGES = ("wire.outage_s",)
+#: The wire event kind (``kind`` ∈ outage/giveup) — instant markers in
+#: the report/trace next to the membership transitions they explain.
+WIRE_EVENT = "wire"
+
+# sanity bounds: a corrupted length prefix must not allocate the
+# universe.  Body ≤ 2 GiB (a u32 can express up to 4 GiB−1, so the bound
+# must sit BELOW the field's range to ever trigger); violations are
+# FramingError — the stream is desynced, the connection must be dropped
+_MAX_HEADER = 16 << 20
+_MAX_BODY = 2 << 30
+
+
+# -- errors ------------------------------------------------------------------
+
+class WireError(ConnectionError):
+    """Base for transport-level wire failures (all retryable)."""
+
+
+class ConnectionClosed(WireError):
+    """Clean close at a frame boundary — no request/reply in flight was
+    lost; safe to reconnect and retry."""
+
+
+class TruncatedMessage(WireError):
+    """The peer vanished MID-message: the frame being read is lost.
+    Retrying is still safe for center ops (idempotency tokens make the
+    server dedup a retry of anything that landed), but the distinction
+    matters for telemetry and for protocols without tokens."""
+
+
+class CorruptPayload(WireError):
+    """Body bytes failed their CRC32 — the wire, not the op, is bad."""
+
+
+class VersionMismatch(RuntimeError):
+    """Peer speaks a different wire protocol version.  NOT retryable —
+    deliberately loud, with both versions in the message."""
+
+
+class WireGiveUp(ConnectionError):
+    """Retries/deadline exhausted.  Carries what was tried and the last
+    underlying error so the give-up is diagnosable, not opaque."""
+
+
+class RemoteOpError(RuntimeError):
+    """The server executed the request and replied with an op-level
+    failure (shape mismatch, unknown op).  NOT retryable: the op, not
+    the wire, is wrong."""
+
+
+class CenterUninitialized(RemoteOpError):
+    """The center has no params yet — a respawn with no usable snapshot.
+    Not a wire fault and not retryable as-is, but RECOVERABLE: the
+    caller re-seeds via ``ensure_init`` with its current params and
+    carries on (an island doing so restarts the consensus from its own
+    state — the missed center history is a missed exchange, which the
+    async algebra absorbs)."""
+
+
+class FramingError(WireError):
+    """A length prefix failed its sanity bound — the byte stream itself
+    is corrupted/desynced, so unlike a CRC mismatch the connection CANNOT
+    be reused: both ends must drop it (the next 'length' read would be
+    arbitrary payload bytes)."""
+
+
+#: Sentinel cached-reply for a token whose ORIGINAL request is still
+#: being applied on another handler thread: the retry must be told to
+#: come back (retryable busy reply), not acked — the original may yet
+#: fail and release the claim.
+INFLIGHT = object()
+
+
+# -- framing -----------------------------------------------------------------
+
+def send_msg(sock: socket.socket, header: dict, body: bytes = b"") -> None:
+    """One framed message: ``[4B hlen][4B header CRC][header JSON]
+    [4B blen][body]`` — version-stamped header, CRC on BOTH parts.  The
+    header CRC is what makes every other integrity verdict trustworthy:
+    without it a flipped header byte reads as garbage JSON (or a spurious
+    unretryable version mismatch) instead of a detected wire fault."""
+    h = dict(header)
+    h["v"] = WIRE_VERSION
+    if body:
+        h["crc"] = zlib.crc32(body) & 0xFFFFFFFF
+    hb = json.dumps(h).encode()
+    sock.sendall(struct.pack("!I", len(hb))
+                 + struct.pack("!I", zlib.crc32(hb) & 0xFFFFFFFF) + hb
+                 + struct.pack("!I", len(body)) + body)
+
+
+def recv_exact(sock: socket.socket, n: int, *,
+               at_boundary: bool = False) -> bytes:
+    """Read exactly ``n`` bytes.  A clean close before the FIRST byte of
+    a message (``at_boundary``) raises :class:`ConnectionClosed`; a close
+    anywhere else raises :class:`TruncatedMessage` — the caller can tell
+    "peer left between requests" from "payload lost mid-flight"."""
+    chunks: List[bytes] = []
+    got = 0
+    while got < n:
+        c = sock.recv(min(n - got, 1 << 20))
+        if not c:
+            if at_boundary and got == 0:
+                raise ConnectionClosed(
+                    "peer closed the connection at a message boundary")
+            raise TruncatedMessage(
+                f"connection closed mid-message ({got}/{n} bytes read)")
+        chunks.append(c)
+        got += len(c)
+    return b"".join(chunks)
+
+
+def recv_msg(sock: socket.socket,
+             check_version: bool = True) -> Tuple[dict, bytes]:
+    """One framed message back: verifies the header CRC (a mismatch is
+    :class:`FramingError` — a flipped header OR length byte cannot be
+    told apart, so the only safe verdict is a desynced stream: drop the
+    connection), then the protocol version (loud — and now TRUSTWORTHY —
+    :class:`VersionMismatch` with both versions), then the body CRC
+    (:class:`CorruptPayload`: the header proved the stream aligned, so
+    the op can be retried on this same connection)."""
+    (hlen,) = struct.unpack("!I", recv_exact(sock, 4, at_boundary=True))
+    if hlen > _MAX_HEADER:
+        raise FramingError(f"header length {hlen} exceeds bound "
+                           f"{_MAX_HEADER} — corrupted length prefix, "
+                           f"stream desynced: drop the connection")
+    (hcrc,) = struct.unpack("!I", recv_exact(sock, 4))
+    hb = recv_exact(sock, hlen)
+    if (zlib.crc32(hb) & 0xFFFFFFFF) != hcrc:
+        raise FramingError(
+            f"header CRC mismatch ({hlen} bytes): header or length "
+            f"prefix corrupted — stream integrity unknown, drop the "
+            f"connection")
+    try:
+        header = json.loads(hb)
+    except ValueError:
+        raise FramingError("header passed its CRC but is not JSON — "
+                           "peer speaks a different framing; drop the "
+                           "connection") from None
+    (blen,) = struct.unpack("!I", recv_exact(sock, 4))
+    if blen > _MAX_BODY:
+        raise FramingError(f"body length {blen} exceeds bound "
+                           f"{_MAX_BODY} — corrupted length prefix, "
+                           f"stream desynced: drop the connection")
+    body = recv_exact(sock, blen) if blen else b""
+    if check_version:
+        got = header.get("v")
+        if got != WIRE_VERSION:
+            raise VersionMismatch(
+                f"wire protocol version mismatch: peer speaks "
+                f"v{got!r}, this end speaks v{WIRE_VERSION} — both ends "
+                f"must run the same release")
+    crc = header.get("crc")
+    if body and crc is not None and (zlib.crc32(body) & 0xFFFFFFFF) != crc:
+        raise CorruptPayload(
+            f"payload CRC mismatch ({len(body)} bytes): body corrupted "
+            f"in flight")
+    return header, body
+
+
+def encode_frame(header: dict, body: bytes = b"") -> bytes:
+    """The exact bytes :func:`send_msg` would emit — WITHOUT stamping the
+    version, so tests and probes can craft mismatched/raw frames."""
+    hb = json.dumps(header).encode()
+    return (struct.pack("!I", len(hb))
+            + struct.pack("!I", zlib.crc32(hb) & 0xFFFFFFFF) + hb
+            + struct.pack("!I", len(body)) + body)
+
+
+# -- leaf packing (numpy lives only here) ------------------------------------
+
+def pack_leaves(leaves) -> bytes:
+    """Flat leaf list → npz bytes keyed by flatten order (no pickle)."""
+    import io
+
+    import numpy as np
+    buf = io.BytesIO()
+    np.savez(buf, **{f"leaf{i}": np.asarray(x, np.float32)
+                     for i, x in enumerate(leaves)})
+    return buf.getvalue()
+
+
+def unpack_leaves(body: bytes):
+    import io
+
+    import numpy as np
+    if not body:
+        return []
+    with np.load(io.BytesIO(body), allow_pickle=False) as z:
+        return [z[f"leaf{i}"] for i in range(len(z.files))]
+
+
+# -- server-side dedup window ------------------------------------------------
+
+class DedupWindow:
+    """Exactly-once application for retried mutating ops.
+
+    Remembers the last ``depth`` applied ``(client, op, seq)`` tokens per
+    client together with the reply that was sent, so a retry of a request
+    that already landed is answered from the cache instead of applied
+    again.  ``seq`` high-water marks are kept per client for snapshots:
+    after a center restart a replayed token at-or-below the restored HWM
+    is still recognized even though its cached reply is gone (the server
+    then synthesizes a fresh reply — the op is NOT reapplied).
+    """
+
+    def __init__(self, depth: int = 128, telemetry_=None):
+        self.depth = int(depth)
+        self.telemetry = telemetry_
+        self._lock = threading.Lock()
+        # client -> OrderedDict[(op, seq) -> (header, body) | None]
+        self._seen: Dict[str, OrderedDict] = {}
+        self.seq_hwm: Dict[str, int] = {}
+        self.hits = 0
+
+    def _tm(self):
+        return self.telemetry if self.telemetry is not None \
+            else telemetry.active()
+
+    def check(self, token: Optional[dict], op: str
+              ) -> Tuple[bool, Any]:
+        """``(is_duplicate, cached_reply)`` for a request's token.  A
+        tokenless request is never a duplicate (legacy/test clients).
+        For a duplicate, ``cached_reply`` is the recorded ``(header,
+        body|None)`` (``None`` body = applied but not cached — reply
+        must be synthesized), plain ``None`` for a post-restart/evicted
+        replay of an APPLIED request, or the :data:`INFLIGHT` sentinel
+        when the original is still being applied on another thread —
+        the caller must answer that one with a retryable busy reply,
+        never an ack (the original may yet fail and release the claim).
+
+        A FRESH token is atomically CLAIMED (placeholder entry) before
+        returning, so a retry arriving while the original is still being
+        applied — a slow server past the client's op timeout — reads as
+        a duplicate instead of a second application.  :meth:`release`
+        withdraws the claim when the op fails."""
+        if not token:
+            return False, None
+        w, seq = str(token.get("w")), int(token.get("seq", -1))
+        with self._lock:
+            window = self._seen.get(w)
+            if window is not None and (op, seq) in window:
+                self.hits += 1
+                entry = window[(op, seq)]
+                hit = INFLIGHT if entry is None else entry
+            elif seq <= self.seq_hwm.get(w, -1):
+                # at-or-below the high-water mark but outside the cached
+                # window: an OLD retry (or a post-restart replay) of a
+                # request that landed before — never reapply.  HWMs only
+                # advance in record(), so this is always APPLIED, never
+                # in-flight
+                self.hits += 1
+                hit = None
+            else:
+                if window is None:
+                    window = self._seen[w] = OrderedDict()
+                window[(op, seq)] = None        # claim
+                while len(window) > self.depth:
+                    window.popitem(last=False)
+                return False, None
+        tm = self._tm()
+        if tm.enabled:
+            tm.counter("wire.dedup_hit")
+        return True, hit
+
+    def record(self, token: Optional[dict], op: str,
+               reply_header: dict, reply_body: Optional[bytes] = b"",
+               max_cached_body: int = 1 << 20) -> None:
+        """Remember an APPLIED request's reply (bounded per client).
+        ``reply_body=None`` means the body is deliberately NOT cached
+        (model-sized push_pull replies — the window must stay small); a
+        replay then gets a synthesized body."""
+        if not token:
+            return
+        w, seq = str(token.get("w")), int(token.get("seq", -1))
+        # (header, None) = applied but body not cached (too big / opted
+        # out) — a replay synthesizes it; distinct from the bare-None claim
+        cached = (dict(reply_header),
+                  bytes(reply_body) if reply_body is not None
+                  and len(reply_body) <= max_cached_body else None)
+        with self._lock:
+            window = self._seen.setdefault(w, OrderedDict())
+            window[(op, seq)] = cached
+            while len(window) > self.depth:
+                window.popitem(last=False)
+            if seq > self.seq_hwm.get(w, -1):
+                self.seq_hwm[w] = seq
+
+    def release(self, token: Optional[dict], op: str) -> None:
+        """Withdraw a :meth:`check` claim after the op FAILED — a later
+        retry of the same token must be allowed to apply."""
+        if not token:
+            return
+        w, seq = str(token.get("w")), int(token.get("seq", -1))
+        with self._lock:
+            window = self._seen.get(w)
+            if window is not None and window.get((op, seq)) is None \
+                    and (op, seq) in window:
+                del window[(op, seq)]
+
+    # -- snapshot plumbing (center crash recovery) --------------------------
+
+    def snapshot(self) -> dict:
+        """APPLIED tokens + HWMs only — cached reply bodies (whole center
+        pulls) would bloat the snapshot, and in-flight claims must NOT
+        persist (a crash mid-apply followed by a restore would otherwise
+        dedup a retry of an op that never landed).  A post-restart replay
+        is recognized by token and answered with a synthesized reply."""
+        with self._lock:
+            return {"hwm": dict(self.seq_hwm),
+                    "tokens": {w: [[op, seq] for (op, seq), v
+                                   in window.items() if v is not None]
+                               for w, window in self._seen.items()},
+                    "hits": self.hits}
+
+    def restore(self, snap: dict) -> None:
+        with self._lock:
+            self.seq_hwm = {str(w): int(s)
+                            for w, s in (snap.get("hwm") or {}).items()}
+            self._seen = {}
+            for w, toks in (snap.get("tokens") or {}).items():
+                window = self._seen[str(w)] = OrderedDict()
+                for op, seq in toks:
+                    # applied-before-the-restart marker (reply bodies are
+                    # not snapshotted): a replay gets a synthesized reply
+                    window[(str(op), int(seq))] = \
+                        ({"ok": True, "dedup": True}, None)
+            self.hits = int(snap.get("hits", 0))
+
+
+# -- client ------------------------------------------------------------------
+
+class WireClient:
+    """Persistent framed connection with per-op timeouts, bounded
+    exponential-backoff retries, transparent reconnect, and idempotency
+    tokens — the client half of the §15 wire contract.
+
+    ``client_id`` keys the server's dedup window (island id, or any
+    stable string); ``op_timeout_s`` bounds each send+recv; a failed
+    attempt reconnects and retries up to ``max_retries`` times within
+    ``deadline_s``, then raises :class:`WireGiveUp` carrying the attempt
+    count and last error.  Thread-safe: one lock serializes this
+    process's callers (the SERVER's lock serializes across processes).
+    """
+
+    def __init__(self, addr: str, client_id: Any = None, *,
+                 op_timeout_s: float = 20.0, connect_timeout_s: float = 5.0,
+                 max_retries: int = 8, deadline_s: float = 120.0,
+                 backoff=None, telemetry_=None):
+        host, port = str(addr).rsplit(":", 1)
+        self.addr = (host, int(port))
+        self.client_id = str(client_id) if client_id is not None else \
+            f"c{id(self) & 0xFFFFFF:x}"
+        self.op_timeout_s = float(op_timeout_s)
+        self.connect_timeout_s = float(connect_timeout_s)
+        self.max_retries = int(max_retries)
+        self.deadline_s = float(deadline_s)
+        if backoff is None:
+            from .membership import Backoff
+            backoff = Backoff(base=0.2, factor=2.0, cap=5.0)
+        self.backoff = backoff
+        self.telemetry = telemetry_
+        self._lock = threading.Lock()
+        self._sock: Optional[socket.socket] = None
+        # seq starts at wall-clock milliseconds, NOT 0: a respawned worker
+        # reuses its client_id (island ids are stable across incarnations),
+        # and the server's seq high-water mark survives both window
+        # eviction and center restarts — a fresh incarnation restarting
+        # from 0 would have every push silently deduped as an 'old retry'.
+        # Clock-based seeding keeps each incarnation strictly above the
+        # last (respawns are seconds apart; the counter is per-client)
+        self._seq = int(time.time() * 1000)
+        self._outage_t0: Optional[float] = None
+
+    # -- plumbing -----------------------------------------------------------
+
+    def _tm(self):
+        return self.telemetry if self.telemetry is not None \
+            else telemetry.active()
+
+    def _connect(self) -> socket.socket:
+        s = socket.create_connection(self.addr,
+                                     timeout=self.connect_timeout_s)
+        s.settimeout(self.op_timeout_s)
+        return s
+
+    def _drop(self) -> None:
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+            self._sock = None
+
+    def _note_ok(self, dt: float) -> None:
+        tm = self._tm()
+        if self._outage_t0 is not None:
+            outage = time.time() - self._outage_t0
+            self._outage_t0 = None
+            if tm.enabled:
+                tm.gauge("wire.outage_s", round(outage, 3))
+                # streamed as a gauges event so the Perfetto export draws
+                # the outage-duration counter track; the wire event is the
+                # human-readable instant marker
+                tm.event("gauges", **{"wire.outage_s": round(outage, 3)})
+                tm.event(WIRE_EVENT, kind="outage", w=self.client_id,
+                         secs=round(outage, 3))
+        if tm.enabled:
+            tm.observe("wire.rtt", dt)
+
+    def _note_fail(self, counter: Optional[str] = None) -> None:
+        if self._outage_t0 is None:
+            self._outage_t0 = time.time()
+        tm = self._tm()
+        if counter and tm.enabled:
+            tm.counter(counter)
+
+    # -- the request loop ---------------------------------------------------
+
+    def request(self, header: dict, body: bytes = b"",
+                ) -> Tuple[dict, bytes]:
+        """One request/response round-trip, retried through failures.
+
+        Center ops are idempotent under retry BY CONSTRUCTION: the token
+        stamped here makes the server's dedup window apply a re-sent
+        mutating op exactly once and replay the original reply."""
+        h = dict(header)
+        with self._lock:
+            h["tok"] = {"w": self.client_id, "seq": self._seq}
+            self._seq += 1
+            return self._request_locked(h, body)
+
+    def _request_locked(self, header: dict, body: bytes
+                        ) -> Tuple[dict, bytes]:
+        t_start = time.time()
+        last_err: Optional[BaseException] = None
+        attempts = 0
+        for attempt in range(self.max_retries + 1):
+            attempts = attempt + 1
+            if attempt:
+                self._note_fail("wire.retry")
+                delay = self.backoff.delay(attempt - 1)
+                if time.time() + delay - t_start > self.deadline_s:
+                    break
+                time.sleep(delay)
+            try:
+                if self._sock is None:
+                    self._sock = self._connect()
+                    if attempt or self._outage_t0 is not None:
+                        tm = self._tm()
+                        if tm.enabled:
+                            tm.counter("wire.reconnect")
+                t0 = time.time()
+                send_msg(self._sock, header, body)
+                resp, rbody = recv_msg(self._sock)
+                if not resp.get("ok"):
+                    if resp.get("retry"):
+                        # retryable server-side verdict: same token, try
+                        # again — a CRC mismatch counts as corruption, an
+                        # in-flight-twin busy reply does not
+                        last_err = WireError(str(resp.get("error")))
+                        if not resp.get("busy"):
+                            self._note_fail("wire.corrupt")
+                        continue
+                    if resp.get("uninit"):
+                        raise CenterUninitialized(
+                            f"center server error: {resp.get('error')}")
+                    raise RemoteOpError(
+                        f"center server error: {resp.get('error')}")
+                self._note_ok(time.time() - t0)
+                return resp, rbody
+            except socket.timeout as e:
+                # the reply may still be in flight — the stream is no
+                # longer frame-aligned, so the connection must be dropped
+                last_err = e
+                self._note_fail("wire.timeout")
+                self._drop()
+            except CorruptPayload as e:
+                # response body corrupted in flight; framing stayed
+                # aligned, the connection is reusable
+                last_err = e
+                self._note_fail("wire.corrupt")
+            except VersionMismatch:
+                self._drop()
+                raise                  # deliberately loud, never retried
+            except (WireError, OSError) as e:
+                # wire.retry ticks at the loop top — only mark the outage
+                last_err = e
+                self._note_fail()
+                self._drop()
+            if time.time() - t_start > self.deadline_s:
+                break
+        self._drop()
+        tm = self._tm()
+        if tm.enabled:
+            tm.counter("wire.giveup")
+            tm.event(WIRE_EVENT, kind="giveup", w=self.client_id,
+                     op=str(header.get("op")),
+                     err=repr(last_err)[:200])
+        raise WireGiveUp(
+            f"center {self.addr[0]}:{self.addr[1]} unreachable: gave up "
+            f"on op {header.get('op')!r} after {attempts} attempts / "
+            f"{time.time() - t_start:.1f}s (deadline {self.deadline_s:.0f}s)"
+            f" — last error: {last_err!r}")
+
+    def close(self) -> None:
+        with self._lock:
+            self._drop()

@@ -38,6 +38,23 @@ from typing import Callable, Dict, List, Optional, Sequence
 
 FAULT_KINDS = ("kill", "stop", "delay")
 
+# wire-level fault kinds (round 14): applied by the ChaosProxy to framed
+# center traffic instead of to processes.  ``at`` opens a fault WINDOW of
+# ``duration`` seconds; ``target`` matches the client id stamped in each
+# frame's idempotency token (-1 = every client).
+#   net_drop      — frames silently discarded (client times out, retries)
+#   net_delay     — each frame stalls NET_DELAY_PER_FRAME_S before forward
+#   net_dup       — each frame forwarded TWICE (the dedup-window test)
+#   net_corrupt   — one body byte flipped (CRC catches it; client retries)
+#   net_partition — connections cut and new ones refused for the window
+NET_FAULT_KINDS = ("net_drop", "net_delay", "net_dup", "net_corrupt",
+                   "net_partition")
+FAULT_KINDS = FAULT_KINDS + NET_FAULT_KINDS
+
+# per-frame stall inside a net_delay window — one knob, not per-fault
+# grammar (the window length already comes from the schedule)
+NET_DELAY_PER_FRAME_S = 0.25
+
 # the injection-audit event kind (telemetry stream + Perfetto instant
 # marker) — the chaos gate matches worker_leave/worker_join transitions
 # against these
@@ -124,7 +141,12 @@ class ChaosMonkey(threading.Thread):
                  telemetry_=None, poll_s: float = 0.05,
                  grace_s: float = 10.0, t0: Optional[float] = None):
         super().__init__(daemon=True, name="chaos-monkey")
-        self.schedule = sorted(schedule, key=lambda f: f.at)
+        # net_* faults are the ChaosProxy's job — a pid-targeted monkey
+        # given a mixed schedule must not SIGSTOP a process because a
+        # PARTITION was asked for
+        self.schedule = sorted((f for f in schedule
+                                if f.kind not in NET_FAULT_KINDS),
+                               key=lambda f: f.at)
         self.pid_of = pid_of
         self.delay_hook = delay_hook
         self.telemetry = telemetry_
@@ -203,6 +225,271 @@ class ChaosMonkey(threading.Thread):
         self._halt.set()
         if self.is_alive():
             self.join(timeout=join_timeout)
+
+
+# -- wire-level chaos: the faulting proxy ------------------------------------
+
+def _recvn(sock, n: int) -> bytes:
+    chunks = []
+    got = 0
+    while got < n:
+        c = sock.recv(min(n - got, 1 << 20))
+        if not c:
+            raise ConnectionError(f"closed ({got}/{n})")
+        chunks.append(c)
+        got += len(c)
+    return b"".join(chunks)
+
+
+def _read_frame(sock):
+    """One wire frame (parallel/wire.py framing: ``[4B hlen][4B header
+    CRC][header JSON][4B blen][body]``) as ``(prefix_bytes, header_dict,
+    body_bytes)`` — the proxy reassembles whole frames so faults hit
+    MESSAGES, not arbitrary byte runs (a half-forwarded frame would just
+    wedge both ends instead of exercising the retry/dedup machinery)."""
+    import json as _json
+    import struct as _struct
+    hl = _recvn(sock, 4)
+    (hlen,) = _struct.unpack("!I", hl)
+    hcrc = _recvn(sock, 4)
+    hb = _recvn(sock, hlen)
+    bl = _recvn(sock, 4)
+    (blen,) = _struct.unpack("!I", bl)
+    body = _recvn(sock, blen) if blen else b""
+    try:
+        header = _json.loads(hb)
+    except ValueError:
+        header = {}
+    return hl + hcrc + hb + bl, header, body
+
+
+class ChaosProxy:
+    """A faulting TCP proxy between wire clients and the center server.
+
+    Sits on its own port; every client connection gets a paired upstream
+    connection and two pump threads.  Client→server frames are read
+    WHOLE and, while a scheduled fault window is active, dropped,
+    delayed, duplicated (the extra reply is swallowed on the way back so
+    the client's request/reply stream stays aligned — the DUPLICATE
+    hits the server's dedup window, which is the point), or corrupted
+    (one body byte flipped; the CRC catches it server-side).
+    ``net_partition`` cuts matching connections and refuses new ones for
+    the window.  Fault targets match the client id in each frame's
+    idempotency token (``tok.w``; -1 = all).  Every window that opens
+    emits one :data:`FAULT_EVENT` audit event.
+
+    Stdlib-only like the rest of this module; schedules come from
+    :func:`parse_schedule` / :func:`seeded_schedule` with the
+    :data:`NET_FAULT_KINDS` kinds."""
+
+    def __init__(self, upstream_addr: str, schedule: Sequence[Fault] = (),
+                 listen_host: str = "127.0.0.1", listen_port: int = 0,
+                 telemetry_=None, t0: Optional[float] = None,
+                 poll_s: float = 0.05):
+        import socket as _socket
+        host, port = str(upstream_addr).rsplit(":", 1)
+        self.upstream = (host, int(port))
+        self.schedule = sorted((f for f in schedule
+                                if f.kind in NET_FAULT_KINDS),
+                               key=lambda f: f.at)
+        self.listen_host = listen_host
+        self.listen_port = int(listen_port)
+        self.telemetry = telemetry_
+        self.t0 = time.time() if t0 is None else float(t0)
+        self.poll_s = float(poll_s)
+        self._socket = _socket
+        self._halt = threading.Event()
+        self._lock = threading.Lock()
+        self._conns: list = []          # [{c, u, worker, pattern}]
+        self._lsock = None
+        self._threads: list = []
+        self.applied: List[Fault] = []
+        self.frames_faulted: Dict[str, int] = {}
+
+    # -- schedule -----------------------------------------------------------
+
+    def _active(self, kind: str, worker) -> bool:
+        now = time.time() - self.t0
+        for f in self.schedule:
+            if f.kind != kind or not (f.at <= now <= f.at + f.duration):
+                continue
+            if f.target == -1 or (worker is not None
+                                  and int(f.target) == int(worker)):
+                return True
+        return False
+
+    def _emit(self, fault: Fault) -> None:
+        fault.applied = True
+        self.applied.append(fault)
+        tm = self.telemetry
+        if tm is not None and getattr(tm, "enabled", False):
+            tm.event(FAULT_EVENT, kind=fault.kind, worker=fault.target,
+                     at=round(fault.at, 2), duration=fault.duration)
+        print(f"chaos-proxy: window open {fault!r}",
+              file=sys.stderr, flush=True)
+
+    def _count(self, kind: str) -> None:
+        with self._lock:
+            self.frames_faulted[kind] = self.frames_faulted.get(kind, 0) + 1
+
+    # -- pumps --------------------------------------------------------------
+
+    def _pump_c2s(self, st) -> None:
+        try:
+            while not self._halt.is_set():
+                prefix, header, body = _read_frame(st["c"])
+                tok = header.get("tok") or {}
+                w = tok.get("w")
+                if w is not None:
+                    # 'w3' (island clients) or a bare int — match on digits
+                    ws = str(w)
+                    st["worker"] = int(ws[1:]) if ws[:1] == "w" and \
+                        ws[1:].isdigit() else (int(ws) if
+                                               ws.lstrip("-").isdigit()
+                                               else None)
+                if self._active("net_partition", st["worker"]):
+                    self._count("net_partition")
+                    break                       # cut the connection
+                if self._active("net_drop", st["worker"]):
+                    self._count("net_drop")
+                    continue                    # frame evaporates
+                if self._active("net_delay", st["worker"]):
+                    self._count("net_delay")
+                    time.sleep(NET_DELAY_PER_FRAME_S)
+                if self._active("net_corrupt", st["worker"]) and body:
+                    self._count("net_corrupt")
+                    bb = bytearray(body)
+                    bb[len(bb) // 2] ^= 0xFF    # CRC will catch it
+                    body = bytes(bb)
+                dup = self._active("net_dup", st["worker"])
+                with st["wlock"]:
+                    st["pattern"].append(0)     # forward this reply
+                    st["u"].sendall(prefix + body)
+                    if dup:
+                        self._count("net_dup")
+                        st["pattern"].append(1)  # swallow the dup's reply
+                        st["u"].sendall(prefix + body)
+        except (ConnectionError, OSError, ValueError):
+            pass
+        finally:
+            self._close_pair(st)
+
+    def _pump_s2c(self, st) -> None:
+        try:
+            while not self._halt.is_set():
+                prefix, header, body = _read_frame(st["u"])
+                with st["wlock"]:
+                    swallow = st["pattern"].popleft() \
+                        if st["pattern"] else 0
+                if swallow:
+                    continue        # the duplicate's reply — client never
+                                    # sent that frame twice, so it must
+                                    # never see two replies
+                if self._active("net_partition", st["worker"]):
+                    self._count("net_partition")
+                    break
+                st["c"].sendall(prefix + body)
+        except (ConnectionError, OSError, ValueError):
+            pass
+        finally:
+            self._close_pair(st)
+
+    def _close_pair(self, st) -> None:
+        for k in ("c", "u"):
+            try:
+                st[k].close()
+            except OSError:
+                pass
+        with self._lock:
+            if st in self._conns:
+                self._conns.remove(st)
+
+    # -- accept / monitor loops ---------------------------------------------
+
+    def _accept_loop(self) -> None:
+        from collections import deque
+        while not self._halt.is_set():
+            try:
+                c, _ = self._lsock.accept()
+            except OSError:
+                return
+            if self._active("net_partition", None):
+                # a global (target −1) partition refuses NEW connections
+                # too; a worker-targeted one can't match here — the peer's
+                # identity is unknown until its first frame
+                self._count("net_partition")
+                try:
+                    c.close()
+                except OSError:
+                    pass
+                continue
+            try:
+                u = self._socket.create_connection(self.upstream,
+                                                   timeout=5.0)
+            except OSError:
+                try:
+                    c.close()       # center down: the outage passes through
+                except OSError:
+                    pass
+                continue
+            st = {"c": c, "u": u, "worker": None, "pattern": deque(),
+                  "wlock": threading.Lock()}
+            with self._lock:
+                self._conns.append(st)
+            # pump threads are NOT retained: a chaos run's retry storms
+            # open thousands of short-lived pairs, and nothing joins them
+            # (stop() severs their sockets via _conns instead)
+            for fn in (self._pump_c2s, self._pump_s2c):
+                threading.Thread(target=fn, args=(st,), daemon=True).start()
+
+    def _monitor_loop(self) -> None:
+        pending = [f for f in self.schedule if not f.applied]
+        while pending and not self._halt.is_set():
+            now = time.time() - self.t0
+            still = []
+            for f in pending:
+                if f.at <= now:
+                    self._emit(f)
+                    if f.kind == "net_partition":
+                        # cut EXISTING matching connections at window open
+                        with self._lock:
+                            conns = list(self._conns)
+                        for st in conns:
+                            if f.target == -1 or \
+                                    st["worker"] == f.target:
+                                self._close_pair(st)
+                else:
+                    still.append(f)
+            pending = still
+            self._halt.wait(self.poll_s)
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def start(self) -> str:
+        """Bind + serve; returns the ``host:port`` clients should dial."""
+        self._lsock = self._socket.socket()
+        self._lsock.setsockopt(self._socket.SOL_SOCKET,
+                               self._socket.SO_REUSEADDR, 1)
+        self._lsock.bind((self.listen_host, self.listen_port))
+        self._lsock.listen(64)
+        addr = self._lsock.getsockname()
+        for fn in (self._accept_loop, self._monitor_loop):
+            t = threading.Thread(target=fn, daemon=True)
+            t.start()
+            self._threads.append(t)
+        return f"{addr[0]}:{addr[1]}"
+
+    def stop(self) -> None:
+        self._halt.set()
+        if self._lsock is not None:
+            try:
+                self._lsock.close()
+            except OSError:
+                pass
+        with self._lock:
+            conns = list(self._conns)
+        for st in conns:
+            self._close_pair(st)
 
 
 def find_child_pid(parent_pid: int, needle: str,
