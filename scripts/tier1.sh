@@ -11,8 +11,10 @@
 # (analysis/engine.py), SPMD collective discipline (axis names,
 # rank-divergent branches, start/done pairing), PartitionSpec/shard_map
 # schema checks, exchange_body symmetry, the jax_compat shim boundary,
-# the telemetry hot-path enabled-guard contract, and the recorder/
-# telemetry schema sync.  Any finding not covered by
+# the telemetry hot-path enabled-guard contract, the recorder/
+# telemetry schema sync, and the host-concurrency pass (thread-role
+# inference; shared-state races, lock-order cycles, signal safety,
+# daemon discipline — design.md §16).  Any finding not covered by
 # tpulint_baseline.json — or a stale baseline entry — fails the gate
 # here, without importing jax, before pytest.  An unchanged tree is a
 # .tpulint_cache/ hit: the gate costs well under a second.

@@ -517,3 +517,25 @@ def test_chaos_gate_center_kill_and_net_faults_convergence(tmp_path):
     with open(os.path.join(chaos_dir, "chaos_gate.json")) as f:
         gate = json.load(f)
     assert gate["val_cost"] < clean_loss + 0.15
+
+
+def test_proxy_stop_joins_its_threads():
+    """ChaosProxy.stop() bounded-joins the accept/monitor threads
+    (tpulint daemon-discipline): nothing of the proxy may outlive
+    stop() into the caller's teardown/audit."""
+    import socket as _socket
+
+    up = _socket.socket()
+    up.bind(("127.0.0.1", 0))
+    up.listen(1)
+    host, port = up.getsockname()
+    # a far-future window keeps the monitor loop alive until stop()
+    proxy = chaos.ChaosProxy(f"{host}:{port}",
+                             chaos.parse_schedule("net_drop@600:1:1"))
+    proxy.start()
+    threads = list(proxy._threads)
+    assert threads and all(t.is_alive() for t in threads)
+    proxy.stop()
+    assert all(not t.is_alive() for t in threads)
+    assert proxy._threads == []
+    up.close()

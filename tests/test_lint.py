@@ -1475,3 +1475,599 @@ def test_precommit_lints_staged_blob_not_worktree(tmp_path):
     p = subprocess.run(["bash", "scripts/precommit_lint.sh"], cwd=repo,
                        capture_output=True, text=True, timeout=300)
     assert p.returncode == 0, p.stdout + p.stderr
+
+
+# ---------------------------------------------------------------------------
+# host-concurrency pass (round 15): thread-role inference + four checkers
+# ---------------------------------------------------------------------------
+
+RACE_BAD = """
+import threading
+
+class Counter:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.count = 0
+        self.items = []
+        self._thread = None
+
+    def start(self):
+        self._thread = threading.Thread(target=self._worker, daemon=True)
+        self._thread.start()
+
+    def _worker(self):
+        self.count = self.count + 1
+        self.items.append(self.count)
+
+    def bump(self):
+        self.count = 0
+
+    def snapshot(self):
+        return list(self.items)
+
+    def stop(self):
+        self._thread.join(timeout=1)
+"""
+
+RACE_GOOD = """
+import threading
+
+class Counter:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.count = 0
+        self.items = []
+        self._thread = None
+
+    def start(self):
+        self._thread = threading.Thread(target=self._worker, daemon=True)
+        self._thread.start()
+
+    def _worker(self):
+        with self._lock:
+            self.count = self.count + 1
+            self.items.append(self.count)
+
+    def bump(self):
+        with self._lock:
+            self.count = 0
+
+    def snapshot(self):
+        with self._lock:
+            return list(self.items)
+
+    def stop(self):
+        self._thread.join(timeout=1)
+"""
+
+
+def test_shared_state_race_bad_fixture(tmp_path):
+    found = lint_snippet(tmp_path, "bad.py", RACE_BAD, "shared-state-race")
+    msgs = [f.message for f in found]
+    assert len(found) == 2, msgs
+    assert any("`count`" in m and "no common lock" in m for m in msgs)
+    assert any("`items`" in m and "iteration/copy" in m for m in msgs)
+
+
+def test_shared_state_race_good_fixture(tmp_path):
+    assert lint_snippet(tmp_path, "good.py", RACE_GOOD,
+                        "shared-state-race") == []
+
+
+def test_shared_state_race_init_writes_are_happens_before(tmp_path):
+    """__init__ writes never conflict — construction precedes start()."""
+    code = (
+        "import threading\n"
+        "class C:\n"
+        "    def __init__(self):\n"
+        "        self.flag = False\n"
+        "        threading.Thread(target=self._go, daemon=True).start()\n"
+        "    def _go(self):\n"
+        "        self.flag = True\n")
+    assert lint_snippet(tmp_path, "x.py", code, "shared-state-race") == []
+
+
+def test_shared_state_race_needs_instance_sharing(tmp_path):
+    """A thread that constructs its OWN instance of a class does not
+    conflict with main-thread users of other instances (the per-island
+    private ModelBase shape)."""
+    code = (
+        "import threading\n"
+        "class Model:\n"
+        "    def compile(self):\n"
+        "        self.train_fn = 1\n"
+        "class Island:\n"
+        "    def start(self):\n"
+        "        self._t = threading.Thread(target=self._run, daemon=True)\n"
+        "        self._t.start()\n"
+        "    def _run(self):\n"
+        "        m = Model()\n"
+        "        m.compile()\n"
+        "    def stop(self):\n"
+        "        self._t.join(timeout=1)\n"
+        "def main_path():\n"
+        "    m = Model()\n"
+        "    m.compile()\n")
+    assert lint_snippet(tmp_path, "x.py", code, "shared-state-race") == []
+
+
+LOCK_ORDER_BAD = """
+import threading
+
+class Pair:
+    def __init__(self):
+        self._a_lock = threading.Lock()
+        self._b_lock = threading.Lock()
+
+    def ab(self):
+        with self._a_lock:
+            with self._b_lock:
+                pass
+
+    def ba(self):
+        with self._b_lock:
+            with self._a_lock:
+                pass
+"""
+
+LOCK_ORDER_GOOD = LOCK_ORDER_BAD.replace(
+    "        with self._b_lock:\n            with self._a_lock:",
+    "        with self._a_lock:\n            with self._b_lock:")
+
+
+def test_lock_ordering_cycle_fixture(tmp_path):
+    found = lint_snippet(tmp_path, "bad.py", LOCK_ORDER_BAD,
+                         "lock-ordering")
+    assert len(found) == 1, [f.message for f in found]
+    assert "lock-order cycle" in found[0].message
+    assert "_a_lock" in found[0].message and "_b_lock" in found[0].message
+
+
+def test_lock_ordering_consistent_order_clean(tmp_path):
+    assert lint_snippet(tmp_path, "good.py", LOCK_ORDER_GOOD,
+                        "lock-ordering") == []
+
+
+def test_lock_ordering_nonreentrant_self_deadlock(tmp_path):
+    code = (
+        "import threading\n"
+        "class C:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "    def outer(self):\n"
+        "        with self._lock:\n"
+        "            self.inner()\n"
+        "    def inner(self):\n"
+        "        with self._lock:\n"
+        "            pass\n")
+    found = lint_snippet(tmp_path, "x.py", code, "lock-ordering")
+    assert found and all("self-deadlock" in f.message for f in found)
+    # the reentrant version is the sanctioned idiom (telemetry registry)
+    rcode = code.replace("threading.Lock()", "threading.RLock()")
+    assert lint_snippet(tmp_path, "y.py", rcode, "lock-ordering") == []
+
+
+SIGNAL_BAD = """
+import signal
+import threading
+import time
+
+_state_lock = threading.Lock()
+
+def _handler(signum, frame):
+    time.sleep(0.1)
+    with _state_lock:
+        pass
+    t = threading.Thread(target=_work, daemon=True)
+    t.start()
+
+def _work():
+    pass
+
+signal.signal(signal.SIGTERM, _handler)
+"""
+
+SIGNAL_GOOD = """
+import signal
+import threading
+
+_halt = threading.Event()
+
+def _handler(signum, frame):
+    _halt.set()
+
+signal.signal(signal.SIGTERM, _handler)
+"""
+
+
+def test_signal_safety_bad_fixture(tmp_path):
+    found = lint_snippet(tmp_path, "bad.py", SIGNAL_BAD, "signal-safety")
+    msgs = [f.message for f in found]
+    assert any("time.sleep" in m for m in msgs), msgs
+    assert any("NON-reentrant lock" in m for m in msgs), msgs
+    assert any("spawns a thread" in m for m in msgs), msgs
+
+
+def test_signal_safety_good_fixture(tmp_path):
+    assert lint_snippet(tmp_path, "good.py", SIGNAL_GOOD,
+                        "signal-safety") == []
+
+
+def test_signal_safety_telemetry_recording_flagged(tmp_path):
+    code = (
+        "import signal\n"
+        "from theanompi_tpu.utils import telemetry\n"
+        "tm = telemetry.active()\n"
+        "def _handler(signum, frame):\n"
+        "    tm.event('sig')\n"
+        "signal.signal(signal.SIGTERM, _handler)\n")
+    found = lint_snippet(tmp_path, "x.py", code, "signal-safety")
+    assert len(found) == 1 and "reentrant call" in found[0].message
+
+
+def test_signal_safety_sanctioned_hook_is_exempt():
+    """The live telemetry.py fatal-signal hook records by design (it is
+    terminal) — the repo-wide run must not flag it."""
+    found = core.run_lint(REPO, paths=["theanompi_tpu/utils/telemetry.py"],
+                          only=["signal-safety"])
+    assert found == [], [f.render() for f in found]
+
+
+DAEMON_BAD = """
+import threading
+
+class Owner:
+    def start(self):
+        self._pump = threading.Thread(target=self._run_pump)
+        self._pump.start()
+
+    def _run_pump(self):
+        pass
+
+class BadThread(threading.Thread):
+    def __init__(self):
+        super().__init__()
+        self._stop = threading.Event()
+
+    def run(self):
+        pass
+"""
+
+DAEMON_GOOD = """
+import threading
+
+class Owner:
+    def start(self):
+        self._pump = threading.Thread(target=self._run_pump, daemon=True)
+        self._pump.start()
+
+    def _run_pump(self):
+        pass
+
+    def stop(self):
+        self._pump.join(timeout=1)
+
+class GoodThread(threading.Thread):
+    def __init__(self):
+        super().__init__(daemon=True)
+        self._halt = threading.Event()
+
+    def run(self):
+        pass
+
+    def stop(self):
+        self._halt.set()
+        self.join(timeout=1)
+"""
+
+
+def test_daemon_discipline_bad_fixture(tmp_path):
+    found = lint_snippet(tmp_path, "bad.py", DAEMON_BAD,
+                         "daemon-discipline")
+    msgs = [f.message for f in found]
+    assert any("non-daemon Thread" in m for m in msgs), msgs
+    assert any("`self._stop`" in m and "shadowing" in m
+               for m in msgs), msgs
+    assert any("non-daemon and never joins itself" in m
+               for m in msgs), msgs
+
+
+def test_daemon_discipline_good_fixture(tmp_path):
+    assert lint_snippet(tmp_path, "good.py", DAEMON_GOOD,
+                        "daemon-discipline") == []
+
+
+def test_daemon_discipline_escaping_started_thread_needs_join(tmp_path):
+    code = (
+        "import threading\n"
+        "class P:\n"
+        "    def __init__(self):\n"
+        "        self._threads = []\n"
+        "    def start(self):\n"
+        "        t = threading.Thread(target=self._go, daemon=True)\n"
+        "        t.start()\n"
+        "        self._threads.append(t)\n"
+        "    def _go(self):\n"
+        "        pass\n")
+    found = lint_snippet(tmp_path, "x.py", code, "daemon-discipline")
+    assert len(found) == 1 and "never joined" in found[0].message
+    fixed = code + (
+        "    def stop(self):\n"
+        "        for t in self._threads:\n"
+        "            t.join(timeout=1)\n")
+    assert lint_snippet(tmp_path, "y.py", fixed,
+                        "daemon-discipline") == []
+
+
+# -- engine thread-role inference -------------------------------------------
+
+ENGINE_ROLES = """
+import atexit
+import signal
+import threading
+
+class Prod:
+    def start(self):
+        self._t = threading.Thread(target=self._producer, daemon=True)
+        self._t.start()
+
+    def _producer(self):
+        self._helper()
+
+    def _helper(self):
+        pass
+
+    def consume(self):
+        pass
+
+    def stop(self):
+        self._t.join(timeout=1)
+
+class Mon(threading.Thread):
+    def run(self):
+        pass
+
+def _on_exit():
+    pass
+
+def _on_sig(s, f):
+    pass
+
+atexit.register(_on_exit)
+signal.signal(signal.SIGTERM, _on_sig)
+"""
+
+
+def test_engine_thread_roles_and_main_exclusion(tmp_path):
+    from theanompi_tpu.analysis.engine import MAIN_ROLE, ProgramIndex
+    (tmp_path / "roles.py").write_text(ENGINE_ROLES)
+    sf = core.SourceFile(str(tmp_path), "roles.py")
+    index = ProgramIndex([sf])
+    kinds = {r.kind for r in index.thread_roles()}
+    assert kinds == {"thread", "thread-subclass", "atexit", "signal"}
+    by_qual = {r.qualname: r for r in index.records.values()
+               if not r.qualname.startswith("roles.<lambda>")}
+    rm = index.role_map()
+    prod_roles = rm[id(by_qual["roles.Prod._producer"].node)]
+    help_roles = rm[id(by_qual["roles.Prod._helper"].node)]
+    # the producer and its exclusive helper run ONLY on the spawned
+    # thread — a spawn reference is not a main-role call edge
+    assert MAIN_ROLE not in prod_roles and MAIN_ROLE not in help_roles
+    assert any(r.startswith("thread:") for r in prod_roles)
+    assert prod_roles <= help_roles
+    # the public surface stays main
+    assert MAIN_ROLE in rm[id(by_qual["roles.Prod.consume"].node)]
+    assert MAIN_ROLE in rm[id(by_qual["roles.Prod.start"].node)]
+
+
+def test_engine_spawn_sites_resolve_tuple_loop_targets(tmp_path):
+    """The ChaosProxy pump-pair shape: Thread targets bound by a for
+    loop over a literal tuple of methods must resolve."""
+    from theanompi_tpu.analysis.engine import ProgramIndex
+    code = (
+        "import threading\n"
+        "class P:\n"
+        "    def start(self):\n"
+        "        for fn in (self._a, self._b):\n"
+        "            threading.Thread(target=fn, daemon=True).start()\n"
+        "    def _a(self):\n"
+        "        pass\n"
+        "    def _b(self):\n"
+        "        pass\n")
+    (tmp_path / "pumps.py").write_text(code)
+    sf = core.SourceFile(str(tmp_path), "pumps.py")
+    index = ProgramIndex([sf])
+    sites = [s for s in index.spawn_sites() if s.kind == "thread"]
+    assert len(sites) == 1
+    assert sorted(e.name for e in sites[0].entries) == ["_a", "_b"]
+
+
+def test_schema_drift_thread_role_probe_live_and_bad(tmp_path):
+    """The live repo's membership/chaos spawn sites all resolve; a
+    planted unresolvable spawn fails the probe."""
+    assert sd.thread_role_coverage_errors() == []
+    bad = tmp_path / "theanompi_tpu" / "utils"
+    bad.mkdir(parents=True)
+    (bad / "chaos.py").write_text(
+        "import threading\n"
+        "def go(fns):\n"
+        "    threading.Thread(target=fns[0], daemon=True).start()\n")
+    errors = sd.thread_role_coverage_errors(root=str(tmp_path))
+    assert errors and "does not resolve" in errors[0][1]
+
+
+# -- live injections against the REAL files (CLI --check-baseline gate) -----
+
+def test_injection_unguarded_producer_write_in_prefetch(tmp_path):
+    """An unguarded cross-thread write planted in the prefetch producer
+    fails the tier-1 gate with rc 1."""
+    rel = _inject(
+        tmp_path, "theanompi_tpu/models/data/prefetch.py",
+        "                cursor = self._data.get_cursor() \\\n"
+        "                    if hasattr(self._data, \"get_cursor\") else {}\n"
+        "                if tm.enabled:\n",
+        "                cursor = self._data.get_cursor() \\\n"
+        "                    if hasattr(self._data, \"get_cursor\") else {}\n"
+        "                self._consumed_cursor = cursor\n"
+        "                if tm.enabled:\n")
+    proc = _lint_cli(tmp_path, rel, "--check-baseline")
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    assert "shared-state-race" in proc.stdout
+    assert "_consumed_cursor" in proc.stdout
+
+
+def test_injection_lock_order_inversion_in_center_server(tmp_path):
+    """A planted A→B / B→A inversion across snapshot() and stop() fails
+    the gate with a lock-ordering cycle."""
+    rel = _inject(
+        tmp_path, "theanompi_tpu/parallel/center_server.py",
+        "        with self.center._lock:\n"
+        "            if self.center._leaves is None:\n"
+        "                return None\n",
+        "        with self.center._lock:\n"
+        "            with self._conns_lock:\n"
+        "                pass\n"
+        "            if self.center._leaves is None:\n"
+        "                return None\n")
+    p = tmp_path / rel
+    src = p.read_text()
+    old = ("            with self._conns_lock:\n"
+           "                conns = list(self._conns)\n"
+           "                self._conns.clear()\n")
+    assert old in src, "center_server.stop changed shape; update injection"
+    p.write_text(src.replace(old,
+                 "            with self._conns_lock:\n"
+                 "                with self.center._lock:\n"
+                 "                    pass\n"
+                 "                conns = list(self._conns)\n"
+                 "                self._conns.clear()\n"))
+    proc = _lint_cli(tmp_path, rel, "--check-baseline")
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    assert "lock-order cycle" in proc.stdout
+
+
+def test_injection_telemetry_event_in_signal_hook(tmp_path):
+    """A telemetry.event() call planted into the center CLI's SIGTERM
+    hook fails the gate (reentrant-BufferedWriter hazard)."""
+    rel = _inject(
+        tmp_path, "theanompi_tpu/parallel/center_server.py",
+        "    signal.signal(signal.SIGTERM, lambda *_: halt.set())",
+        "    signal.signal(signal.SIGTERM,\n"
+        "                  lambda *_: (tm.event(\"sigterm\"), halt.set()))")
+    proc = _lint_cli(tmp_path, rel, "--check-baseline")
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    assert "signal-safety" in proc.stdout
+    assert "reentrant call" in proc.stdout
+
+
+# -- the --only concurrency group + cache behavior ---------------------------
+
+def test_only_concurrency_group_runs_just_the_pass(tmp_path):
+    (tmp_path / "bad.py").write_text(RACE_BAD + LOCK_ORDER_BAD)
+    out = json.loads(_lint_cli(tmp_path, "bad.py", "--only", "concurrency",
+                               "--format", "json").stdout)
+    from theanompi_tpu.analysis.checkers import CHECK_GROUPS
+    group = set(CHECK_GROUPS["concurrency"])
+    checks = {f["check"] for f in out["findings"]}
+    assert checks and checks <= group, checks
+    # the v2 schema carries the new checker names + stable fingerprints
+    for f in out["findings"]:
+        assert f["check"] in group
+        assert len(f["fingerprint"]) == 12
+    # and a non-concurrency finding source stays silent under the group
+    (tmp_path / "rng.py").write_text(RNG_BAD)
+    out2 = json.loads(_lint_cli(tmp_path, "rng.py", "--only",
+                                "concurrency", "--format", "json").stdout)
+    assert out2["findings"] == []
+
+
+def test_only_concurrency_repo_warm_cache_subsecond():
+    """Satellite gate: a warm-cache whole-repo run of just the
+    concurrency pass stays sub-second (modulo interpreter startup),
+    mirroring the existing full-suite cache gate."""
+    import time as _time
+    cold = subprocess.run(
+        [sys.executable, LINT, "--only", "concurrency", "--format",
+         "json"], cwd=REPO, capture_output=True, text=True, timeout=300)
+    t0 = _time.monotonic()
+    warm = subprocess.run(
+        [sys.executable, LINT, "--only", "concurrency", "--format",
+         "json"], cwd=REPO, capture_output=True, text=True, timeout=300)
+    elapsed = _time.monotonic() - t0
+    w, c = json.loads(warm.stdout), json.loads(cold.stdout)
+    assert w["cache"] == "hit"
+    assert w["findings"] == c["findings"]
+    assert elapsed < 2.5, f"warm concurrency lint took {elapsed:.2f}s"
+
+
+def test_precommit_carries_concurrency_checkers(tmp_path):
+    """precommit_lint.sh runs the concurrency pass on staged blobs with
+    the same names/fingerprints (satellite: the hook and the json v2
+    schema carry the new checkers unchanged)."""
+    import shutil
+    repo = tmp_path / "r"
+    (repo / "scripts").mkdir(parents=True)
+    (repo / "theanompi_tpu").mkdir()
+    shutil.copy(os.path.join(REPO, "scripts", "precommit_lint.sh"),
+                repo / "scripts" / "precommit_lint.sh")
+    shutil.copy(LINT, repo / "scripts" / "lint.py")
+    shutil.copytree(os.path.join(REPO, "theanompi_tpu", "analysis"),
+                    repo / "theanompi_tpu" / "analysis")
+    shutil.copy(os.path.join(REPO, "theanompi_tpu", "jax_compat.py"),
+                repo / "theanompi_tpu" / "jax_compat.py")
+    (repo / "theanompi_tpu" / "utils").mkdir()
+    for m in ("__init__.py", "recorder.py", "telemetry.py"):
+        shutil.copy(os.path.join(REPO, "theanompi_tpu", "utils", m),
+                    repo / "theanompi_tpu" / "utils" / m)
+
+    def git(*a):
+        return subprocess.run(["git", *a], cwd=repo, capture_output=True,
+                              text=True, timeout=60)
+
+    git("init", "-q")
+    git("config", "user.email", "t@t")
+    git("config", "user.name", "t")
+    (repo / "theanompi_tpu" / "racy.py").write_text(RACE_BAD)
+    git("add", "theanompi_tpu/racy.py")
+    p = subprocess.run(["bash", "scripts/precommit_lint.sh"], cwd=repo,
+                       capture_output=True, text=True, timeout=300)
+    assert p.returncode == 1, p.stdout + p.stderr
+    assert "shared-state-race" in p.stdout
+
+
+def test_engine_resolve_callable_survives_cyclic_rebind(tmp_path):
+    """`fn = fn` (or a = b / b = a) around a spawn target must degrade
+    to unresolved, not recurse to death and abort the engine."""
+    from theanompi_tpu.analysis.engine import ProgramIndex
+    code = (
+        "import threading\n"
+        "def go(fn=None):\n"
+        "    fn = fn\n"
+        "    a = b = None\n"
+        "    a = b\n"
+        "    b = a\n"
+        "    threading.Thread(target=fn, daemon=True).start()\n"
+        "    threading.Thread(target=a, daemon=True).start()\n")
+    (tmp_path / "cyc.py").write_text(code)
+    sf = core.SourceFile(str(tmp_path), "cyc.py")
+    index = ProgramIndex([sf])
+    sites = [s for s in index.spawn_sites() if s.kind == "thread"]
+    assert len(sites) == 2
+    assert all(s.entries == [] for s in sites)
+
+
+def test_daemon_discipline_stored_attr_daemonized_after(tmp_path):
+    """`self._t = Thread(...); self._t.daemon = True` is daemonic — the
+    post-construction daemon assign must be seen for stored attrs too."""
+    code = (
+        "import threading\n"
+        "class C:\n"
+        "    def start(self):\n"
+        "        self._t = threading.Thread(target=self._go)\n"
+        "        self._t.daemon = True\n"
+        "        self._t.start()\n"
+        "    def _go(self):\n"
+        "        pass\n"
+        "    def stop(self):\n"
+        "        self._t.join(timeout=1)\n")
+    assert lint_snippet(tmp_path, "x.py", code, "daemon-discipline") == []

@@ -153,3 +153,20 @@ def test_watchdog_in_worker_loop_detects_slow_iteration(capsys):
     assert events, "watchdog never fired despite 0.3s iterations"
     assert any("iter" in label or "no heartbeat" in label
                for _, label in events)
+
+
+def test_watchdog_rearm_protocol_fires_once_per_episode():
+    """The single-writer re-arm protocol (tpulint shared-state-race fix):
+    the monitor fires ONCE per stall episode, and a heartbeat — the only
+    writer of the beat sequence — re-arms it for the next one."""
+    stalls = []
+    wd = StallWatchdog(timeout_s=0.15, poll_s=0.03, first_timeout_s=0.15,
+                       on_stall=lambda el, lab: stalls.append(lab))
+    with wd:
+        wd.beat("ep1")
+        time.sleep(0.5)            # one episode, several poll ticks
+        assert wd.stall_count == 1, stalls
+        wd.beat("ep2")             # re-arm
+        time.sleep(0.5)
+        assert wd.stall_count == 2, stalls
+    assert stalls == ["ep1", "ep2"]

@@ -537,3 +537,54 @@ def test_remote_center_rides_out_center_restart(tmp_path):
         client.close()
     finally:
         _revive.srv.stop()
+
+
+# -- round 15: locked HWM reads + serve-thread join ---------------------------
+
+def test_dedup_hwm_snapshot_is_a_locked_copy():
+    """hwm_snapshot is the one sanctioned cross-thread read of seq_hwm
+    (tpulint shared-state-race fix): it returns a copy — and survives a
+    writer hammering the window concurrently, where an unlocked dict()
+    over the live mapping can raise mid-iteration."""
+    win = wire.DedupWindow(depth=8)
+    tok = {"w": "c0", "seq": 1}
+    win.check(tok, "push")
+    win.record(tok, "push", {"ok": True})
+    snap = win.hwm_snapshot()
+    assert snap == {"c0": 1}
+    snap["c0"] = 999                      # mutating the copy is inert
+    assert win.hwm_snapshot() == {"c0": 1}
+
+    halt = threading.Event()
+
+    def hammer():
+        seq = 2
+        while not halt.is_set():
+            t = {"w": f"c{seq % 17}", "seq": seq}
+            win.check(t, "push")
+            win.record(t, "push", {"ok": True})
+            seq += 1
+
+    t = threading.Thread(target=hammer, daemon=True)
+    t.start()
+    try:
+        t0 = time.time()
+        while time.time() - t0 < 0.5:
+            s = win.hwm_snapshot()        # must never raise
+            assert all(isinstance(v, int) for v in s.values())
+    finally:
+        halt.set()
+        t.join(timeout=5)
+
+
+def test_center_server_stop_joins_serve_thread():
+    """stop() bounded-joins the serve thread (tpulint daemon-discipline
+    fix): a stop immediately followed by a same-port restart must not
+    race a still-unwinding serve loop."""
+    srv = CenterServer(alpha=0.5)
+    srv.start("127.0.0.1", 0)
+    t = srv._thread
+    assert t is not None and t.is_alive()
+    srv.stop()
+    assert not t.is_alive()
+    assert srv._thread is None

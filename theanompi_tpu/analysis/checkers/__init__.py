@@ -3,7 +3,9 @@ every checker with :data:`~..core.CHECKERS` (docs/design.md §12).
 
 The dataflow checkers (trace-purity, rng-discipline, donation-safety,
 collective-discipline, sharding-schema, exchange-symmetry) run on the
-whole-program engine (``analysis/engine.py``); compat-boundary and
+whole-program engine (``analysis/engine.py``); the host-concurrency pass
+(shared-state-race, lock-ordering, signal-safety, daemon-discipline)
+runs on the engine's thread-role inference; compat-boundary and
 telemetry-hot-path stay per-file (their invariants are lexical);
 schema-drift is the live-object project probe.
 """
@@ -13,9 +15,18 @@ from . import (  # noqa: F401
     compat_boundary,
     donation_safety,
     exchange_symmetry,
+    host_concurrency,
     rng_discipline,
     schema_drift,
     sharding_schema,
     telemetry_hot_path,
     trace_purity,
 )
+
+#: ``--only``/``--disable`` group aliases: ``--only concurrency`` runs
+#: just the host-concurrency pass (scripts/lint.py expands these before
+#: checker-name validation, so the cache keys on the real names).
+CHECK_GROUPS = {
+    "concurrency": ("daemon-discipline", "lock-ordering",
+                    "shared-state-race", "signal-safety"),
+}

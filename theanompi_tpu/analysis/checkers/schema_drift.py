@@ -371,6 +371,64 @@ def wire_schema_errors(wire, membership, telemetry,
     return errors
 
 
+def thread_role_coverage_errors(root: Optional[str] = None) -> List[tuple]:
+    """Round-15 probe: the host-concurrency pass is only as good as its
+    thread-role map, so every ``threading.Thread(...)``/``Timer(...)``
+    construction in the thread-heaviest runtime modules
+    (``membership.py``, ``chaos.py``) must (a) appear among
+    ``engine.spawn_sites()`` and (b) RESOLVE to its entry function — a
+    spawn whose target the engine cannot resolve silently escapes the
+    shared-state-race/daemon-discipline analysis.  Built live on a mini
+    ProgramIndex over just those files, so a new spawn idiom the
+    resolver does not understand fails the gate the day it lands."""
+    import ast as _ast
+
+    from ..core import SourceFile
+    from ..engine import ProgramIndex
+    if root is None:
+        root = os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__)))))
+    files = []
+    for rel in (MEMBERSHIP_PATH, CHAOS_PATH):
+        full = os.path.join(root, rel)
+        if os.path.exists(full):
+            try:
+                files.append(SourceFile(root, rel))
+            except SyntaxError:
+                continue           # the parse step reports it already
+    if not files:
+        return []
+    index = ProgramIndex(files)
+    sites = {}
+    for s in index.spawn_sites():
+        if s.kind in ("thread", "timer"):
+            sites[(s.path, s.line)] = s
+    errors: List[tuple] = []
+    for sf in files:
+        for node in _ast.walk(sf.tree):
+            if not isinstance(node, _ast.Call):
+                continue
+            resolved = sf.resolver.resolve(node.func)
+            if resolved not in ("threading.Thread", "threading.Timer"):
+                continue
+            site = sites.get((sf.path, node.lineno))
+            if site is None:
+                errors.append((sf.path,
+                               f"thread spawn at line {node.lineno} is "
+                               f"invisible to the thread-role map "
+                               f"(engine.spawn_sites) — the "
+                               f"host-concurrency pass cannot analyze "
+                               f"it"))
+            elif not site.entries:
+                errors.append((sf.path,
+                               f"thread spawn at line {node.lineno} "
+                               f"(target `{site.target_desc}`) does not "
+                               f"resolve to an entry function — its "
+                               f"thread role is empty and its body "
+                               f"escapes the race analysis"))
+    return errors
+
+
 def _load_by_path(relpath: str, name: str):
     """A probed module loaded by FILE path — for modules that are not
     importable in the lint CLI's jax-free process through the synthetic
@@ -443,5 +501,8 @@ class SchemaDriftChecker(Checker):
             os.path.join("theanompi_tpu", "parallel", "wire.py"),
             "_tpulint_wire")
         errors += wire_schema_errors(wire, membership, telemetry, report)
+        # round 15: the thread-role map must see and resolve every
+        # Thread/Timer spawn in the thread-heaviest runtime modules
+        errors += thread_role_coverage_errors()
         return [Finding(self.name, path, 1, 0, msg)
                 for path, msg in errors]

@@ -46,9 +46,20 @@ def _repo_root() -> str:
 
 
 def _split(value: Optional[str]) -> Optional[List[str]]:
+    """Comma-split + group-alias expansion (``--only concurrency`` →
+    the four host-concurrency checkers), deduplicated in order."""
     if value is None:
         return None
-    return [v.strip() for v in value.split(",") if v.strip()]
+    from .checkers import CHECK_GROUPS
+    out: List[str] = []
+    for v in value.split(","):
+        v = v.strip()
+        if not v:
+            continue
+        for name in CHECK_GROUPS.get(v, (v,)):
+            if name not in out:
+                out.append(name)
+    return out
 
 
 def _cached_run(root, paths, only, disable, cache_dir=None):
@@ -66,13 +77,20 @@ def _cached_run(root, paths, only, disable, cache_dir=None):
     rels = iter_py_paths(root, paths)
     lint_rels = {r.replace(os.sep, "/") for r in rels}
     if "schema-drift" in selected:
-        # the live probe's inputs must key the cache even on partial
-        # runs whose path set does not cover them — but they are NOT
-        # part of the linted set then, so no per-file entry may be
+        # EVERY file the live probes load must key the cache even on
+        # partial runs whose path set does not cover it — but they are
+        # NOT part of the linted set then, so no per-file entry may be
         # stored for them (it would read as "no findings" to a later
-        # full run)
-        from .checkers.schema_drift import RECORDER_PATH, TELEMETRY_PATH
-        for probe in (RECORDER_PATH, TELEMETRY_PATH):
+        # full run).  Omitting one (e.g. membership.py for the round-15
+        # thread-role coverage probe) would let a stale tree hit mask a
+        # drift the probe exists to catch.
+        from .checkers.schema_drift import (CHAOS_PATH, DEVPROF_PATH,
+                                            MEMBERSHIP_PATH, RECORDER_PATH,
+                                            REPORT_PATH, SENTRY_PATH,
+                                            TELEMETRY_PATH, WIRE_PATH)
+        for probe in (RECORDER_PATH, TELEMETRY_PATH, DEVPROF_PATH,
+                      SENTRY_PATH, REPORT_PATH, MEMBERSHIP_PATH,
+                      CHAOS_PATH, WIRE_PATH):
             if probe not in lint_rels and \
                     os.path.exists(os.path.join(root, probe)):
                 rels = list(rels) + [probe]
@@ -122,9 +140,11 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap.add_argument("--json", action="store_true", dest="as_json",
                     help="alias for --format json")
     ap.add_argument("--only", default=None,
-                    help="comma-separated checker names to run")
+                    help="comma-separated checker names (or the "
+                         "'concurrency' group) to run")
     ap.add_argument("--disable", default=None,
-                    help="comma-separated checker names to skip")
+                    help="comma-separated checker names (or group) "
+                         "to skip")
     ap.add_argument("--baseline", default=None,
                     help=f"baseline file (default: <root>/{BASELINE_NAME})")
     ap.add_argument("--update-baseline", action="store_true")

@@ -24,6 +24,21 @@ one-parse-per-file :class:`~.core.SourceFile` cache and exposes:
   ``lax`` collectives with which statically-known axis names), donates.
   :meth:`ProgramIndex.transitive_summary` unions a function's summary
   over everything it can reach.
+* **thread-role inference** (round 15, docs/design.md §16): every host
+  concurrency entry point in scope — ``threading.Thread(target=…)`` /
+  ``Timer``, ``run()`` overrides of ``threading.Thread`` subclasses,
+  ``signal.signal`` handlers, ``atexit`` hooks, ``socketserver``
+  request-handler classes, executor ``submit``/``add_done_callback``
+  callables — becomes a :class:`ThreadRole` whose members are the
+  functions reachable from its entry, PLUS the implicit ``main`` role
+  (everything reachable from non-entry top-level functions/methods).
+  Role closures cut the *spawn edges*: referencing ``self._producer``
+  at a ``Thread(target=self._producer)`` site hands the function to the
+  new thread, it does not call it on the spawning one — so a producer
+  body stays out of ``main`` unless something actually calls it there.
+  :meth:`ProgramIndex.role_map` is what the host-concurrency checkers
+  (shared-state-race, lock-ordering, signal-safety, daemon-discipline)
+  consume.
 
 The engine is deliberately STATIC-only (stdlib ``ast``): resolution that
 would need type inference returns the empty list rather than guessing —
@@ -144,6 +159,89 @@ class TransitiveSummary:
     issues_collective: bool = False
     donates: bool = False
     collective_names: FrozenSet[str] = frozenset()
+
+
+# ---------------------------------------------------------------------------
+# thread roles (host-concurrency pass, docs/design.md §16)
+# ---------------------------------------------------------------------------
+
+#: The implicit role every function belongs to unless it is ONLY reachable
+#: through a spawn edge (a ``Thread(target=…)`` reference, a signal
+#: handler registration, …).
+MAIN_ROLE = "main"
+
+# spawn-construct vocabulary: resolved callable -> (kind, how to find the
+# entry expression).  ``signal.signal(sig, h)``'s handler is positional 1;
+# ``atexit.register(f)``'s is positional 0; Thread/Timer take keyword
+# ``target``/``function`` (or the documented positional slot).
+_SPAWN_CTORS = {
+    "threading.Thread": ("thread", 1, ("target",)),
+    "threading.Timer": ("timer", 1, ("function",)),
+}
+_SPAWN_REGISTRARS = {
+    "signal.signal": ("signal", 1, ("handler",)),
+    "atexit.register": ("atexit", 0, ()),
+}
+# receiver methods that hand a callable to another thread
+_SPAWN_METHODS = {"submit": ("executor", 0),
+                  "add_done_callback": ("executor", 0)}
+_NON_HANDLERS = {"signal.SIG_DFL", "signal.SIG_IGN", "signal.default_int_handler"}
+
+#: Method names so common on stdlib objects (threads, locks, sockets,
+#: files, processes) that the unique-family fallback must not claim them
+#: during ROLE closure: `t.join()` on a Thread resolving to the one
+#: in-scope class that happens to define `join` would teleport that
+#: class's methods into the spawning role.  Precise resolution paths
+#: (self., ctor-typed receivers, imports) are unaffected.
+GENERIC_METHOD_NAMES = {
+    "join", "start", "stop", "run", "close", "wait", "get", "put",
+    "set", "clear", "pop", "read", "write", "flush", "send", "recv",
+    "sendall", "accept", "connect", "acquire", "release", "poll",
+    "kill", "terminate", "shutdown", "submit", "result", "cancel",
+    "items", "keys", "values", "update", "copy", "append", "add",
+    "remove", "beat",
+}
+
+#: Base classes whose subclasses' ``run`` (Thread) / ``handle``
+#: (socketserver) methods execute on their own thread.
+THREAD_BASES = ("threading.Thread", "threading.Timer")
+HANDLER_BASES = ("socketserver.BaseRequestHandler",
+                 "socketserver.StreamRequestHandler",
+                 "socketserver.DatagramRequestHandler")
+
+
+@dataclass
+class SpawnSite:
+    """One place a concurrency entry point is introduced: a
+    ``Thread``/``Timer`` construction, a handler registration, a
+    thread-subclass / request-handler class definition."""
+
+    sf: SourceFile
+    node: ast.AST                 # the Call or ClassDef
+    kind: str                     # thread|timer|signal|atexit|executor|
+    #                               thread-subclass|handler
+    target_desc: str              # source text of the entry expression
+    entries: List[FuncRecord] = field(default_factory=list)
+
+    @property
+    def path(self) -> str:
+        return self.sf.path
+
+    @property
+    def line(self) -> int:
+        return getattr(self.node, "lineno", 1)
+
+
+@dataclass
+class ThreadRole:
+    """One inferred thread role: a name, its kind, every spawn site that
+    introduces it, and its entry records (members come from
+    :meth:`ProgramIndex.role_members`)."""
+
+    name: str
+    kind: str
+    sites: List[SpawnSite] = field(default_factory=list)
+    entries: List[FuncRecord] = field(default_factory=list)
 
 
 # ---------------------------------------------------------------------------
@@ -425,9 +523,12 @@ class ProgramIndex:
 
     def resolve_call(self, sf: SourceFile, func_expr: ast.AST,
                      enclosing: Optional[ast.AST],
-                     ctor_types: Optional[Dict[str, Tuple[str, str]]] = None
+                     ctor_types: Optional[Dict[str, Tuple[str, str]]] = None,
+                     skip_generic_unique: bool = False
                      ) -> List[FuncRecord]:
-        """Possible targets of a call through ``func_expr``, or []."""
+        """Possible targets of a call through ``func_expr``, or [].
+        ``skip_generic_unique`` (role closures) withholds the
+        unique-family fallback for :data:`GENERIC_METHOD_NAMES`."""
         idx = self.file_index[sf.path]
         if isinstance(func_expr, ast.Name):
             local = idx.lookup(func_expr.id, enclosing)
@@ -468,7 +569,28 @@ class ProgramIndex:
                     base.id in ctor_types:
                 return self.method_records(ctor_types[base.id],
                                            func_expr.attr)
+            # self.<attr>.<m>() where the attr was assigned from a
+            # visible constructor anywhere in the enclosing class
+            if isinstance(base, ast.Attribute) and \
+                    isinstance(base.value, ast.Name) and \
+                    base.value.id in ("self", "cls"):
+                cls = None
+                f = enclosing
+                while f is not None:
+                    cls = idx.class_of.get(id(f))
+                    if cls is not None:
+                        break
+                    f = idx.parent_func.get(id(f))
+                if cls is not None:
+                    ctor = self.class_attr_ctors(
+                        (sf.resolver.module, cls.name)).get(base.attr)
+                    key = self._class_keys.get(ctor or "")
+                    if key is not None:
+                        return self.method_records(key, func_expr.attr)
             # unique-family method name (the exchange_body rule)
+            if skip_generic_unique and \
+                    func_expr.attr in GENERIC_METHOD_NAMES:
+                return []
             return self._unique_family(func_expr.attr)
         return []
 
@@ -626,6 +748,313 @@ class ProgramIndex:
         t.collective_names = frozenset(names)
         self._transitive_cache[id(rec.node)] = t
         return t
+
+    # -- thread roles (host-concurrency pass) -------------------------------
+
+    def resolve_callable(self, sf: SourceFile, expr: ast.AST,
+                         enclosing: Optional[ast.AST],
+                         ctor_types=None,
+                         _seen_names: Optional[Set[str]] = None
+                         ) -> List[FuncRecord]:
+        """Targets of a callable-valued expression — :meth:`resolve_call`
+        plus the spawn-site idioms: an inline ``lambda``, and a local
+        Name bound from an assignment or a ``for``-loop over a literal
+        tuple of method references (the ChaosProxy pump-pair shape).
+        ``_seen_names`` guards cyclic local rebinds (``fn = fn``,
+        ``a = b; b = a``) — a cycle degrades to unresolved instead of
+        recursing unboundedly."""
+        if isinstance(expr, ast.Lambda):
+            rec = self.records.get(id(expr))
+            return [rec] if rec is not None else []
+        out = self.resolve_call(sf, expr, enclosing, ctor_types)
+        if out or not isinstance(expr, ast.Name) or enclosing is None:
+            return out
+        seen_names = set(_seen_names or ())
+        if expr.id in seen_names:
+            return []
+        seen_names.add(expr.id)
+        found: List[FuncRecord] = []
+        for sub in body_walk(enclosing):
+            exprs: List[ast.AST] = []
+            if isinstance(sub, ast.Assign) and any(
+                    isinstance(t, ast.Name) and t.id == expr.id
+                    for t in sub.targets):
+                exprs = [sub.value]
+            elif isinstance(sub, ast.For) and \
+                    isinstance(sub.target, ast.Name) and \
+                    sub.target.id == expr.id and \
+                    isinstance(sub.iter, (ast.Tuple, ast.List)):
+                exprs = list(sub.iter.elts)
+            for e in exprs:
+                if isinstance(e, (ast.Tuple, ast.List)):
+                    exprs.extend(e.elts)
+                    continue
+                found.extend(self.resolve_callable(sf, e, enclosing,
+                                                   ctor_types,
+                                                   _seen_names=seen_names))
+        seen: Set[int] = set()
+        return [r for r in found
+                if id(r.node) not in seen and not seen.add(id(r.node))]
+
+    def _spawn_entry_expr(self, call: ast.Call, pos: int,
+                          kwnames) -> Optional[ast.AST]:
+        expr = call.args[pos] if len(call.args) > pos else None
+        for kw in call.keywords:
+            if kw.arg in kwnames:
+                expr = kw.value
+        return expr
+
+    def is_thread_subclass(self, class_key: Tuple[str, str]) -> bool:
+        return self._inherits(class_key, THREAD_BASES)
+
+    def _inherits(self, class_key, dotted_bases) -> bool:
+        seen = set()
+        frontier = [class_key]
+        while frontier:
+            k = frontier.pop()
+            if k in seen:
+                continue
+            seen.add(k)
+            for b in self.class_bases.get(k, []):
+                if b in dotted_bases:
+                    return True
+                bk = self._class_keys.get(b)
+                if bk is not None:
+                    frontier.append(bk)
+        return False
+
+    def spawn_sites(self) -> List[SpawnSite]:
+        """Every concurrency entry point in scope (cached)."""
+        if getattr(self, "_spawn_sites", None) is not None:
+            return self._spawn_sites
+        sites: List[SpawnSite] = []
+        arg_ids: Set[int] = set()     # entry-expr node ids (spawn edges)
+        for sf in self.files:
+            idx = self.file_index[sf.path]
+            for node in ast.walk(sf.tree):
+                if isinstance(node, ast.ClassDef):
+                    key = (sf.resolver.module, node.name)
+                    if self.is_thread_subclass(key):
+                        recs = self.method_records(key, "run",
+                                                   include_subclasses=False)
+                        recs = [r for r in recs if r.class_key == key]
+                        if recs:
+                            sites.append(SpawnSite(
+                                sf, node, "thread-subclass",
+                                f"{node.name}.run", recs))
+                    elif self._inherits(key, HANDLER_BASES):
+                        recs = [r for n in ("handle", "setup", "finish")
+                                for r in self.method_records(
+                                    key, n, include_subclasses=False)
+                                if r.class_key == key]
+                        if recs:
+                            sites.append(SpawnSite(
+                                sf, node, "handler",
+                                f"{node.name}.handle", recs))
+                    continue
+                if not isinstance(node, ast.Call):
+                    continue
+                resolved = sf.resolver.resolve(node.func)
+                kind = pos = kwnames = None
+                if resolved in _SPAWN_CTORS:
+                    kind, pos, kwnames = _SPAWN_CTORS[resolved]
+                elif resolved in _SPAWN_REGISTRARS:
+                    kind, pos, kwnames = _SPAWN_REGISTRARS[resolved]
+                elif isinstance(node.func, ast.Attribute) and \
+                        node.func.attr in _SPAWN_METHODS:
+                    kind, pos = _SPAWN_METHODS[node.func.attr]
+                    kwnames = ("fn",)
+                if kind is None:
+                    continue
+                expr = self._spawn_entry_expr(node, pos, kwnames)
+                if expr is None:
+                    continue
+                hresolved = sf.resolver.resolve(expr)
+                if kind == "signal" and hresolved in _NON_HANDLERS:
+                    continue
+                enc = idx.enclosing.get(id(expr))
+                enc_rec = self.records.get(id(enc)) if enc is not None \
+                    else None
+                ctor_types = self._local_ctor_types(enc_rec) \
+                    if enc_rec is not None else None
+                entries = self.resolve_callable(sf, expr, enc, ctor_types)
+                desc = ImportResolver.dotted(expr) or \
+                    ("<lambda>" if isinstance(expr, ast.Lambda)
+                     else ast.dump(expr)[:40])
+                sites.append(SpawnSite(sf, node, kind, desc, entries))
+                arg_ids.add(id(expr))
+        self._spawn_arg_ids = arg_ids
+        self._spawn_sites = sites
+        return sites
+
+    def _role_callees(self, rec: FuncRecord) -> List[FuncRecord]:
+        """:meth:`callees` with the SPAWN EDGES cut: a callable handed to
+        ``Thread(target=…)``/``signal.signal``/``submit`` runs on the new
+        thread, not the spawning one, so it is not a same-role callee."""
+        cache = getattr(self, "_role_callees_cache", None)
+        if cache is None:
+            cache = self._role_callees_cache = {}
+        cached = cache.get(id(rec.node))
+        if cached is not None:
+            return cached
+        self.spawn_sites()            # ensures _spawn_arg_ids
+        skip = self._spawn_arg_ids
+        idx = self.file_index[rec.sf.path]
+        ctor_types = self._local_ctor_types(rec)
+        out: List[FuncRecord] = []
+        seen: Set[int] = set()
+
+        def add(targets) -> None:
+            for t in targets:
+                if id(t.node) not in seen and t.node is not rec.node:
+                    seen.add(id(t.node))
+                    out.append(t)
+
+        for sub in body_walk(rec.node):
+            if isinstance(sub, ast.Call):
+                enc = idx.enclosing.get(id(sub.func), rec.node)
+                add(self.resolve_call(rec.sf, sub.func, enc, ctor_types,
+                                      skip_generic_unique=True))
+                for arg in list(sub.args) + [kw.value for kw in
+                                             sub.keywords]:
+                    if id(arg) in skip:
+                        continue
+                    if isinstance(arg, (ast.Name, ast.Attribute)):
+                        enc = idx.enclosing.get(id(arg), rec.node)
+                        add(self.resolve_call(rec.sf, arg, enc,
+                                              ctor_types,
+                                              skip_generic_unique=True))
+        cache[id(rec.node)] = out
+        return out
+
+    def _role_closure(self, seeds: Iterable[FuncRecord]) -> List[FuncRecord]:
+        out: List[FuncRecord] = []
+        seen: Set[int] = set()
+        frontier = list(seeds)
+        while frontier:
+            rec = frontier.pop()
+            if id(rec.node) in seen:
+                continue
+            seen.add(id(rec.node))
+            out.append(rec)
+            frontier.extend(self._role_callees(rec))
+        return out
+
+    def thread_roles(self) -> List[ThreadRole]:
+        """Concurrent roles (one per distinct entry set), cached.  Role
+        names are ``<kind>:<entry qualname>`` — stable across runs."""
+        if getattr(self, "_thread_roles", None) is not None:
+            return self._thread_roles
+        by_name: Dict[str, ThreadRole] = {}
+        for site in self.spawn_sites():
+            if site.entries:
+                name = f"{site.kind}:{site.entries[0].qualname}"
+            else:
+                name = f"{site.kind}:{site.sf.path}:{site.line}"
+            role = by_name.get(name)
+            if role is None:
+                role = by_name[name] = ThreadRole(name, site.kind)
+            role.sites.append(site)
+            known = {id(e.node) for e in role.entries}
+            role.entries.extend(e for e in site.entries
+                                if id(e.node) not in known)
+        self._thread_roles = [by_name[n] for n in sorted(by_name)]
+        return self._thread_roles
+
+    def role_members(self, role: ThreadRole) -> List[FuncRecord]:
+        return self._role_closure(role.entries)
+
+    def role_map(self) -> Dict[int, Set[str]]:
+        """func-node id -> the set of role names the function can run
+        under.
+
+        The ``main`` role's seeds are the records with NO incoming
+        call-graph reference (the public surface: CLI mains, class
+        methods called through duck-typed receivers, constructors) minus
+        the concurrent entries; its members are their closure.  A helper
+        referenced ONLY by a thread entry's closure therefore stays out
+        of ``main`` — attributing it to the spawning thread too would
+        make every thread-private helper read as cross-thread.  The
+        approximation is deliberately biased toward fewer false
+        conflicts: an unresolvable duck-typed call from main into a
+        role-private helper is missed, never invented."""
+        cached = getattr(self, "_role_map", None)
+        if cached is not None:
+            return cached
+        roles = self.thread_roles()
+        out: Dict[int, Set[str]] = {}
+        entry_ids: Set[int] = set()
+        for role in roles:
+            for rec in self.role_members(role):
+                out.setdefault(id(rec.node), set()).add(role.name)
+            entry_ids.update(id(e.node) for e in role.entries)
+        referenced: Set[int] = set()
+        for rec in self.records.values():
+            for callee in self._role_callees(rec):
+                referenced.add(id(callee.node))
+        main_seeds = []
+        for rec in self.records.values():
+            if isinstance(rec.node, ast.Lambda):
+                continue
+            if id(rec.node) in entry_ids or id(rec.node) in referenced:
+                continue
+            fidx = self.file_index[rec.sf.path]
+            if fidx.parent_func.get(id(rec.node)) is not None:
+                continue              # nested defs: reachable via parent
+            main_seeds.append(rec)
+        for rec in self._role_closure(main_seeds):
+            out.setdefault(id(rec.node), set()).add(MAIN_ROLE)
+        for rec in self.records.values():
+            out.setdefault(id(rec.node), {MAIN_ROLE})
+        self._role_map = out
+        return out
+
+    def roles_of(self, rec: FuncRecord) -> Set[str]:
+        return self.role_map().get(id(rec.node), {MAIN_ROLE})
+
+    # -- class attribute construction map (lock/sync-object identity) ------
+
+    def class_attr_ctors(self, class_key: Tuple[str, str]) -> Dict[str, str]:
+        """``self.X = <Call>`` assignments anywhere in the class (its own
+        methods): attr -> the resolved constructor's dotted path (or the
+        in-scope class path).  The concurrency checkers use it to know a
+        ``_lock`` is a ``threading.Lock`` vs ``RLock``, a ``_q`` is a
+        ``queue.Queue``, and which class ``self.center`` is."""
+        cache = getattr(self, "_attr_ctor_cache", None)
+        if cache is None:
+            cache = self._attr_ctor_cache = {}
+        cached = cache.get(class_key)
+        if cached is not None:
+            return cached
+        out: Dict[str, str] = {}
+        module, _cls_name = class_key
+        for name, recs in self.methods.items():
+            for rec in recs:
+                if rec.class_key != class_key:
+                    continue
+                for sub in body_walk(rec.node):
+                    if not isinstance(sub, (ast.Assign, ast.AnnAssign)):
+                        continue
+                    value = sub.value
+                    if not isinstance(value, ast.Call):
+                        continue
+                    resolved = rec.sf.resolver.resolve(value.func)
+                    if resolved is None and \
+                            isinstance(value.func, ast.Name):
+                        fidx = self.file_index[rec.sf.path]
+                        if value.func.id in fidx.classes:
+                            resolved = f"{module}.{value.func.id}"
+                    if resolved is None:
+                        continue
+                    targets = sub.targets if isinstance(sub, ast.Assign) \
+                        else [sub.target]
+                    for t in targets:
+                        if isinstance(t, ast.Attribute) and \
+                                isinstance(t.value, ast.Name) and \
+                                t.value.id == "self":
+                            out.setdefault(t.attr, resolved)
+        cache[class_key] = out
+        return out
 
 
 # ---------------------------------------------------------------------------

@@ -131,12 +131,13 @@ class CenterServer:
     # -- crash-recovery snapshots -------------------------------------------
 
     def _state_mark(self) -> tuple:
-        """Cheap change detector — snapshot only when the state moved."""
+        """Cheap change detector — snapshot only when the state moved.
+        The dedup HWMs are read through the locked accessor: handler
+        threads mutate them concurrently with this snapshot-loop read."""
         st = self.center.stats_snapshot()
         return (st["n_updates"], tuple(st["demoted"]),
                 sum(st["dropped_by_island"].values()),
-                sum(self.dedup.seq_hwm.values()) if self.dedup.seq_hwm
-                else 0)
+                sum(self.dedup.hwm_snapshot().values()))
 
     def snapshot(self) -> Optional[str]:
         """One crash-atomic snapshot file (single npz: leaves + a JSON
@@ -348,11 +349,13 @@ class CenterServer:
                         center.readmit_island(int(header["island"]))
                         wire.send_msg(self.request, {"ok": True})
                     elif op == "stats":
+                        # hwm_snapshot: another handler thread may be
+                        # mid-record — a bare dict(dedup.seq_hwm) races
                         wire.send_msg(
                             self.request,
                             {"ok": True, **center.stats_snapshot(),
                              "dedup_hits": dedup.hits,
-                             "seq_hwm": dict(dedup.seq_hwm)})
+                             "seq_hwm": dedup.hwm_snapshot()})
                     else:
                         wire.send_msg(self.request,
                                       {"ok": False,
@@ -399,6 +402,14 @@ class CenterServer:
                     c.close()
                 except OSError:
                     pass
+        # bounded join of the serve thread: shutdown() returns once the
+        # serve_forever loop EXITS, but the thread can still be unwinding
+        # — a stop() immediately followed by a same-port restart (the
+        # supervised-respawn tests) must not race it (tpulint
+        # daemon-discipline)
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+            self._thread = None
 
 
 # -- client -----------------------------------------------------------------

@@ -1153,7 +1153,7 @@ def run_elastic(rule: str, modelfile: str, modelclass: str,
                 leaves = srv.center.pull_leaves()
                 stats = {"ok": True, **srv.center.stats_snapshot(),
                          "dedup_hits": srv.dedup.hits,
-                         "seq_hwm": dict(srv.dedup.seq_hwm)}
+                         "seq_hwm": srv.dedup.hwm_snapshot()}
             if record_dir and leaves is not None:
                 with open(os.path.join(record_dir, "center_final.npz"),
                           "wb") as f:

@@ -374,6 +374,16 @@ class DedupWindow:
                     and (op, seq) in window:
                 del window[(op, seq)]
 
+    def hwm_snapshot(self) -> Dict[str, int]:
+        """Locked copy of the per-client seq high-water marks — the ONE
+        way other threads may read them.  ``seq_hwm`` mutates under
+        ``_lock`` on handler threads; an unlocked ``dict()``/``sum()``
+        over the live dict (the center's snapshot loop, the ``stats``
+        op) can throw ``dictionary changed size during iteration``
+        mid-flight (tpulint shared-state-race)."""
+        with self._lock:
+            return dict(self.seq_hwm)
+
     # -- snapshot plumbing (center crash recovery) --------------------------
 
     def snapshot(self) -> dict:

@@ -482,6 +482,14 @@ class ChaosProxy:
     def stop(self) -> None:
         self._halt.set()
         if self._lsock is not None:
+            # shutdown BEFORE close: on Linux a bare close() of a
+            # listening socket does not reliably wake a thread blocked
+            # in accept() — shutdown makes it raise immediately, which
+            # the bounded join below depends on
+            try:
+                self._lsock.shutdown(self._socket.SHUT_RDWR)
+            except OSError:
+                pass
             try:
                 self._lsock.close()
             except OSError:
@@ -490,6 +498,14 @@ class ChaosProxy:
             conns = list(self._conns)
         for st in conns:
             self._close_pair(st)
+        # bounded join of the accept/monitor threads: the closed listen
+        # socket unblocks accept() and the halt event ends the monitor
+        # within poll_s, but without a join they can outlive stop() into
+        # the caller's teardown (audit reads, a same-port proxy restart)
+        # — tpulint daemon-discipline
+        for t in self._threads:
+            t.join(timeout=5)
+        self._threads = []
 
 
 def find_child_pid(parent_pid: int, needle: str,

@@ -51,7 +51,13 @@ class StallWatchdog:
         self._last_beat = time.monotonic()
         self._last_label = "(no heartbeat yet)"
         self._beaten = False
-        self._fired = False
+        # single-writer re-arm protocol (tpulint shared-state-race): the
+        # hot loop bumps `_beat_seq` (ONLY beat writes it), the monitor
+        # remembers which beat it fired for in ITS local state — no
+        # attribute is written from two threads, so there is no window
+        # where a beat landing between the monitor's check and set could
+        # be lost or double-fire a stall
+        self._beat_seq = 0
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self.stall_count = 0
@@ -63,7 +69,7 @@ class StallWatchdog:
         if label:
             self._last_label = label
         self._beaten = True
-        self._fired = False          # re-arm after recovery
+        self._beat_seq += 1          # re-arms the monitor (sole writer)
         # heartbeats feed the telemetry flight ring (ring-only: the stream
         # would drown in them) — the dump then shows exactly what the rank
         # was doing in the window before a stall/crash
@@ -95,12 +101,14 @@ class StallWatchdog:
     # -- monitor ------------------------------------------------------------
 
     def _monitor(self) -> None:
+        fired_for = -1               # monitor-local: last beat seq fired on
         while not self._stop.wait(self.poll_s):
+            seq = self._beat_seq
             elapsed = time.monotonic() - self._last_beat
             threshold = self.timeout_s if self._beaten else self.first_timeout_s
-            if elapsed > threshold and not self._fired:
-                self._fired = True
-                self.stall_count += 1
+            if elapsed > threshold and seq != fired_for:
+                fired_for = seq      # one fire per stall episode; a new
+                self.stall_count += 1     # beat advances seq and re-arms
                 try:
                     self.on_stall(elapsed, self._last_label)
                 except Exception as e:     # a broken handler must not kill
