@@ -950,6 +950,10 @@ def main() -> int:
             h.observe(v)
         out["p50_step_secs"] = round(h.percentile(50), 5)
         out["p95_step_secs"] = round(h.percentile(95), 5)
+        # the EXACT streaming extreme (tracked outside the reservoir):
+        # the single worst iteration — the sample an SLO cares about,
+        # which a thinned reservoir's percentile can drop
+        out["max_step_secs"] = round(h.max, 5)
     try:
         # NOTE: a process-wide monotone peak — on the rare spc-fallback
         # retry it includes the failed first attempt's high-water mark

@@ -1,0 +1,132 @@
+#!/usr/bin/env python
+"""fleetz — one table for every live process in a run directory.
+
+Each long-lived process of an elastic run (worker CLIs, the center
+server, the supervisor) serves a tiny ``statusz`` socket
+(``theanompi_tpu/utils/tracing.py``, docs/design.md §17) and registers
+it under ``<record_dir>/statusz/``.  This script dials every registered
+endpoint and prints the fleet's live state — role, pid, uptime, current
+iteration, current span, spans emitted, last event — marking
+unreachable endpoints DOWN (a crashed process leaves its discovery file
+behind; a cleanly-exited one removes it).
+
+Usage:
+    python scripts/fleetz.py <record_dir> [--json] [--events N]
+
+``--events N`` additionally tails the last N flight-ring events of every
+reachable process (the cross-process "what is everyone doing right now"
+that used to need N terminals).
+
+Runs jax-free: the package parent is bootstrapped synthetically (the
+``scripts/lint.py`` pattern) so ``utils/tracing.py`` loads without
+executing the jax-importing package ``__init__``.
+"""
+
+import argparse
+import json
+import os
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# the ONE synthetic-package bootstrap lives in scripts/lint.py — reuse
+# it so a change to the jax-free loading scheme cannot drift between
+# the two jax-free CLIs
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from lint import _bootstrap_package  # noqa: E402
+
+_bootstrap_package()
+from theanompi_tpu.utils import tracing  # noqa: E402
+
+
+def probe(doc, timeout_s=2.0):
+    """One roster entry → its live health reply (or a DOWN row)."""
+    addr = f"{doc.get('host', '127.0.0.1')}:{doc.get('port')}"
+    try:
+        rep = tracing.statusz_query(addr, "health", timeout_s=timeout_s)
+    except Exception as e:
+        return {"ok": False, "role": doc.get("role"), "id": doc.get("id"),
+                "pid": doc.get("pid"), "addr": addr, "down": True,
+                "error": repr(e)[:80]}
+    rep.setdefault("role", doc.get("role"))
+    rep.setdefault("id", doc.get("id"))
+    rep["addr"] = addr
+    return rep
+
+
+def fleet_table(record_dir, timeout_s=2.0):
+    return [probe(doc, timeout_s)
+            for doc in tracing.read_statusz_docs(record_dir)]
+
+
+def print_table(rows):
+    cols = ("role", "id", "pid", "state", "uptime", "iter", "spans",
+            "current span", "last event")
+    table = []
+    for r in rows:
+        cur = r.get("current_span") or {}
+        last = r.get("last_event") or {}
+        table.append((
+            str(r.get("role", "?")), str(r.get("id", "?")),
+            str(r.get("pid", "?")),
+            "DOWN" if r.get("down") else "up",
+            f"{r.get('uptime_s', 0):.0f}s" if not r.get("down") else "-",
+            str(r.get("iter", r.get("steps", "-"))),
+            str(r.get("spans", "-")),
+            cur.get("name", "-") if cur else "-",
+            last.get("ev", "-") if last else "-"))
+    widths = [max(len(c), *(len(row[i]) for row in table)) if table
+              else len(c) for i, c in enumerate(cols)]
+    print("  ".join(c.ljust(w) for c, w in zip(cols, widths)))
+    for row in table:
+        print("  ".join(v.ljust(w) for v, w in zip(row, widths)))
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("record_dir")
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable output (one JSON doc)")
+    ap.add_argument("--events", type=int, default=0, metavar="N",
+                    help="also tail each live process's last N "
+                         "flight-ring events")
+    ap.add_argument("--timeout", type=float, default=2.0)
+    args = ap.parse_args(argv)
+    docs = tracing.read_statusz_docs(args.record_dir)
+    if not docs:
+        print(f"no statusz endpoints registered under "
+              f"{tracing.statusz_dir(args.record_dir)} — is a run with "
+              f"record_dir set (and statusz not disabled) live?",
+              file=sys.stderr)
+        return 1
+    rows = [probe(doc, args.timeout) for doc in docs]
+    if args.json:
+        print(json.dumps({"fleet": rows}, default=str))
+    else:
+        print_table(rows)
+    if args.events:
+        for r in rows:
+            if r.get("down"):
+                continue
+            try:
+                rep = tracing.statusz_query(r["addr"], "events",
+                                            n=args.events,
+                                            timeout_s=args.timeout)
+            except Exception:
+                continue
+            print(f"\n{r.get('role')} {r.get('id')} — last "
+                  f"{args.events} events:")
+            for ev in rep.get("events", []):
+                detail = {k: v for k, v in ev.items()
+                          if k not in ("ts", "run", "rank", "ev")}
+                print(f"  ts={ev.get('ts')} {ev.get('ev')} {detail}")
+    # any DOWN row is worth a nonzero exit: a dead process left its
+    # discovery file behind (clean exits deregister)
+    return 0 if all(not r.get("down") for r in rows) else 2
+
+
+if __name__ == "__main__":
+    try:
+        raise SystemExit(main())
+    except BrokenPipeError:
+        os._exit(0)

@@ -33,8 +33,23 @@ and emits the cross-worker run report the bucket sums can't answer:
   ``fault_injected`` audits — open directly in Perfetto (ui.perfetto.dev)
   or ``chrome://tracing`` for the cross-rank straggler/churn timeline.
 
+* **distributed traces** (round 16, docs/design.md §17) — ``span``
+  events from the causal-tracing layer (``utils/tracing.py``) are joined
+  ACROSS rank files by span id: each exchange round's client span, its
+  ``wire.<op>`` children, and the center's ``center.<op>`` handler spans
+  become one per-round trace with a critical path (compute | stage |
+  wire | queue | apply), a join rate, and dedup-twin accounting; the
+  per-worker straggler ROOT-CAUSE table (which component dominated) is
+  what ``membership.check_stragglers`` cites in its demote events, and
+  the Perfetto export draws flow arrows from each client wire span to
+  the server span it caused;
+* **``--since TS`` / ``--last SEC``** — time-window the load (cheap
+  ``ts``-prefix line skip, no full parse) so long elastic/chaos runs can
+  be reported incrementally.
+
 Usage:
     python scripts/telemetry_report.py <record_dir> [--window SEC]
+                                       [--since TS | --last SEC]
                                        [--json out.json] [--trace out.json]
 
 Stdlib only — runnable on a machine with no jax installed.
@@ -57,7 +72,8 @@ TRACKED_EVENTS = ("phase", "train_record", "val_record", "gauges",
                   "device_profile", "anomaly", "crash", "stall",
                   "fatal_signal", "worker_join", "worker_leave",
                   "worker_demote", "fault_injected",
-                  "center_down", "center_restored", "wire")
+                  "center_down", "center_restored", "wire",
+                  "span", "statusz")
 
 # gauges-event keys drawn as Perfetto counter tracks (plus
 # images_per_sec from train_record events); heartbeat.iter is the
@@ -70,7 +86,12 @@ TRACE_COUNTER_KEYS = ("hbm_bytes_in_use", "prefetch.queue_depth",
 INSTANT_EVENTS = ("anomaly", "crash", "stall", "fatal_signal",
                   "worker_join", "worker_leave", "worker_demote",
                   "fault_injected", "center_down", "center_restored",
-                  "wire")
+                  "wire", "statusz")
+
+# The critical-path component vocabulary (mirrors utils/tracing.py
+# COMPONENTS — schema-drift-probed): every second of a traced exchange
+# round is charged to exactly one of these.
+TRACE_COMPONENTS = ("compute", "stage", "wire", "queue", "apply")
 
 
 def percentile(values, q):
@@ -84,8 +105,31 @@ def percentile(values, q):
     return s[idx]
 
 
-def load_events(record_dir):
-    """All events from every per-rank stream, sorted by timestamp."""
+def _line_ts(line):
+    """The ``ts`` of one JSONL line WITHOUT a full json parse — telemetry
+    serializes ``ts`` first (dict insertion order), so a prefix scan is
+    enough.  None when the line doesn't open with the ts key (then the
+    caller falls back to a real parse)."""
+    if not line.startswith('{"ts":'):
+        return None
+    end = line.find(",", 6)
+    if end < 0:
+        end = line.find("}", 6)
+    if end < 0:
+        return None
+    try:
+        return float(line[6:end].strip())
+    except ValueError:
+        return None
+
+
+def load_events(record_dir, since=None, until=None):
+    """All events from every per-rank stream, sorted by timestamp.
+
+    ``since``/``until`` (epoch seconds) window the load for long
+    elastic/chaos runs: out-of-window lines are skipped on a cheap
+    ``ts``-prefix scan, never fully json-parsed — incremental reporting
+    without paying for the whole stream."""
     events = []
     for path in sorted(glob.glob(
             os.path.join(record_dir, "telemetry_rank*.jsonl"))):
@@ -94,14 +138,52 @@ def load_events(record_dir):
                 line = line.strip()
                 if not line:
                     continue
+                if since is not None or until is not None:
+                    ts = _line_ts(line)
+                    if ts is not None and (
+                            (since is not None and ts < since) or
+                            (until is not None and ts > until)):
+                        continue
                 try:
                     ev = json.loads(line)
                 except ValueError:
                     continue          # a crash can truncate the last line
-                if isinstance(ev, dict) and "ev" in ev:
-                    events.append(ev)
+                if not (isinstance(ev, dict) and "ev" in ev):
+                    continue
+                if since is not None and ev.get("ts", 0) < since:
+                    continue          # fallback for ts-not-first lines
+                if until is not None and ev.get("ts", 0) > until:
+                    continue
+                events.append(ev)
     events.sort(key=lambda e: e.get("ts", 0))
     return events
+
+
+def stream_extent(record_dir):
+    """``(first_ts, last_ts)`` across the per-rank streams, read from each
+    file's head and tail only (no full parse) — what ``--last N`` anchors
+    its window against.  ``(None, None)`` when nothing is parseable."""
+    lo = hi = None
+    for path in sorted(glob.glob(
+            os.path.join(record_dir, "telemetry_rank*.jsonl"))):
+        try:
+            with open(path, "rb") as f:
+                head = f.readline().decode("utf-8", "replace").strip()
+                ts = _line_ts(head)
+                if ts is not None:
+                    lo = ts if lo is None else min(lo, ts)
+                f.seek(0, os.SEEK_END)
+                size = f.tell()
+                f.seek(max(0, size - 65536))
+                tail = f.read().decode("utf-8", "replace").splitlines()
+            for line in reversed(tail):
+                ts = _line_ts(line.strip())
+                if ts is not None:
+                    hi = ts if hi is None else max(hi, ts)
+                    break
+        except OSError:
+            continue
+    return lo, hi
 
 
 def load_summaries(record_dir):
@@ -134,7 +216,10 @@ def phase_breakdown(events):
         out[sec] = {"count": len(vals), "total": round(sum(vals), 4),
                     "mean": round(sum(vals) / len(vals), 6),
                     "p50": percentile(vals, 50), "p95": percentile(vals, 95),
-                    "p99": percentile(vals, 99)}
+                    "p99": percentile(vals, 99),
+                    # exact extreme over the (windowed) stream — the one
+                    # sample a reservoir can drop and an SLO cares about
+                    "max": round(max(vals), 6)}
     return out
 
 
@@ -182,6 +267,144 @@ def straggler_ranking(events, window_s):
     ranking.sort(key=lambda r: (-r["windows_straggled"],
                                 -(r["mean_train_secs"] or 0)))
     return ranking
+
+
+def assemble_traces(events):
+    """Join client and server ``span`` events across rank streams into
+    per-round distributed traces (docs/design.md §17).
+
+    A round is a client span named ``round`` (async islands) or
+    ``exchange`` (sync SPMD dispatch); its ``wire.<op>`` child spans were
+    emitted by the wire client, and the server's ``center.<op>`` spans
+    join by parent span id — a chaos-duplicated or retried request may
+    produce several server spans for one client span, of which exactly
+    one is APPLIED (the ``dedup``-tagged twins are counted but never
+    charged to the critical path).
+
+    Per-round critical path: every second of the round is charged to one
+    component — ``queue``/``apply`` from the server's reply-header time
+    split, ``wire`` is each op's remaining transit time (dt − q − a,
+    retries included: that IS wire time), ``stage`` from the round's own
+    ``stage_s`` field when the worker measured one, and ``compute`` is
+    the residual (local steps, data wait, elastic update math).  The
+    components therefore sum to the observed round time by construction
+    — the 5% acceptance tolerance covers clock skew between the two
+    processes' q/a stamps, not bookkeeping slack."""
+    rounds = []
+    wires = defaultdict(list)
+    servers = defaultdict(list)
+    for ev in events:
+        if ev.get("ev") != "span":
+            continue
+        if ev.get("side") == "server":
+            servers[ev.get("parent")].append(ev)
+        elif str(ev.get("name", "")).startswith("wire."):
+            wires[ev.get("trace")].append(ev)
+        elif ev.get("name") in ("round", "exchange"):
+            rounds.append(ev)
+    out = []
+    for r in rounds:
+        tid = r.get("trace")
+        total = float(r.get("dt", 0.0))
+        wire_s = queue_s = apply_s = 0.0
+        wire_ops = joined = unjoined = dedup_twins = 0
+        for w in wires.get(tid, ()):
+            q = float(w.get("q") or 0.0)
+            a = float(w.get("a") or 0.0)
+            dt = float(w.get("dt", 0.0))
+            queue_s += q
+            apply_s += a
+            wire_s += max(0.0, dt - q - a)
+            wire_ops += 1
+            srvs = servers.get(w.get("span"), ())
+            if any(not s.get("dedup") for s in srvs):
+                joined += 1
+            else:
+                unjoined += 1
+            dedup_twins += sum(1 for s in srvs if s.get("dedup"))
+        stage = float(r.get("stage_s") or 0.0)
+        compute = max(0.0, total - wire_s - queue_s - apply_s - stage)
+        components = {"compute": round(compute, 6),
+                      "stage": round(stage, 6),
+                      "wire": round(wire_s, 6),
+                      "queue": round(queue_s, 6),
+                      "apply": round(apply_s, 6)}
+        dominant = max(components, key=components.get)
+        out.append({"trace": tid, "rank": int(r.get("rank", 0)),
+                    "island": r.get("island"), "name": r.get("name"),
+                    "t0": r.get("t0", r.get("ts")), "dt": round(total, 6),
+                    "components": components, "dominant": dominant,
+                    "wire_ops": wire_ops, "joined": joined,
+                    "unjoined": unjoined, "dedup_twins": dedup_twins,
+                    "outcome": r.get("outcome")})
+    out.sort(key=lambda t: t.get("t0") or 0.0)
+    return out
+
+
+def straggler_root_cause(events, window_s, traces=None):
+    """Per-worker root-cause table from the assembled traces: WHICH
+    critical-path component dominated each worker's rounds, per
+    ``window_s`` wall-clock window — the demote-event citation
+    ``membership.MembershipController.check_stragglers`` attaches, so a
+    straggler demotion names its cause (slow compute vs a slow wire vs a
+    queued-up center), not just its symptom."""
+    traces = assemble_traces(events) if traces is None else traces
+    if not traces:
+        return {}
+    t_origin = min(t.get("t0") or 0.0 for t in traces)
+    win = defaultdict(lambda: defaultdict(lambda: defaultdict(float)))
+    totals = defaultdict(lambda: defaultdict(float))
+    counts = defaultdict(int)
+    dt_sum = defaultdict(float)
+    for t in traces:
+        rank = t["rank"]
+        w = int(((t.get("t0") or 0.0) - t_origin) / window_s)
+        for comp, secs in t["components"].items():
+            win[rank][w][comp] += secs
+            totals[rank][comp] += secs
+        counts[rank] += 1
+        dt_sum[rank] += t["dt"]
+    out = {}
+    for rank in sorted(counts):
+        dom_windows = defaultdict(int)
+        for comps in win[rank].values():
+            dom_windows[max(comps, key=comps.get)] += 1
+        tot = totals[rank]
+        overall = max(tot, key=tot.get)
+        denom = sum(tot.values()) or 1.0
+        out[rank] = {
+            "rounds": counts[rank], "windows": len(win[rank]),
+            "dominant": overall,
+            "dominant_share": round(tot[overall] / denom, 4),
+            "windows_dominated_by": dict(sorted(dom_windows.items())),
+            "mean_round_s": round(dt_sum[rank] / counts[rank], 6),
+            "components_total_s": {k: round(v, 4)
+                                   for k, v in sorted(tot.items())}}
+    return out
+
+
+def trace_summary(events, window_s=10.0):
+    """The run-level trace digest: round/join/dedup counts, critical-path
+    totals, and the per-worker root-cause table.  Empty dict when the
+    streams carry no spans (tracing off)."""
+    traces = assemble_traces(events)
+    if not traces:
+        return {}
+    joined = sum(t["joined"] for t in traces)
+    unjoined = sum(t["unjoined"] for t in traces)
+    comp = {k: round(sum(t["components"][k] for t in traces), 4)
+            for k in TRACE_COMPONENTS}
+    denom = joined + unjoined
+    return {
+        "rounds": len(traces),
+        "wire_ops": sum(t["wire_ops"] for t in traces),
+        "joined": joined, "unjoined": unjoined,
+        "join_rate": round(joined / denom, 4) if denom else None,
+        "dedup_twins": sum(t["dedup_twins"] for t in traces),
+        "components_total_s": comp,
+        "dominant": max(comp, key=comp.get),
+        "root_cause": straggler_root_cause(events, window_s,
+                                           traces=traces)}
 
 
 def health_flags(events, summaries):
@@ -246,6 +469,18 @@ def wire_health(events, summaries):
             row["rtt_count"] = h.get("count")
             row["rtt_p50"] = h.get("p50")
             row["rtt_p99"] = h.get("p99")
+            # the EXACT streaming extreme (telemetry.Histogram tracks it
+            # outside the reservoir) — the worst RTT an SLO cares about,
+            # which percentile-of-reservoir can drop
+            row["rtt_max"] = h.get("max")
+        # the v2 reply-header time split: RTT decomposable into center
+        # queueing vs apply even with tracing disabled (§17 satellite)
+        for key, label in (("wire.server_queue", "server_queue"),
+                           ("wire.server_apply", "server_apply")):
+            hh = s.get("hist", {}).get(key)
+            if hh:
+                row[label + "_p50"] = hh.get("p50")
+                row[label + "_p99"] = hh.get("p99")
         outages = [e for e in events
                    if e.get("ev") == "wire" and e.get("kind") == "outage"
                    and int(e.get("rank", 0)) == rank]
@@ -273,14 +508,25 @@ def build_trace(events):
     def us(ts):
         return max(0.0, round((ts - t0) * 1e6, 1))
 
+    # §17 causal spans: rounds on tid 1, wire/server handler spans on
+    # tid 2 — pre-scanned so the thread metadata and the cross-track flow
+    # arrows (client wire span → the server span it caused) can be built
+    span_evs = [e for e in events if e.get("ev") == "span" and "ts" in e]
+    span_tids = {(int(e.get("rank", 0)),
+                  1 if e.get("name") in ("round", "exchange") else 2)
+                 for e in span_evs}
+
     meta, body = [], []
     for r in ranks:
         meta.append({"ph": "M", "pid": r, "name": "process_name",
-                     "args": {"name": f"rank {r}"}})
+                     "args": {"name": "center" if r < 0 else f"rank {r}"}})
         meta.append({"ph": "M", "pid": r, "name": "process_sort_index",
                      "args": {"sort_index": r}})
         meta.append({"ph": "M", "pid": r, "tid": 0, "name": "thread_name",
                      "args": {"name": "phases"}})
+    for r, tid in sorted(span_tids):
+        meta.append({"ph": "M", "pid": r, "tid": tid, "name": "thread_name",
+                     "args": {"name": "rounds" if tid == 1 else "spans"}})
     for ev in events:
         kind = ev.get("ev")
         if kind not in TRACKED_EVENTS or "ts" not in ev:
@@ -317,12 +563,33 @@ def build_trace(events):
                              "ts": us(ev["ts"]),
                              "name": "device.overlap_ratio",
                              "args": {"value": ev["overlap_ratio"]}})
+        elif kind == "span":
+            name = str(ev.get("name", "?"))
+            tid = 1 if name in ("round", "exchange") else 2
+            dur = max(0.0, float(ev.get("dt", 0.0)) * 1e6)
+            start = us(float(ev["t0"])) if ev.get("t0") is not None \
+                else max(0.0, us(ev["ts"]) - dur)
+            label = name
+            if ev.get("dedup"):
+                label += ":dedup"
+            elif ev.get("ok") is False:
+                label += ":failed"
+            elif ev.get("outcome") and ev["outcome"] != "exchanged":
+                label += f":{ev['outcome']}"
+            body.append({"ph": "X", "pid": rank, "tid": tid,
+                         "ts": round(start, 1), "dur": round(dur, 1),
+                         "name": label, "cat": "span",
+                         "args": {k: ev.get(k)
+                                  for k in ("trace", "span", "parent",
+                                            "q", "a", "retries", "island")
+                                  if ev.get(k) is not None}})
         elif kind in INSTANT_EVENTS:
             parts = []
             if "worker" in ev:          # membership/chaos events name the
                 parts.append(f"w{ev['worker']}")   # affected worker
-            d = ev.get("kind") or ev.get("reason") or ev.get("label") or \
-                ev.get("error", "")[:40] or ev.get("signum", "")
+            d = ev.get("kind") or ev.get("reason") or ev.get("role") or \
+                ev.get("label") or ev.get("error", "")[:40] or \
+                ev.get("signum", "")
             if d:
                 parts.append(str(d))
             detail = ":".join(parts)
@@ -330,6 +597,33 @@ def build_trace(events):
                          "ts": us(ev["ts"]), "s": "p",
                          "name": f"{kind}:{detail}" if detail else kind,
                          "cat": "alert"})
+    # flow arrows: each server span binds back to the client wire span
+    # that caused it (join by parent span id) — the visual cross-rank
+    # link between a worker's exchange and the center handler it hit.
+    # The flow id is the SERVER span id, so a dedup twin gets its own
+    # arrow out of the same client span.
+    def _mid(ev):
+        dur = max(0.0, float(ev.get("dt", 0.0)) * 1e6)
+        start = us(float(ev["t0"])) if ev.get("t0") is not None \
+            else max(0.0, us(ev["ts"]) - dur)
+        return round(start + dur / 2.0, 1)
+
+    wire_client = {e.get("span"): e for e in span_evs
+                   if e.get("side") != "server"
+                   and str(e.get("name", "")).startswith("wire.")}
+    for s_ev in span_evs:
+        if s_ev.get("side") != "server":
+            continue
+        c_ev = wire_client.get(s_ev.get("parent"))
+        if c_ev is None:
+            continue              # client span lost (crash mid-round)
+        fid = str(s_ev.get("span"))
+        body.append({"ph": "s", "id": fid, "cat": "wire", "name": "rpc",
+                     "pid": int(c_ev.get("rank", 0)), "tid": 2,
+                     "ts": _mid(c_ev)})
+        body.append({"ph": "f", "bp": "e", "id": fid, "cat": "wire",
+                     "name": "rpc", "pid": int(s_ev.get("rank", 0)),
+                     "tid": 2, "ts": _mid(s_ev)})
     body.sort(key=lambda e: e["ts"])
     return {"displayTimeUnit": "ms", "traceEvents": meta + body}
 
@@ -373,6 +667,7 @@ def build_report(record_dir, window_s=10.0, events=None):
         "flags": health_flags(events, summaries),
         "counters": {r: s.get("counters", {}) for r, s in summaries.items()},
         "wire": wire_health(events, summaries),
+        "traces": trace_summary(events, window_s),
         "membership_events": membership,
         "crash_events": crashes,
         "flight_dumps": dumps,
@@ -440,8 +735,15 @@ def print_report(rep):
         for rank, w in sorted(rep["wire"].items()):
             rtt = (f"rtt p50 {w['rtt_p50'] * 1e3:.1f}ms "
                    f"p99 {w['rtt_p99'] * 1e3:.1f}ms "
+                   f"max {w['rtt_max'] * 1e3:.1f}ms "
                    f"over {w['rtt_count']} ops"
                    if w.get("rtt_p50") is not None else "no rtt samples")
+            if w.get("server_queue_p50") is not None:
+                # the v2 reply-header split: how much of that RTT was the
+                # center queueing/applying rather than the wire itself
+                rtt += (f" [center queue p50 "
+                        f"{w['server_queue_p50'] * 1e3:.2f}ms, apply p50 "
+                        f"{w.get('server_apply_p50', 0) * 1e3:.2f}ms]")
             churn = ", ".join(
                 f"{k.split('.', 1)[1]}×{int(v)}" for k, v in sorted(
                     w.items()) if k.startswith("wire.") and v)
@@ -450,6 +752,27 @@ def print_report(rep):
                       if w.get("outages") else "")
             print(f"  rank {rank}: {rtt}"
                   + (f" — {churn}" if churn else "") + outage)
+    tr = rep.get("traces")
+    if tr:
+        jr = (f"{tr['join_rate']:.1%} joined" if tr.get("join_rate")
+              is not None else "no wire ops")
+        print(f"\ndistributed traces ({tr['rounds']} exchange rounds, "
+              f"{tr['wire_ops']} wire ops, {jr}, "
+              f"{tr['dedup_twins']} dedup twin(s)):")
+        comp = tr["components_total_s"]
+        print("  critical path totals: " + "  ".join(
+            f"{k} {comp[k]:.3f}s" for k in comp))
+        if tr.get("root_cause"):
+            print("  straggler root cause (dominant component per worker):")
+            for rank, rc in sorted(tr["root_cause"].items(),
+                                   key=lambda kv: str(kv[0])):
+                wins = ", ".join(f"{k}×{v}" for k, v in
+                                 rc["windows_dominated_by"].items())
+                print(f"    rank {rank}: {rc['dominant'].upper()} "
+                      f"({rc['dominant_share']:.0%} of round time; "
+                      f"windows: {wins}; mean round "
+                      f"{rc['mean_round_s'] * 1e3:.1f} ms over "
+                      f"{rc['rounds']} rounds)")
     if rep.get("membership_events"):
         print("\nmembership transitions / injected faults:")
         for ev in rep["membership_events"][-12:]:
@@ -476,6 +799,13 @@ def main(argv=None):
     ap.add_argument("record_dir")
     ap.add_argument("--window", type=float, default=10.0,
                     help="straggler window seconds (default 10)")
+    ap.add_argument("--since", type=float, default=None, metavar="TS",
+                    help="only events at/after this unix timestamp — "
+                         "incremental reports over long runs without "
+                         "parsing the whole stream")
+    ap.add_argument("--last", type=float, default=None, metavar="SEC",
+                    help="only the trailing SEC seconds of the stream "
+                         "(anchored at the newest event)")
     ap.add_argument("--json", default=None, metavar="OUT",
                     help="also write the machine-readable report here "
                          "('-' for stdout)")
@@ -488,13 +818,23 @@ def main(argv=None):
     if not os.path.isdir(args.record_dir):
         print(f"no such directory: {args.record_dir}", file=sys.stderr)
         return 2
-    events = load_events(args.record_dir)        # parsed ONCE, shared by
-    rep = build_report(args.record_dir, args.window,  # report and --trace
+    since = args.since
+    if args.last is not None:
+        _, hi = stream_extent(args.record_dir)
+        if hi is not None:
+            last_since = hi - args.last
+            since = last_since if since is None else max(since, last_since)
+    events = load_events(args.record_dir,        # parsed ONCE, shared by
+                         since=since)            # report and --trace
+    rep = build_report(args.record_dir, args.window,
                        events=events)
+    if since is not None:
+        rep["since"] = round(since, 3)
     if not rep["events"]:
-        print(f"no telemetry_rank*.jsonl events under {args.record_dir} — "
-              "run with record_dir set (telemetry streams there)",
-              file=sys.stderr)
+        win = " in the requested window" if since is not None else ""
+        print(f"no telemetry_rank*.jsonl events under "
+              f"{args.record_dir}{win} — run with record_dir set "
+              "(telemetry streams there)", file=sys.stderr)
         return 1
     print_report(rep)
     if args.json == "-":

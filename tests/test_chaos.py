@@ -381,7 +381,17 @@ def test_elastic_center_sigkill_recovers_without_world_restart(tmp_path):
     with no world restart (each worker joins exactly once), the telemetry
     stream carries the center_down → center_restored pair, and every
     landed duplicate push was applied exactly once (dedup counter > 0,
-    bookkeeping balanced)."""
+    bookkeeping balanced).
+
+    Round 16 rides the SAME run for the causal-tracing acceptance
+    (ISSUE 11, docs/design.md §17): with ``tracing=true`` the merged
+    stream must assemble distributed traces where ≥95% of exchange-round
+    client spans join an applied server span, every round's critical
+    path sums to the observed round time within 5%, the straggler
+    root-cause table names each worker's dominant component, and the
+    Perfetto export carries cross-process flow arrows — one elastic run,
+    both gates (a second full elastic run would blow the tier-1
+    budget)."""
     record_dir = str(tmp_path)
     schedule = chaos.parse_schedule("kill@18:0")      # worker 0 = center
     net_schedule = chaos.parse_schedule("net_dup@0:-1:600")
@@ -393,7 +403,7 @@ def test_elastic_center_sigkill_recovers_without_world_restart(tmp_path):
     rc = mb.run_elastic(
         "easgd", "tests.conftest", "SleepyModel",
         {"sync_freq": 2, "batch_size": 8, "iter_sleep": 0.2,
-         "wire_timeout": 5, "wire_deadline": 90,
+         "tracing": True, "wire_timeout": 5, "wire_deadline": 90,
          "center_snapshot_every_s": 0.5}, 2,
         record_dir=record_dir, steps=120, host_devices=1,
         chaos_schedule=schedule, net_chaos_schedule=net_schedule,
@@ -430,6 +440,53 @@ def test_elastic_center_sigkill_recovers_without_world_restart(tmp_path):
     ok, _ = chaos_run.audit_center(record_dir, n_center_kills=1,
                                    require_dedup=True)
     assert ok
+
+    # -- round 16: the causal-tracing acceptance on this same run ------------
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        "_chaos_test_report", os.path.join(REPO, "scripts",
+                                           "telemetry_report.py"))
+    rep = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(rep)
+    trace_events = rep.load_events(record_dir)
+    summary = rep.trace_summary(trace_events, window_s=5.0)
+    assert summary, "tracing=true produced no spans"
+    assert summary["rounds"] >= 20, summary
+    # ≥95% of client wire spans join an applied server span — through
+    # the center kill (snapshot restore + retries) AND the dup storm
+    assert summary["join_rate"] is not None
+    assert summary["join_rate"] >= 0.95, summary
+    # every frame was duplicated: twins observed, tagged, never joined
+    assert summary["dedup_twins"] > 0, summary
+    # per-round critical path sums to the observed round time within 5%
+    for t in [t for t in rep.assemble_traces(trace_events)
+              if t["name"] == "round"]:
+        total = sum(t["components"].values())
+        assert abs(total - t["dt"]) <= 0.05 * t["dt"] + 0.005, t
+    # the root-cause table names each worker's dominant component
+    root = summary["root_cause"]
+    assert set(root) >= {1, 2}, root
+    for rcause in root.values():
+        assert rcause["dominant"] in rep.TRACE_COMPONENTS
+        assert rcause["rounds"] > 0
+    # SleepyModel's 0.2s/iter local steps dominate these rounds
+    assert all(rcause["dominant"] == "compute" for rcause in root.values())
+    # statusz endpoints were live on every long-lived process
+    assert {e.get("role") for e in trace_events
+            if e.get("ev") == "statusz"} >= {"worker", "supervisor",
+                                             "center"}
+    # Perfetto export: span slices + flow arrows binding client wire
+    # spans to the server spans they caused, landing on the center track
+    trace = rep.build_trace(trace_events)
+    tevs = trace["traceEvents"]
+    assert any(e.get("cat") == "span" and e.get("ph") == "X"
+               for e in tevs)
+    starts = [e for e in tevs if e.get("ph") == "s"]
+    finishes = [e for e in tevs if e.get("ph") == "f"]
+    assert starts and finishes
+    assert {e["id"] for e in starts} == {e["id"] for e in finishes}
+    assert {e["pid"] for e in finishes} == {-1}
+    assert {e["pid"] for e in starts} >= {1, 2}
 
 
 # -- slow: the full convergence-under-chaos gate -----------------------------

@@ -425,6 +425,72 @@ def test_telemetry_hot_path_good_fixture(tmp_path):
                         "telemetry-hot-path") == []
 
 
+TRACING_BAD = """
+from theanompi_tpu.utils import tracing
+
+def island_loop(n, center):
+    tr = tracing.active()
+    for i in range(n):
+        rnd = tr.begin("round", count=i)
+        rnd.end()
+"""
+
+TRACING_GOOD = """
+from theanompi_tpu.utils import tracing
+
+def island_loop(n, center):
+    tr = tracing.active()
+    for i in range(n):
+        rnd = tr.begin("round", count=i) if tr.enabled else None
+        if rnd is not None:
+            rnd.end()
+"""
+
+EMIT_BAD = """
+from theanompi_tpu.utils import telemetry, tracing
+
+def request(trace, op):
+    tm = telemetry.active()
+    tracing.emit_wire_span(tm, trace, op, dt=0.1)
+"""
+
+EMIT_GOOD = """
+from theanompi_tpu.utils import telemetry, tracing
+
+def request(trace, op):
+    tm = telemetry.active()
+    if trace is not None and tm.enabled:
+        tracing.emit_wire_span(tm, trace, op, dt=0.1)
+"""
+
+
+def test_span_emission_unguarded_begin_is_a_finding(tmp_path):
+    """Round 16: the span API is part of the hot-path contract — an
+    unguarded `Tracer.begin` in a hot file (async_easgd joined the set)
+    is a finding; the `... if tr.enabled else None` idiom is the guard."""
+    found = lint_snippet(tmp_path, "async_easgd.py", TRACING_BAD,
+                         "telemetry-hot-path")
+    assert len(found) == 1
+    assert "tr.begin" in found[0].message
+
+
+def test_span_emission_guarded_begin_is_clean(tmp_path):
+    assert lint_snippet(tmp_path, "async_easgd.py", TRACING_GOOD,
+                        "telemetry-hot-path") == []
+
+
+def test_module_level_emit_helpers_are_recording_calls(tmp_path):
+    """`tracing.emit_wire_span(...)` resolves to the tracing module —
+    unguarded in wire.py (now a hot file) it is a finding; the
+    `trace is not None and tm.enabled` conjunction guards."""
+    found = lint_snippet(tmp_path, "wire.py", EMIT_BAD,
+                         "telemetry-hot-path")
+    assert len(found) == 1
+    assert "emit_wire_span" in found[0].message
+    assert lint_snippet(tmp_path, "wire.py", EMIT_GOOD,
+                        "telemetry-hot-path") == []
+
+
 def test_telemetry_hot_path_only_applies_to_hot_files(tmp_path):
     # the same unguarded call in a non-hot-path file is NOT a finding
     assert lint_snippet(tmp_path, "report_tool.py", TELEMETRY_BAD,
@@ -512,6 +578,37 @@ def test_schema_drift_bad_fixture(monkeypatch):
 
     errors = sd.live_drift_errors(FakeRecorder, telemetry)
     assert any("SECTIONS" in msg for _, msg in errors)
+
+
+def test_schema_drift_tracing_probe_good_and_bad():
+    """Round 16: the live tracing probe — real modules in sync; a report
+    stand-in that cannot assemble spans (or tracks the wrong vocabulary)
+    fails the gate; a span emitter drifting from SPAN_FIELDS fails."""
+    import importlib.util
+
+    from theanompi_tpu.utils import telemetry, tracing
+    report_path = os.path.join(REPO, "scripts", "telemetry_report.py")
+    spec = importlib.util.spec_from_file_location("_sd_test_report",
+                                                  report_path)
+    report = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(report)
+
+    assert sd.tracing_schema_errors(tracing, telemetry, report) == []
+
+    class BlindReport:
+        # tracks neither span nor statusz, assembles nothing
+        TRACKED_EVENTS = ("phase",)
+        TRACE_COMPONENTS = ()
+
+        @staticmethod
+        def assemble_traces(events):
+            return []
+
+    errors = sd.tracing_schema_errors(tracing, telemetry, BlindReport)
+    msgs = [m for _, m in errors]
+    assert any("TRACKED_EVENTS" in m for m in msgs)
+    assert any("round(s)" in m for m in msgs), msgs
+    assert any("TRACE_COMPONENTS" in m for m in msgs)
 
 
 # ---------------------------------------------------------------------------

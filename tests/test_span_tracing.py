@@ -1,0 +1,504 @@
+"""Cross-process causal tracing (utils/tracing.py, docs/design.md §17):
+span context over the wire, server-side time split, trace assembly with
+critical paths, chaos-path dedup semantics, statusz/fleetz — and the
+elastic chaos acceptance gate (center kill + net dup → joined traces)."""
+
+import glob
+import importlib.util
+import json
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from theanompi_tpu.parallel import membership as mb
+from theanompi_tpu.parallel import wire
+from theanompi_tpu.parallel.center_server import CenterServer, RemoteCenter
+from theanompi_tpu.utils import chaos, telemetry, tracing
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _report_mod():
+    path = os.path.join(REPO, "scripts", "telemetry_report.py")
+    spec = importlib.util.spec_from_file_location("_span_test_report", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@pytest.fixture
+def traced_stream(tmp_path):
+    """Process-global telemetry stream + enabled tracer (the server
+    handler reads telemetry.active(), so the global must be live);
+    restored to disabled afterwards so other tests stay unaffected."""
+    d = str(tmp_path / "stream")
+    tm = telemetry.init({"record_dir": d, "rank": 0,
+                         "telemetry_flush_every": 1})
+    tr = tracing.init({"tracing": True})
+    yield d, tm, tr
+    telemetry.init({"telemetry": False})
+    tracing.init({})
+
+
+def _events(record_dir):
+    out = []
+    for p in sorted(glob.glob(os.path.join(record_dir,
+                                           "telemetry_rank*.jsonl"))):
+        with open(p) as f:
+            for line in f:
+                if line.strip():
+                    try:
+                        out.append(json.loads(line))
+                    except ValueError:
+                        pass
+    return out
+
+
+def _spans(events):
+    return [e for e in events if e.get("ev") == "span"]
+
+
+# -- tracer unit surface ------------------------------------------------------
+
+def test_tracer_disabled_is_inert_and_default():
+    tracing.init({})
+    tr = tracing.active()
+    assert tr.enabled is False
+    assert tr.begin("round") is None          # call sites guard on enabled
+    # tracing=true without telemetry stays disabled: spans ride the stream
+    telemetry.init({"telemetry": False})
+    assert tracing.init({"tracing": True}).enabled is False
+
+
+def test_span_ids_hierarchy_and_event_schema():
+    tm = telemetry.Telemetry(rank=3, run_id="t")
+    tr = tracing.Tracer(telemetry_=tm)
+    rnd = tr.begin("round", island=2)
+    assert tr.current["span"] == rnd.span     # statusz current-span view
+    child = rnd.child("wire.push")
+    assert child.trace == rnd.trace and child.parent == rnd.span
+    assert child.span != rnd.span
+    rnd.note(train_s=0.5)
+    ev = rnd.end(outcome="exchanged")
+    assert tr.spans == 1 and tr.current is None
+    for k in tracing.SPAN_FIELDS:
+        if k != "parent":                     # root: parent=None omitted
+            assert k in ev, (k, ev)
+    streamed = [e for e in tm.tail(4) if e["ev"] == tracing.SPAN_EVENT]
+    assert streamed and streamed[-1]["name"] == "round"
+    assert streamed[-1]["train_s"] == 0.5
+    assert streamed[-1]["outcome"] == "exchanged"
+    # ids are unique across mints
+    ids = {tracing.new_span_id() for _ in range(64)}
+    assert len(ids) == 64
+
+
+# -- propagation over the wire ------------------------------------------------
+
+def test_wire_span_propagation_and_server_split(traced_stream):
+    """One traced round against a live center: the client's wire spans
+    and the server's handler spans share the trace id, parent-chain
+    correctly, and carry the queue/apply split; an UNtraced op still
+    feeds the wire.server_queue/apply histograms (satellite: RTT stays
+    decomposable with tracing disabled)."""
+    d, tm, tr = traced_stream
+    srv = CenterServer(alpha=0.5)
+    host, port = srv.start()
+    try:
+        c = RemoteCenter(f"{host}:{port}", alpha=0.5, client_id="w1")
+        c.ensure_init({"w": np.ones(3, np.float32)})
+        rnd = tr.begin("round", island=1)
+        _ = c.pull(trace=rnd.ctx())
+        c.push_delta({"w": np.full(3, 2.0, np.float32)}, island=1,
+                     trace=rnd.ctx())
+        rnd.end(outcome="exchanged")
+        c.push_delta({"w": np.full(3, 1.0, np.float32)}, island=1)  # untraced
+        c.close()
+    finally:
+        srv.stop()
+    tm.close()
+    spans = _spans(_events(d))
+    rounds = [s for s in spans if s["name"] == "round"]
+    wires = [s for s in spans if s["name"].startswith("wire.")]
+    servers = [s for s in spans if s["side"] == "server"]
+    assert len(rounds) == 1 and len(wires) == 2 and len(servers) == 2
+    tid = rounds[0]["trace"]
+    assert all(s["trace"] == tid for s in wires + servers)
+    assert all(w["parent"] == rounds[0]["span"] for w in wires)
+    wire_ids = {w["span"] for w in wires}
+    assert all(s["parent"] in wire_ids for s in servers)
+    # the push's server span carries the q/a split; the wire span echoes it
+    push_srv = [s for s in servers if s["name"] == "center.push"][0]
+    assert push_srv.get("q") is not None and push_srv.get("a") is not None
+    push_wire = [w for w in wires if w["name"] == "wire.push"][0]
+    assert push_wire.get("a") == push_srv["a"]
+    # histograms fed by EVERY reply (3 traced+untraced center ops + init)
+    summ = json.load(open(os.path.join(d, "telemetry_summary_rank0.json")))
+    for k in ("wire.server_queue", "wire.server_apply"):
+        assert summ["hist"][k]["count"] >= 4, (k, summ["hist"].get(k))
+
+
+def test_chaos_dup_yields_one_applied_span_and_tagged_twin(traced_stream):
+    """THE chaos-path pin: a ChaosProxy-duplicated push produces exactly
+    ONE applied server span joined to the client span; the deduped twin
+    is tagged `dedup` and the assembled critical path counts it never."""
+    d, tm, tr = traced_stream
+    srv = CenterServer(alpha=0.5)
+    host, port = srv.start()
+    proxy = chaos.ChaosProxy(f"{host}:{port}",
+                             chaos.parse_schedule("net_dup@0:-1:60"))
+    paddr = proxy.start()
+    try:
+        c = RemoteCenter(paddr, alpha=0.5, client_id="w1")
+        c.ensure_init({"w": np.ones(3, np.float32)})
+        rnd = tr.begin("round", island=1)
+        time.sleep(0.01)
+        c.push_delta({"w": np.full(3, 2.0, np.float32)}, island=1,
+                     trace=rnd.ctx())
+        rnd.end(outcome="exchanged")
+        assert srv.center.n_updates == 1          # applied exactly once
+        assert srv.dedup.hits >= 1
+        c.close()
+    finally:
+        proxy.stop()
+        srv.stop()
+    tm.close()
+    events = _events(d)
+    spans = _spans(events)
+    push_wire = [s for s in spans if s["name"] == "wire.push"]
+    assert len(push_wire) == 1                    # retries/dups share ONE span
+    servers = [s for s in spans if s["side"] == "server"
+               and s["name"] == "center.push"]
+    applied = [s for s in servers if not s.get("dedup")]
+    twins = [s for s in servers if s.get("dedup")]
+    assert len(applied) == 1 and len(twins) >= 1
+    assert all(s["parent"] == push_wire[0]["span"] for s in servers)
+    rep = _report_mod()
+    traces = rep.assemble_traces(events)
+    rounds = [t for t in traces if t["name"] == "round"]
+    assert len(rounds) == 1
+    t = rounds[0]
+    assert t["joined"] == 1 and t["unjoined"] == 0
+    assert t["dedup_twins"] >= 1
+    # the twin never double-counts: apply charged once, from the applied span
+    assert t["components"]["apply"] <= float(applied[0]["a"]) + 1e-6 + \
+        float(applied[0].get("q", 0))
+
+
+def test_corrupt_retry_shares_trace_and_joins_once(traced_stream):
+    """A corrupted request is retried under the SAME token and trace ids:
+    one client wire span (retries counted), exactly one applied server
+    span, trace joined."""
+    d, tm, tr = traced_stream
+    srv = CenterServer(alpha=0.5)
+    host, port = srv.start()
+    t0 = time.time()
+    proxy = chaos.ChaosProxy(f"{host}:{port}",
+                             chaos.parse_schedule("net_corrupt@0:-1:0.6"),
+                             t0=t0)
+    paddr = proxy.start()
+    try:
+        c = RemoteCenter(paddr, alpha=0.5, client_id="w1",
+                         op_timeout_s=1.0, max_retries=20, deadline_s=30)
+        c._wire.backoff = mb.Backoff(base=0.05, cap=0.2)
+        c.ensure_init({"w": np.ones(3, np.float32)})   # corrupt window bites
+        rnd = tr.begin("round", island=1)
+        c.push_delta({"w": np.full(3, 2.0, np.float32)}, island=1,
+                     trace=rnd.ctx())
+        rnd.end(outcome="exchanged")
+        c.close()
+    finally:
+        proxy.stop()
+        srv.stop()
+    tm.close()
+    events = _events(d)
+    spans = _spans(events)
+    push_wire = [s for s in spans if s["name"] == "wire.push"]
+    assert len(push_wire) == 1
+    applied = [s for s in spans if s["side"] == "server"
+               and s["name"] == "center.push" and not s.get("dedup")]
+    assert len(applied) == 1
+    assert applied[0]["trace"] == push_wire[0]["trace"]
+    assert srv.center.n_updates == 1
+
+
+def test_partition_round_fails_then_next_round_joins(traced_stream):
+    """A ChaosProxy partition mid-round: the wire span ends ok=false (the
+    round is `skipped`, joined to nothing), and the FIRST round after the
+    window heals joins an applied server span again — outage and recovery
+    both visible in the assembled trace."""
+    d, tm, tr = traced_stream
+    srv = CenterServer(alpha=0.5)
+    host, port = srv.start()
+    sched = chaos.parse_schedule("net_partition@0:-1:1.0")
+    proxy = chaos.ChaosProxy(f"{host}:{port}", sched,
+                             t0=time.time() + 3600)    # armed manually
+    paddr = proxy.start()
+    try:
+        c = RemoteCenter(paddr, alpha=0.5, client_id="w1",
+                         op_timeout_s=0.4, max_retries=2, deadline_s=1.0)
+        c._wire.backoff = mb.Backoff(base=0.05, cap=0.1)
+        c.ensure_init({"w": np.ones(3, np.float32)})   # pre-partition
+        proxy.t0 = time.time()                         # window opens NOW
+        time.sleep(0.05)
+        rnd1 = tr.begin("round", island=1)
+        with pytest.raises(wire.WireGiveUp):
+            c.push_delta({"w": np.full(3, 2.0, np.float32)}, island=1,
+                         trace=rnd1.ctx())
+        rnd1.end(outcome="skipped")
+        time.sleep(max(0.0, proxy.t0 + 1.2 - time.time()))   # heal
+        rnd2 = tr.begin("round", island=1)
+        c.push_delta({"w": np.full(3, 2.0, np.float32)}, island=1,
+                     trace=rnd2.ctx())
+        rnd2.end(outcome="exchanged")
+        c.close()
+    finally:
+        proxy.stop()
+        srv.stop()
+    tm.close()
+    events = _events(d)
+    rep = _report_mod()
+    traces = {t["outcome"]: t for t in rep.assemble_traces(events)
+              if t["name"] == "round"}
+    assert traces["skipped"]["joined"] == 0
+    assert traces["skipped"]["unjoined"] == 1
+    assert traces["exchanged"]["joined"] == 1
+    assert srv.center.n_updates == 1                   # only the healed push
+
+
+def test_giveup_ends_span_with_failure(traced_stream):
+    """A partitioned/dead center still ENDS the wire span (ok=false, the
+    error carried) so a round through an outage assembles instead of
+    leaking an unfinished trace."""
+    d, tm, tr = traced_stream
+    rnd = tr.begin("round", island=1)
+    client = wire.WireClient("127.0.0.1:9", client_id="w1",
+                             connect_timeout_s=0.2, op_timeout_s=0.2,
+                             max_retries=1, deadline_s=1.0,
+                             backoff=mb.Backoff(base=0.02, cap=0.05))
+    with pytest.raises(wire.WireGiveUp):
+        client.request({"op": "pull"}, trace=rnd.ctx())
+    rnd.end(outcome="skipped")
+    tm.close()
+    spans = _spans(_events(d))
+    pulls = [s for s in spans if s["name"] == "wire.pull"]
+    assert len(pulls) == 1 and pulls[0]["ok"] is False
+    assert "err" in pulls[0]
+    rounds = [s for s in spans if s["name"] == "round"]
+    assert rounds and rounds[0]["outcome"] == "skipped"
+
+
+# -- assembly / critical path / root cause on synthetic streams ---------------
+
+def _synthetic_round(rank, t0, compute_s, wire_s, q, a, trace=None):
+    """One round + wire + applied server span triple as raw events."""
+    trace = trace or tracing.new_trace_id()
+    rid, wid, sid = (tracing.new_span_id() for _ in range(3))
+    dt = compute_s + wire_s + q + a
+    return [
+        {"ev": "span", "ts": t0 + dt, "rank": rank, "name": "round",
+         "side": "client", "trace": trace, "span": rid, "parent": None,
+         "t0": t0, "dt": dt, "outcome": "exchanged"},
+        {"ev": "span", "ts": t0 + dt, "rank": rank, "name": "wire.push",
+         "side": "client", "trace": trace, "span": wid, "parent": rid,
+         "t0": t0 + compute_s, "dt": wire_s + q + a, "q": q, "a": a,
+         "ok": True},
+        {"ev": "span", "ts": t0 + dt, "rank": -1, "name": "center.push",
+         "side": "server", "trace": trace, "span": sid, "parent": wid,
+         "t0": t0 + compute_s, "dt": q + a, "q": q, "a": a, "ok": True},
+    ]
+
+
+def test_assemble_critical_path_and_root_cause():
+    rep = _report_mod()
+    events = []
+    t = 1000.0
+    # rank 1: compute-bound; rank 2: queue-bound
+    for i in range(4):
+        events += _synthetic_round(1, t + i, compute_s=0.8, wire_s=0.05,
+                                   q=0.01, a=0.02)
+        events += _synthetic_round(2, t + i, compute_s=0.1, wire_s=0.05,
+                                   q=0.6, a=0.02)
+    traces = rep.assemble_traces(events)
+    assert len(traces) == 8
+    for tr_ in traces:
+        assert abs(sum(tr_["components"].values()) - tr_["dt"]) <= \
+            0.05 * tr_["dt"] + 1e-9
+        assert tr_["joined"] == 1
+    rc = rep.straggler_root_cause(events, window_s=2.0)
+    assert rc[1]["dominant"] == "compute"
+    assert rc[2]["dominant"] == "queue"
+    assert rc[2]["rounds"] == 4 and rc[2]["windows"] >= 1
+    summary = rep.trace_summary(events, window_s=2.0)
+    assert summary["rounds"] == 8 and summary["join_rate"] == 1.0
+    assert set(summary["components_total_s"]) == set(rep.TRACE_COMPONENTS)
+
+
+def test_check_stragglers_cites_root_cause_component():
+    """The demote event names the dominant component from the root-cause
+    table — 'demoted: straggler' comes with a cause."""
+    tm = telemetry.Telemetry(rank=0, run_id="rc")
+    ctl = mb.MembershipController(telemetry_=tm, straggle_windows=2)
+    ctl.join(1)
+    ctl.join(2)
+    ctl._root_cause = {2: {"dominant": "queue", "dominant_share": 0.7}}
+    ranking = [{"rank": 2, "windows_straggled": 5,
+                "mean_train_secs": 0.9},
+               {"rank": 1, "windows_straggled": 0,
+                "mean_train_secs": 0.1}]
+    assert ctl.check_stragglers(ranking) == [2]
+    demotes = [e for e in tm.tail(8) if e["ev"] == "worker_demote"]
+    assert demotes and demotes[-1]["component"] == "queue"
+
+
+def test_report_since_and_last_window_filtering(tmp_path):
+    d = str(tmp_path)
+    tm = telemetry.Telemetry(rank=0, run_id="w", stream_dir=d,
+                             flush_every=1)
+    rep = _report_mod()
+    # hand-stamp phases at controlled times via direct event writes
+    for i in range(10):
+        tm.event("phase", sec="train", dt=0.01)
+    tm.close()
+    # rewrite ts fields to a spread so the window bites deterministically
+    path = os.path.join(d, "telemetry_rank0.jsonl")
+    lines = [json.loads(ln) for ln in open(path) if ln.strip()]
+    for i, ev in enumerate(lines):
+        ev["ts"] = 1000.0 + i
+    with open(path, "w") as f:
+        for ev in lines:
+            f.write(json.dumps(ev) + "\n")
+    assert len(rep.load_events(d)) == len(lines)
+    windowed = rep.load_events(d, since=1005.0)
+    assert windowed and all(e["ts"] >= 1005.0 for e in windowed)
+    assert len(windowed) == len(lines) - 5
+    lo, hi = rep.stream_extent(d)
+    assert lo == 1000.0 and hi == 1000.0 + len(lines) - 1
+    # the CLI path: --last uses the extent, prints a windowed report
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts",
+                                      "telemetry_report.py"),
+         d, "--last", "3"], capture_output=True, text=True)
+    assert out.returncode == 0, out.stderr
+    assert "phase breakdown" in out.stdout
+
+
+# -- statusz / fleetz ---------------------------------------------------------
+
+def test_statusz_health_events_and_fleetz(tmp_path):
+    d = str(tmp_path)
+    tm = telemetry.Telemetry(rank=1, run_id="sz", stream_dir=d)
+    tr = tracing.Tracer(telemetry_=tm)
+    tr.begin("round", island=1)              # live current-span
+    sz = tracing.StatuszServer("worker", ident=1, run_dir=d,
+                               telemetry_=tm, tracer_=tr,
+                               extra=lambda: {"steps": 42})
+    host, port = sz.start()
+    try:
+        rep = tracing.statusz_query(f"{host}:{port}", "health")
+        assert rep["ok"] and rep["role"] == "worker" and rep["id"] == 1
+        assert rep["steps"] == 42
+        assert rep["current_span"]["name"] == "round"
+        for k in tracing.STATUSZ_FIELDS:
+            assert k in rep, k
+        evs = tracing.statusz_query(f"{host}:{port}", "events", n=4)
+        assert evs["ok"] and isinstance(evs["events"], list)
+        bad = tracing.statusz_query(f"{host}:{port}", "nope")
+        assert bad["ok"] is False and "unknown" in bad["error"]
+        # fleetz aggregates the roster (this live one + a ghost)
+        ghost = os.path.join(tracing.statusz_dir(d), "center_-1.json")
+        with open(ghost, "w") as f:
+            json.dump({"role": "center", "id": -1, "pid": 99999,
+                       "host": "127.0.0.1", "port": 9}, f)
+        out = subprocess.run(
+            [sys.executable, os.path.join(REPO, "scripts", "fleetz.py"),
+             d, "--json"], capture_output=True, text=True)
+        assert out.returncode == 2, out.stderr        # a DOWN row present
+        fleet = json.loads(out.stdout)["fleet"]
+        by_role = {r["role"]: r for r in fleet}
+        assert by_role["worker"]["ok"] and by_role["worker"]["spans"] == 0
+        assert by_role["center"].get("down") is True
+    finally:
+        sz.stop()
+        tm.close()
+    assert not os.path.exists(os.path.join(tracing.statusz_dir(d),
+                                           "worker_1.json"))
+    # a CRASH exit path (stop(deregister=False)) keeps the discovery doc
+    # so fleetz lists the process DOWN instead of losing it from the
+    # roster (SIGKILL — no stop() at all — gets the same verdict)
+    tm2 = telemetry.Telemetry(rank=2, run_id="sz2", stream_dir=d)
+    sz2 = tracing.StatuszServer("worker", ident=2, run_dir=d,
+                                telemetry_=tm2)
+    sz2.start()
+    sz2.stop(deregister=False)
+    tm2.close()
+    ghost2 = os.path.join(tracing.statusz_dir(d), "worker_2.json")
+    assert os.path.exists(ghost2)
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "fleetz.py"),
+         d, "--json"], capture_output=True, text=True)
+    assert out.returncode == 2
+    fleet = json.loads(out.stdout)["fleet"]
+    down = [r for r in fleet if str(r.get("id")) == "2"]
+    assert down and down[0].get("down") is True
+
+
+def test_island_round_spans_measure_stage(tmp_path):
+    """The §17 stage component is MEASURED, not decorative: an in-process
+    island running under tracing attaches recorder-derived stage_s
+    (load + host staging) to every round span, and with telemetry on the
+    island's brackets stream phase events for the straggler ranking."""
+    from tests.conftest import TinyModel
+    from theanompi_tpu.parallel.async_easgd import AsyncEASGDTrainer
+
+    d = str(tmp_path / "stream")
+    telemetry.init({"record_dir": d, "rank": 0,
+                    "telemetry_flush_every": 1})
+    tracing.init({"tracing": True})
+    try:
+        def factory(cfg):
+            cfg = dict(cfg)
+            cfg["verbose"] = False
+            cfg.setdefault("batch_size", 8)
+            return TinyModel(cfg)
+
+        trainer = AsyncEASGDTrainer(factory, {
+            "async_islands": 1, "sync_freq": 2, "seed": 3,
+            "batch_size": 8})
+        trainer.start()
+        isl = trainer.islands[0]
+        deadline = time.time() + 180
+        while isl.exchanges_done < 3 and time.time() < deadline:
+            assert isl.error is None, isl.error
+            time.sleep(0.05)
+        trainer.stop_and_join(timeout=120)
+    finally:
+        tm = telemetry.active()
+        tm.close()
+        telemetry.init({"telemetry": False})
+        tracing.init({})
+    events = _events(d)
+    rounds = [s for s in _spans(events) if s["name"] == "round"]
+    assert len(rounds) >= 3
+    assert all("stage_s" in r for r in rounds), rounds[0]
+    assert all(r["stage_s"] >= 0 for r in rounds)
+    # stage is bounded by the round itself
+    assert all(r["stage_s"] <= r["dt"] + 1e-6 for r in rounds)
+    # the island recorder's brackets stream phase events (straggler
+    # ranking raw material) — train at minimum
+    phases = {e.get("sec") for e in events if e.get("ev") == "phase"}
+    assert "train" in phases and "load" in phases
+
+
+# -- the acceptance gate: elastic chaos run with joined traces ----------------
+# The ISSUE 11 acceptance (center SIGKILL + net_dup elastic run → ≥95%
+# span join rate, per-round critical paths within 5%, root-cause table,
+# Perfetto flow arrows, live statusz audit) rides the EXISTING round-14
+# chaos gate — test_chaos.test_elastic_center_sigkill_recovers_without_
+# world_restart now runs with tracing=true and asserts the full trace
+# contract on the same run, so tier-1 pays for ONE elastic chaos world,
+# not two.

@@ -42,6 +42,7 @@ REPORT_PATH = "scripts/telemetry_report.py"
 MEMBERSHIP_PATH = "theanompi_tpu/parallel/membership.py"
 CHAOS_PATH = "theanompi_tpu/utils/chaos.py"
 WIRE_PATH = "theanompi_tpu/parallel/wire.py"
+TRACING_PATH = "theanompi_tpu/utils/tracing.py"
 
 # one lane, one module: a compute span [0,50]us and a comm span [40,60]us
 # → compute 50us, comm 20us, exposed 10us, overlap 0.5 — a COMPLETE
@@ -371,6 +372,161 @@ def wire_schema_errors(wire, membership, telemetry,
     return errors
 
 
+def tracing_schema_errors(tracing, telemetry,
+                          telemetry_report=None) -> List[tuple]:
+    """Round-16 probes: the causal-tracing span/statusz vocabulary
+    (docs/design.md §17).  LIVE checks, all jax-free:
+
+    * a Tracer driven through a round must emit a ``span`` event carrying
+      every declared :data:`SPAN_FIELDS` key;
+    * the three span emitters (round via ``Tracer``, ``emit_wire_span``,
+      ``emit_server_span``) fed into the REPORT's trace assembly must
+      produce one joined round whose critical-path components sum to the
+      round time, with a dedup twin counted but never joined — a span
+      emitter the report cannot render fails the gate here;
+    * a live :class:`StatuszServer` must answer a real socket ``health``
+      query with every declared :data:`STATUSZ_FIELDS` key and register/
+      deregister its discovery doc;
+    * the report must track ``span``/``statusz`` and agree on the
+      component vocabulary."""
+    errors: List[tuple] = []
+    if tracing is None:
+        return errors
+
+    # 1. a live round span carries the declared field set
+    tm = telemetry.Telemetry(rank=0, run_id="drift-check")
+    tr = tracing.Tracer(telemetry_=tm)
+    rnd = tr.begin("round", island=0)
+    ctx = rnd.ctx()
+    rnd.end(outcome="exchanged")
+    spans = [e for e in tm.tail(4) if e["ev"] == tracing.SPAN_EVENT]
+    if not spans:
+        errors.append((TRACING_PATH,
+                       "a live Tracer round emitted no "
+                       f"{tracing.SPAN_EVENT!r} event"))
+    else:
+        missing = [k for k in tracing.SPAN_FIELDS
+                   if k not in spans[-1] and k != "parent"]
+        if missing:                      # parent is None → omitted is fine
+            errors.append((TRACING_PATH,
+                           f"round span event lacks declared SPAN_FIELDS "
+                           f"{missing}: {sorted(spans[-1])}"))
+        if tr.spans != 1:
+            errors.append((TRACING_PATH,
+                           f"Tracer.spans counted {tr.spans} after one "
+                           "emitted span"))
+
+    # 2. the full client+server emitter set must assemble into ONE joined
+    # round in the live report — with the dedup twin tagged, counted, and
+    # never double-counted on the critical path
+    import time as _time
+    tm2 = telemetry.Telemetry(rank=1, run_id="drift-check")
+    tr2 = tracing.Tracer(telemetry_=tm2)
+    rnd2 = tr2.begin("round", island=1)
+    wire_ctx = rnd2.ctx()
+    sid = tracing.new_span_id()
+    tracing.emit_wire_span(tm2, wire_ctx, "push", span=sid,
+                           t0=rnd2.t0, dt=0.01, q=0.002, a=0.003)
+    srv_ctx = {"t": rnd2.trace, "s": sid}
+    tracing.emit_server_span(tm2, srv_ctx, "push", t0=rnd2.t0, dt=0.006,
+                             q=0.002, a=0.003, island=1)
+    tracing.emit_server_span(tm2, srv_ctx, "push", t0=rnd2.t0, dt=0.0001,
+                             island=1, dedup=True)
+    _time.sleep(0.015)          # round dt must cover its wire op's 10ms
+    rnd2.end(outcome="exchanged")
+    if telemetry_report is not None:
+        assemble = getattr(telemetry_report, "assemble_traces", None)
+        if assemble is None:
+            errors.append((REPORT_PATH,
+                           "telemetry_report has no assemble_traces — "
+                           "span events would be emitted but never "
+                           "joined/rendered"))
+        else:
+            traces = assemble(tm2.tail(8))
+            if len(traces) != 1:
+                errors.append((REPORT_PATH,
+                               f"trace assembly built {len(traces)} "
+                               "round(s) from one emitted round"))
+            else:
+                t = traces[0]
+                if t["joined"] != 1 or t["dedup_twins"] != 1:
+                    errors.append((REPORT_PATH,
+                                   f"client span did not join exactly one "
+                                   f"applied server span with one dedup "
+                                   f"twin (joined={t['joined']}, "
+                                   f"twins={t['dedup_twins']})"))
+                total = sum(t["components"].values())
+                if abs(total - t["dt"]) > max(0.05 * t["dt"], 1e-6):
+                    errors.append((REPORT_PATH,
+                                   f"critical-path components sum "
+                                   f"{total:.6f} != round dt "
+                                   f"{t['dt']:.6f}"))
+                if set(t["components"]) != set(tracing.COMPONENTS):
+                    errors.append((REPORT_PATH,
+                                   f"component vocabulary "
+                                   f"{sorted(t['components'])} != "
+                                   f"tracing.COMPONENTS "
+                                   f"{sorted(tracing.COMPONENTS)}"))
+        comps = getattr(telemetry_report, "TRACE_COMPONENTS", ())
+        if tuple(comps) != tuple(tracing.COMPONENTS):
+            errors.append((REPORT_PATH,
+                           f"TRACE_COMPONENTS {tuple(comps)!r} != "
+                           f"tracing.COMPONENTS "
+                           f"{tuple(tracing.COMPONENTS)!r}"))
+        tracked = set(getattr(telemetry_report, "TRACKED_EVENTS", ()))
+        missing = sorted({tracing.SPAN_EVENT,
+                          tracing.STATUSZ_EVENT} - tracked)
+        if missing:
+            errors.append((REPORT_PATH,
+                           f"TRACKED_EVENTS is missing tracing event "
+                           f"kind(s) {missing} — spans/statusz would be "
+                           "silently dropped from report and trace"))
+
+    # 3. a live statusz endpoint answers with the declared field set and
+    # registers/deregisters its discovery doc
+    import tempfile
+    with tempfile.TemporaryDirectory() as d:
+        tm3 = telemetry.Telemetry(rank=0, run_id="drift-check")
+        sz = tracing.StatuszServer("probe", ident=0, run_dir=d,
+                                   telemetry_=tm3, tracer_=tr)
+        try:
+            host, port = sz.start()
+            docs = tracing.read_statusz_docs(d)
+            if len(docs) != 1 or docs[0].get("port") != port:
+                errors.append((TRACING_PATH,
+                               f"statusz discovery doc missing/wrong "
+                               f"under {d}: {docs}"))
+            rep = tracing.statusz_query(f"{host}:{port}", "health")
+            missing = [k for k in tracing.STATUSZ_FIELDS if k not in rep]
+            if missing:
+                errors.append((TRACING_PATH,
+                               f"statusz health reply lacks declared "
+                               f"STATUSZ_FIELDS {missing}: "
+                               f"{sorted(rep)}"))
+            evs = tracing.statusz_query(f"{host}:{port}", "events", n=4)
+            if not evs.get("ok") or "events" not in evs:
+                errors.append((TRACING_PATH,
+                               "statusz events op returned no event "
+                               "list"))
+            sz_evs = [e for e in tm3.tail(4)
+                      if e["ev"] == tracing.STATUSZ_EVENT]
+            if not sz_evs or "addr" not in sz_evs[-1]:
+                errors.append((TRACING_PATH,
+                               f"statusz start emitted no "
+                               f"{tracing.STATUSZ_EVENT!r} event with an "
+                               f"addr"))
+        except Exception as e:
+            errors.append((TRACING_PATH,
+                           f"live statusz probe failed: {e!r}"))
+        finally:
+            sz.stop()
+        if tracing.read_statusz_docs(d):
+            errors.append((TRACING_PATH,
+                           "statusz stop() left its discovery doc "
+                           "behind — fleetz would list a ghost"))
+    return errors
+
+
 def thread_role_coverage_errors(root: Optional[str] = None) -> List[tuple]:
     """Round-15 probe: the host-concurrency pass is only as good as its
     thread-role map, so every ``threading.Thread(...)``/``Timer(...)``
@@ -501,6 +657,15 @@ class SchemaDriftChecker(Checker):
             os.path.join("theanompi_tpu", "parallel", "wire.py"),
             "_tpulint_wire")
         errors += wire_schema_errors(wire, membership, telemetry, report)
+        # round 16: the causal-tracing span/statusz vocabulary — live
+        # emitters joined through the live report, statusz on a real
+        # socket (utils/tracing is stdlib-only by contract, importable
+        # through the synthetic package like telemetry)
+        try:
+            from theanompi_tpu.utils import tracing as tracing_mod
+        except ImportError:
+            tracing_mod = None
+        errors += tracing_schema_errors(tracing_mod, telemetry, report)
         # round 15: the thread-role map must see and resolve every
         # Thread/Timer spawn in the thread-heaviest runtime modules
         errors += thread_role_coverage_errors()

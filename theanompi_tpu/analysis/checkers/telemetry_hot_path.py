@@ -19,6 +19,16 @@ the dotted ``self.telemetry`` itself, and direct module-level
 ``.enabled`` (``if tm.enabled``, ``if rec and telem.enabled``); the
 accessors (``active``/``init``/``install_signal_hooks``) and plain
 ``.enabled`` reads are free.
+
+Round 16 extends the pass to the span-emission API
+(``utils/tracing.py``, docs/design.md §17): tracer handles come from
+``tracing.active()``/``tracing.init(...)``, ``Tracer.begin`` is the
+recording gate (a Span minted under a guard only exists on the enabled
+path, so ``Span.end``/``note`` need no separate check), and the
+module-level ``tracing.emit_wire_span``/``emit_server_span`` one-shot
+emitters are recording calls — an unguarded hot-path span is a lint
+finding.  The hot set grows the wire/center/island files the span API
+rides through.
 """
 
 from __future__ import annotations
@@ -28,16 +38,21 @@ from typing import List, Set
 
 from ..core import Checker, Finding, ImportResolver, SourceFile, register
 
-HOT_BASENAMES = {"steps.py", "prefetch.py", "exchanger.py", "worker.py"}
+HOT_BASENAMES = {"steps.py", "prefetch.py", "exchanger.py", "worker.py",
+                 "async_easgd.py", "wire.py", "center_server.py"}
 
 TELEMETRY_MODULE = "theanompi_tpu.utils.telemetry"
+TRACING_MODULE = "theanompi_tpu.utils.tracing"
 
 # methods that record (cost when disabled = wasted work); the accessors
-# and `.enabled` reads are the sanctioned unguarded surface
+# and `.enabled` reads are the sanctioned unguarded surface.  `begin`
+# (Tracer) and the emit_* one-shot helpers are the §17 span API.
 RECORDING = {"counter", "gauge", "observe", "phase", "event",
-             "system_snapshot", "dump_flight", "tail", "summary", "close"}
+             "system_snapshot", "dump_flight", "tail", "summary", "close",
+             "begin", "emit_wire_span", "emit_server_span"}
 
-HANDLE_SOURCES = {TELEMETRY_MODULE + ".active", TELEMETRY_MODULE + ".init"}
+HANDLE_SOURCES = {TELEMETRY_MODULE + ".active", TELEMETRY_MODULE + ".init",
+                  TRACING_MODULE + ".active", TRACING_MODULE + ".init"}
 
 
 def _test_mentions_enabled(test: ast.AST) -> bool:
@@ -72,8 +87,9 @@ def _ends_control_flow(stmts) -> bool:
 @register
 class TelemetryHotPathChecker(Checker):
     name = "telemetry-hot-path"
-    description = ("telemetry recording calls in steps/prefetch/exchanger/"
-                   "worker not dominated by an `enabled` check")
+    description = ("telemetry/span-emission calls in steps/prefetch/"
+                   "exchanger/worker/async_easgd/wire/center_server not "
+                   "dominated by an `enabled` check")
 
     def applies_to(self, path: str) -> bool:
         return path.rsplit("/", 1)[-1] in HOT_BASENAMES
@@ -180,7 +196,8 @@ class TelemetryHotPathChecker(Checker):
             return
         base = ImportResolver.dotted(func.value)
         resolved_base = sf.resolver.resolve(func.value)
-        is_handle = (base in handles) or (resolved_base == TELEMETRY_MODULE)
+        is_handle = (base in handles) or \
+            (resolved_base in (TELEMETRY_MODULE, TRACING_MODULE))
         if is_handle:
             findings.append(Finding(
                 self.name, sf.path, node.lineno, node.col_offset,

@@ -87,10 +87,11 @@ def _cached_run(root, paths, only, disable, cache_dir=None):
         from .checkers.schema_drift import (CHAOS_PATH, DEVPROF_PATH,
                                             MEMBERSHIP_PATH, RECORDER_PATH,
                                             REPORT_PATH, SENTRY_PATH,
-                                            TELEMETRY_PATH, WIRE_PATH)
+                                            TELEMETRY_PATH, TRACING_PATH,
+                                            WIRE_PATH)
         for probe in (RECORDER_PATH, TELEMETRY_PATH, DEVPROF_PATH,
                       SENTRY_PATH, REPORT_PATH, MEMBERSHIP_PATH,
-                      CHAOS_PATH, WIRE_PATH):
+                      CHAOS_PATH, WIRE_PATH, TRACING_PATH):
             if probe not in lint_rels and \
                     os.path.exists(os.path.join(root, probe)):
                 rels = list(rels) + [probe]

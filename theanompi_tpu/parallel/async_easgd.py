@@ -62,6 +62,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..utils import tracing
 from .mesh import WORKER_AXIS
 
 
@@ -82,7 +83,10 @@ class ElasticCenter:
         self.alpha = float(alpha)
         self._leaves: Optional[List[np.ndarray]] = None
         self._treedef = None
-        self._lock = threading.Lock()
+        # REENTRANT: the center server's handler takes this lock FIRST to
+        # measure queue wait (lock wait = center queueing, §17 time
+        # split), then calls the op, which re-enters it for free
+        self._lock = threading.RLock()
         self.n_updates = 0            # exchanges absorbed (all islands)
         self.updates_by_island: Dict[int, int] = {}
         # elastic membership (parallel/membership.py): a demoted island's
@@ -125,7 +129,13 @@ class ElasticCenter:
 
     # -- pytree interface (in-process islands) -----------------------------
 
-    def ensure_init(self, params) -> None:
+    # ``trace`` mirrors RemoteCenter's surface (IslandRunner passes its
+    # round-span context to whichever center it holds); the in-process
+    # store has no wire to propagate it over, so it is accepted and
+    # ignored — the round's critical path then shows zero wire time,
+    # which is the truth.
+
+    def ensure_init(self, params, trace=None) -> None:
         """Lazy init from the first island to arrive — all islands share the
         model seed, so their initial params (and hence the center) agree;
         avoids building a throwaway probe model just to read its params."""
@@ -136,7 +146,7 @@ class ElasticCenter:
             if self._treedef is None:     # a remote client may have seeded
                 self._treedef = treedef   # the leaves before any local tree
 
-    def pull(self):
+    def pull(self, trace=None):
         with self._lock:
             assert self._leaves is not None, "center not initialized yet"
             assert self._treedef is not None, \
@@ -144,11 +154,11 @@ class ElasticCenter:
             return jax.tree.unflatten(self._treedef,
                                       [np.array(x) for x in self._leaves])
 
-    def push_delta(self, delta_mean, island: int) -> None:
+    def push_delta(self, delta_mean, island: int, trace=None) -> None:
         """center += α·mean_i delta_i for one island's workers."""
         self.push_delta_leaves(jax.tree.leaves(delta_mean), island)
 
-    def push_pull(self, delta_mean, island: int):
+    def push_pull(self, delta_mean, island: int, trace=None):
         """ASGD downpour round-trip (≙ the reference server absorbing a
         worker's accumulated gradients and replying with fresh params):
         center += mean_i delta_i, return the new center — one atomic op."""
@@ -323,22 +333,63 @@ class IslandRunner(threading.Thread):
         # and erased from the center
         anchor = self.center.pull() if self.rule == "asgd" else None
 
+        # causal tracing (docs/design.md §17): one trace per exchange
+        # round — minted at the round's first local step, ended after its
+        # exchange.  The round span's context rides the wire into the
+        # center's handler span, so the report can join client and server
+        # sides and split the round into compute|stage|wire|queue|apply.
+        # ONE `enabled` check per site; disabled tracing costs nothing.
+        tr = tracing.active()
+        from ..utils import telemetry
+        tm = telemetry.active()
+        rec = None
+        if tr.enabled or tm.enabled:
+            # a real recorder under the island steps: train_iter brackets
+            # load/stage/train, giving the round span a MEASURED stage_s
+            # (data wait + host staging — without it a staging-starved
+            # island would be misattributed to 'compute' in the §17
+            # root-cause table) and, with telemetry on, the phase.train
+            # events the windowed straggler ranking reads
+            from ..utils.recorder import Recorder
+            rec = Recorder({"verbose": False, "rank": self.island_id})
+            rec.telemetry = tm
+        rnd = None
+        stage_base = 0.0
         count = 0
         while not self.stop_event.is_set():
             count += 1
-            model.train_iter(count, None)
+            if rnd is None and tr.enabled:
+                rnd = tr.begin("round", island=self.island_id, count=count)
+                if rec is not None:
+                    stage_base = rec.t_sec_total["load"] + \
+                        rec.t_sec_total["stage"]
+            model.train_iter(count, rec)
             self.steps_done += 1
             if self.lease is not None:
                 self.lease.beat(self.steps_done)
             if self.throttle_s:
                 time.sleep(self.throttle_s)
             if count % self.sync_freq == 0:
+                ctx = None
+                if rnd is not None:
+                    # local-step wall time — the round residual beyond
+                    # stage and the wire ops is compute; stage_s is the
+                    # MEASURED data-wait + host-staging time of this
+                    # round's steps (recorder load+stage buckets)
+                    rnd.note(train_s=round(time.time() - rnd.t0, 6),
+                             steps=self.sync_freq, rule=self.rule)
+                    if rec is not None:
+                        rnd.note(stage_s=round(
+                            rec.t_sec_total["load"] +
+                            rec.t_sec_total["stage"] - stage_base, 6))
+                    ctx = rnd.ctx()
                 # A center outage mid-run is SURVIVABLE: the island skips
                 # the exchange and keeps training locally (the EASGD/ASGD
                 # algebra tolerates missed exchanges by design) — the next
                 # successful pull/push_pull resyncs it against whatever
                 # the center became (restored from snapshot, advanced by
                 # the other islands) while the supervisor respawns it.
+                outcome = "exchanged"
                 try:
                     if self.rule == "asgd":
                         if anchor is None:
@@ -351,7 +402,7 @@ class IslandRunner(threading.Thread):
                             # current center and restart the local
                             # accumulation (the abandoned round is a
                             # missed exchange, which downpour absorbs).
-                            anchor = self.center.pull()
+                            anchor = self.center.pull(trace=ctx)
                             _set_params_from(anchor)
                         else:
                             mean_p = jax.device_get(mean_fn(
@@ -359,24 +410,24 @@ class IslandRunner(threading.Thread):
                             delta = jax.tree.map(np.subtract, mean_p,
                                                  anchor)
                             anchor = self.center.push_pull(
-                                delta, self.island_id)
+                                delta, self.island_id, trace=ctx)
                             _set_params_from(anchor)
                     else:
-                        center = self.center.pull()
+                        center = self.center.pull(trace=ctx)
                         new_params, delta_mean = elastic_fn(
                             model.step_state["params"], center)
                         model.step_state["params"] = new_params
                         self.center.push_delta(jax.device_get(delta_mean),
-                                               self.island_id)
+                                               self.island_id, trace=ctx)
                     self.exchanges_done += 1
                 except WireGiveUp:
+                    outcome = "skipped"
                     self.exchanges_skipped += 1
                     if self.rule == "asgd":
                         # the in-flight push_pull's fate is UNKNOWN (it
                         # may have landed, reply lost): the anchor can no
                         # longer be trusted — mark it for resync above
                         anchor = None
-                    from ..utils import telemetry
                     tm = telemetry.active()
                     if tm.enabled:
                         tm.counter("wire.exchange_skipped")
@@ -387,8 +438,8 @@ class IslandRunner(threading.Thread):
                     # lost center history is a missed exchange, which the
                     # async algebra absorbs.  Crashing here instead would
                     # cascade into the world restart the design forbids.
+                    outcome = "reseeded"
                     self.exchanges_skipped += 1
-                    from ..utils import telemetry
                     tm = telemetry.active()
                     if tm.enabled:
                         tm.counter("wire.center_reseed")
@@ -400,6 +451,9 @@ class IslandRunner(threading.Thread):
                             anchor = self.center.pull()
                     except (WireGiveUp, CenterUninitialized):
                         pass           # next exchange gets another shot
+                if rnd is not None:
+                    rnd.end(outcome=outcome)
+                    rnd = None
 
 
 class AsyncEASGDTrainer:

@@ -59,9 +59,9 @@ from .wire import (ConnectionClosed, CorruptPayload, DedupWindow,
                    pack_leaves, unpack_leaves)
 
 try:
-    from ..utils import telemetry
+    from ..utils import telemetry, tracing
 except ImportError:        # file-path load (jax-free tooling): absolute
-    from theanompi_tpu.utils import telemetry
+    from theanompi_tpu.utils import telemetry, tracing
 
 # back-compat aliases — the framing now lives in parallel/wire.py
 _pack_leaves = pack_leaves
@@ -275,6 +275,43 @@ class CenterServer:
             def _dispatch(self, header, body):
                 op = header.get("op")
                 tok = header.get("tok")
+                trc = header.get("trace")     # v2 causal-tracing context
+                t_acc = time.time()           # request accepted (parsed)
+
+                def reply(hdr, rbody=b"", srv=None, dedup_reply=False):
+                    """Send one reply; when the request carried trace
+                    context, stamp the server's ``center.<op>`` span into
+                    the telemetry stream (parented to the client's
+                    ``wire.<op>`` span — the cross-process join).  A
+                    deduplicated twin is tagged so the trace assembly
+                    never double-counts it on the critical path."""
+                    h = dict(hdr)
+                    if srv is not None:
+                        h["srv"] = srv
+                    wire.send_msg(self.request, h, rbody)
+                    tm = telemetry.active()
+                    if trc and tm.enabled:
+                        tracing.emit_server_span(
+                            tm, trc, str(op), t0=t_acc,
+                            dt=time.time() - t_acc,
+                            q=(srv or {}).get("q"), a=(srv or {}).get("a"),
+                            island=header.get("island"),
+                            dedup=dedup_reply, ok=bool(h.get("ok")))
+
+                def timed(fn):
+                    """Run ``fn`` under the center lock, splitting server
+                    time into ``q`` (lock wait — the center serializes
+                    every client here, so lock wait IS the center queue)
+                    and ``a`` (the apply under the lock).  The center's
+                    own methods re-enter the RLock for free."""
+                    t_q = time.time()
+                    with center._lock:
+                        q = time.time() - t_q
+                        t_a = time.time()
+                        out = fn()
+                        return out, {"q": round(q, 6),
+                                     "a": round(time.time() - t_a, 6)}
+
                 if op in ("push", "push_pull"):
                     dup, cached = dedup.check(tok, op)
                     if dup:
@@ -283,25 +320,31 @@ class CenterServer:
                             # handler thread — it may yet FAIL and release
                             # the claim, so the twin must not be acked:
                             # tell the client to retry the same token
-                            wire.send_msg(self.request,
-                                          {"ok": False, "retry": True,
-                                           "busy": True,
-                                           "error": "request in flight — "
-                                                    "retry"})
+                            reply({"ok": False, "retry": True,
+                                   "busy": True,
+                                   "error": "request in flight — retry"},
+                                  dedup_reply=True)
                             return
                         # a retry of a request that already LANDED: reply
-                        # without reapplying — exactly-once application
-                        hdr = cached[0] if cached is not None \
-                            else {"ok": True, "dedup": True}
+                        # without reapplying — exactly-once application.
+                        # The dedup marker rides the reply so the CLIENT
+                        # side (a retry whose original landed) tags its
+                        # span too; a chaos-proxy duplicate's twin reply
+                        # is swallowed by the proxy, and only this
+                        # server-side tag remains — which is the one the
+                        # critical path reads.
+                        hdr = dict(cached[0]) if cached is not None \
+                            else {"ok": True}
+                        hdr["dedup"] = True
                         if cached is not None and cached[1] is not None:
-                            wire.send_msg(self.request, hdr, cached[1])
+                            reply(hdr, cached[1], dedup_reply=True)
                         elif op == "push":
-                            wire.send_msg(self.request, hdr)
+                            reply(hdr, dedup_reply=True)
                         else:
                             # push_pull replay: the CURRENT center is the
                             # synthesized body — a valid (fresher) anchor
-                            wire.send_msg(self.request, hdr,
-                                          pack_leaves(center.pull_leaves()))
+                            reply(hdr, pack_leaves(center.pull_leaves()),
+                                  dedup_reply=True)
                         return
                 if op in ("pull", "push", "push_pull") and \
                         center._leaves is None:
@@ -310,56 +353,54 @@ class CenterServer:
                     # and carry on) instead of an opaque assertion repr
                     if op in ("push", "push_pull"):
                         dedup.release(tok, op)     # claim withdrawn
-                    wire.send_msg(self.request,
-                                  {"ok": False, "uninit": True,
-                                   "error": "center not initialized (no "
-                                            "snapshot survived?) — "
-                                            "re-seed with ensure_init"})
+                    reply({"ok": False, "uninit": True,
+                           "error": "center not initialized (no "
+                                    "snapshot survived?) — "
+                                    "re-seed with ensure_init"})
                     return
                 try:
                     if op == "init":
-                        center.ensure_init_leaves(unpack_leaves(body))
-                        wire.send_msg(self.request, {"ok": True})
+                        leaves_in = unpack_leaves(body)
+                        _, srv = timed(
+                            lambda: center.ensure_init_leaves(leaves_in))
+                        reply({"ok": True}, srv=srv)
                     elif op == "pull":
-                        wire.send_msg(self.request, {"ok": True},
-                                      pack_leaves(center.pull_leaves()))
+                        leaves, srv = timed(center.pull_leaves)
+                        reply({"ok": True}, pack_leaves(leaves), srv=srv)
                     elif op == "push":
-                        center.push_delta_leaves(unpack_leaves(body),
-                                                 int(header["island"]))
-                        reply = {"ok": True}
-                        dedup.record(tok, op, reply)
-                        wire.send_msg(self.request, reply)
+                        leaves_in = unpack_leaves(body)
+                        _, srv = timed(lambda: center.push_delta_leaves(
+                            leaves_in, int(header["island"])))
+                        dedup.record(tok, op, {"ok": True, "srv": srv})
+                        reply({"ok": True}, srv=srv)
                     elif op == "push_pull":
-                        leaves = center.push_pull_leaves(
-                            unpack_leaves(body), int(header["island"]))
-                        reply = {"ok": True}
+                        leaves_in = unpack_leaves(body)
+                        leaves, srv = timed(lambda: center.push_pull_leaves(
+                            leaves_in, int(header["island"])))
                         # record the token but not the (model-sized) body:
                         # a replay is answered with the CURRENT center,
                         # which the downpour algebra accepts as its fresh
                         # anchor — exactly-once application is what matters
-                        dedup.record(tok, op, reply, reply_body=None)
-                        wire.send_msg(self.request, reply,
-                                      pack_leaves(leaves))
+                        dedup.record(tok, op, {"ok": True, "srv": srv},
+                                     reply_body=None)
+                        reply({"ok": True}, pack_leaves(leaves), srv=srv)
                     elif op == "demote":
                         # elastic membership (parallel/membership.py):
                         # further pushes from this island are dropped
                         center.demote_island(int(header["island"]))
-                        wire.send_msg(self.request, {"ok": True})
+                        reply({"ok": True})
                     elif op == "readmit":
                         center.readmit_island(int(header["island"]))
-                        wire.send_msg(self.request, {"ok": True})
+                        reply({"ok": True})
                     elif op == "stats":
                         # hwm_snapshot: another handler thread may be
                         # mid-record — a bare dict(dedup.seq_hwm) races
-                        wire.send_msg(
-                            self.request,
-                            {"ok": True, **center.stats_snapshot(),
-                             "dedup_hits": dedup.hits,
-                             "seq_hwm": dedup.hwm_snapshot()})
+                        reply({"ok": True, **center.stats_snapshot(),
+                               "dedup_hits": dedup.hits,
+                               "seq_hwm": dedup.hwm_snapshot()})
                     else:
-                        wire.send_msg(self.request,
-                                      {"ok": False,
-                                       "error": f"unknown op {op!r}"})
+                        reply({"ok": False,
+                               "error": f"unknown op {op!r}"})
                 except Exception:
                     if op in ("push", "push_pull"):
                         dedup.release(tok, op)   # failed: claim withdrawn
@@ -436,39 +477,47 @@ class RemoteCenter:
                                 deadline_s=deadline_s,
                                 telemetry_=telemetry_)
 
-    def _roundtrip(self, header: dict, body: bytes = b"") -> Tuple[dict, bytes]:
-        return self._wire.request(header, body)
+    def _roundtrip(self, header: dict, body: bytes = b"",
+                   trace: Optional[dict] = None) -> Tuple[dict, bytes]:
+        return self._wire.request(header, body, trace=trace)
 
     def _leaves(self, tree) -> Tuple[List[np.ndarray], object]:
         import jax
         leaves, treedef = jax.tree.flatten(tree)
         return [np.asarray(x, np.float32) for x in leaves], treedef
 
-    def ensure_init(self, params) -> None:
-        leaves, self._treedef = self._leaves(params)
-        self._roundtrip({"op": "init"}, pack_leaves(leaves))
+    # ``trace`` on every op: the caller's span context (Span.ctx()) —
+    # propagated through the wire header so the server's handler span
+    # joins the client's round (docs/design.md §17).  None (the default,
+    # and the whole surface pre-v2) traces nothing.
 
-    def pull(self):
+    def ensure_init(self, params, trace: Optional[dict] = None) -> None:
+        leaves, self._treedef = self._leaves(params)
+        self._roundtrip({"op": "init"}, pack_leaves(leaves), trace=trace)
+
+    def pull(self, trace: Optional[dict] = None):
         import jax
-        _, body = self._roundtrip({"op": "pull"})
+        _, body = self._roundtrip({"op": "pull"}, trace=trace)
         leaves = unpack_leaves(body)
         assert self._treedef is not None, "pull before ensure_init"
         return jax.tree.unflatten(self._treedef, leaves)
 
-    def pull_leaves(self) -> List[np.ndarray]:
-        _, body = self._roundtrip({"op": "pull"})
+    def pull_leaves(self, trace: Optional[dict] = None) -> List[np.ndarray]:
+        _, body = self._roundtrip({"op": "pull"}, trace=trace)
         return unpack_leaves(body)
 
-    def push_delta(self, delta_mean, island: int) -> None:
+    def push_delta(self, delta_mean, island: int,
+                   trace: Optional[dict] = None) -> None:
         leaves, _ = self._leaves(delta_mean)
         self._roundtrip({"op": "push", "island": island},
-                        pack_leaves(leaves))
+                        pack_leaves(leaves), trace=trace)
 
-    def push_pull(self, delta_mean, island: int):
+    def push_pull(self, delta_mean, island: int,
+                  trace: Optional[dict] = None):
         import jax
         leaves, _ = self._leaves(delta_mean)
         _, body = self._roundtrip({"op": "push_pull", "island": island},
-                                  pack_leaves(leaves))
+                                  pack_leaves(leaves), trace=trace)
         assert self._treedef is not None, "push_pull before ensure_init"
         return jax.tree.unflatten(self._treedef, unpack_leaves(body))
 
@@ -527,8 +576,13 @@ def center_main(argv: Optional[List[str]] = None) -> int:
                     help="self-terminate after this long (0 = forever)")
     args = ap.parse_args(argv)
 
+    # flush_every=2: the center emits low-rate, high-value events (server
+    # spans, dedup audits) and dies by SIGKILL in the chaos gates — a
+    # 64-event write buffer would lose the very spans the trace assembly
+    # joins (≥95% join-rate acceptance, docs/design.md §17)
     tm = telemetry.init({"record_dir": args.record_dir,
-                         "rank": -1, "run_id": args.run_id}) \
+                         "rank": -1, "run_id": args.run_id,
+                         "telemetry_flush_every": 2}) \
         if args.record_dir else telemetry.active()
 
     srv = CenterServer(alpha=args.alpha, snapshot_dir=args.snapshot_dir,
@@ -539,6 +593,18 @@ def center_main(argv: Optional[List[str]] = None) -> int:
     print(f"center: serving on {host}:{port} "
           f"({'restored from snapshot' if restored else 'fresh'})",
           file=sys.stderr, flush=True)
+
+    statusz = None
+    if args.record_dir:
+        # live ops endpoint (docs/design.md §17): health/uptime/last-N
+        # queries over the wire framing; scripts/fleetz.py aggregates
+        statusz = tracing.StatuszServer(
+            "center", ident=args.lease_id, run_dir=args.record_dir,
+            telemetry_=tm,
+            extra=lambda: {"n_updates": srv.center.n_updates,
+                           "dedup_hits": srv.dedup.hits,
+                           "addr": f"{host}:{port}"})
+        statusz.start()
 
     lease = None
     if args.lease_dir:
@@ -559,6 +625,8 @@ def center_main(argv: Optional[List[str]] = None) -> int:
         if args.max_seconds and time.time() - t0 > args.max_seconds:
             break
     srv.stop(final_snapshot=True)
+    if statusz is not None:
+        statusz.stop()
     if lease is not None:
         lease.release()
     if tm.enabled:

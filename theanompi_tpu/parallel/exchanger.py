@@ -57,7 +57,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from . import steps
 from ..jax_compat import shard_map
-from ..utils import devprof, telemetry
+from ..utils import devprof, telemetry, tracing
 from .mesh import WORKER_AXIS
 from .strategies import Strategy, get_strategy
 
@@ -289,6 +289,13 @@ class Exchanger:
             # fused: the cadence already ran inside the multi-step dispatch
             return
         tm = telemetry.active()
+        # causal tracing (§17): the sync rules' exchange is in-mesh (no
+        # wire), so its round span has no server join — but it lands in
+        # the same per-rank span stream, so the critical-path table can
+        # name 'compute vs exchange dispatch' for SPMD runs too
+        tr = tracing.active()
+        sp = tr.begin("exchange", count=count,
+                      rule=self.name) if tr.enabled else None
         if recorder:
             recorder.start()
         t0 = time.time() if tm.enabled else 0.0
@@ -311,6 +318,8 @@ class Exchanger:
             # bench's recorder-less loop stays fully asynchronous
             jax.block_until_ready(self.model.step_state["params"])
             recorder.end("comm")
+        if sp is not None:
+            sp.end()
 
 
 class BSP_Exchanger(Exchanger):

@@ -55,9 +55,9 @@ from collections import deque
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 try:
-    from ..utils import telemetry
+    from ..utils import telemetry, tracing
 except ImportError:        # file-path load (jax-free lint probe): absolute
-    from theanompi_tpu.utils import telemetry
+    from theanompi_tpu.utils import telemetry, tracing
 
 # The membership transition vocabulary — consumed by
 # scripts/telemetry_report.py (instant markers in the Perfetto export) and
@@ -513,11 +513,34 @@ class MembershipController:
 
     def straggler_ranking(self) -> List[dict]:
         """The windowed ranking from ``scripts/telemetry_report.py`` over
-        this run's merged per-rank streams (``record_dir``)."""
+        this run's merged per-rank streams (``record_dir``).  When the
+        streams carry causal-tracing ``span`` events (§17), the report's
+        root-cause table is computed from the SAME parse and stashed for
+        :meth:`check_stragglers` to cite in its demote events."""
         mod = _load_report_module()
         if mod is None or not self.record_dir:
             return []
         events = mod.load_events(self.record_dir)
+        rc_fn = getattr(mod, "straggler_root_cause", None)
+        if rc_fn is not None:
+            try:
+                # RECENT windows only: the citation must name what
+                # dominated the rounds that are TRIGGERING the demotion,
+                # not the whole run's average (a worker can be compute-
+                # bound for an hour, then queue-bound into its demotion)
+                # — and assembling only the recent slice bounds the
+                # per-poll trace cost on long runs.  The cumulative
+                # ranking below still reads the FULL stream by contract
+                # (windows_straggled/straggle_base count since run
+                # start).
+                horizon = time.time() - \
+                    4 * max(1, self.straggle_windows) * \
+                    self.straggle_window_s
+                recent = [e for e in events
+                          if e.get("ts", 0) >= horizon]
+                self._root_cause = rc_fn(recent, self.straggle_window_s)
+            except Exception:
+                self._root_cause = {}
         return mod.straggler_ranking(events, self.straggle_window_s)
 
     def check_stragglers(self, ranking: Optional[List[dict]] = None
@@ -525,10 +548,17 @@ class MembershipController:
         """Demote every live rank charged ≥ ``straggle_windows`` straggles
         by the windowed ranking (injectable for tests; sourced from the
         telemetry streams otherwise).  Single-rank rankings are ignored —
-        with no peer to compare against, 'slowest' is meaningless."""
+        with no peer to compare against, 'slowest' is meaningless.
+
+        When the run carries distributed traces, each demote event cites
+        the straggler root-cause table's verdict for that worker — WHICH
+        component (compute | stage | wire | queue | apply) dominated its
+        rounds — so 'demoted: straggler' comes with a cause, not just a
+        symptom."""
         ranking = self.straggler_ranking() if ranking is None else ranking
         if len(ranking) < 2:
             return []
+        root_cause = getattr(self, "_root_cause", {}) or {}
         demoted: List[int] = []
         for row in ranking:
             wid = int(row["rank"])
@@ -540,8 +570,10 @@ class MembershipController:
             base = self.workers.get(wid, {}).get("straggle_base", 0)
             if ws - base < self.straggle_windows:
                 continue
+            cause = root_cause.get(wid) or root_cause.get(str(wid)) or {}
             if self.demote(wid, reason="straggler", windows_straggled=ws,
-                           mean_train_secs=row.get("mean_train_secs")):
+                           mean_train_secs=row.get("mean_train_secs"),
+                           component=cause.get("dominant")):
                 self.workers[wid]["straggle_base"] = ws
                 demoted.append(wid)
         return demoted
@@ -796,6 +828,18 @@ class ElasticSupervisor:
         """Run the elastic world until every worker finished (rc 0): 0 — or
         nonzero on breaker trip / restart exhaustion / timeout."""
         t0 = time.time()
+        # live ops endpoint (§17): the supervisor is a long-lived process
+        # too — fleetz shows its view of the fleet next to the workers'
+        statusz = None
+        if self.record_dir:
+            statusz = tracing.StatuszServer(
+                "supervisor", ident=0, run_dir=self.record_dir,
+                telemetry_=self.controller.telemetry,
+                extra=lambda: {"workers": self.controller.status(),
+                               "done": sorted(self.done),
+                               "failed": sorted(self.failed),
+                               "center_downs": self._center_downs})
+            statusz.start()
         if self.center_cmd_for is not None:
             self._spawn_center()
         for wid in self.worker_ids:
@@ -865,6 +909,9 @@ class ElasticSupervisor:
                 time.sleep(self.poll_s)
         finally:
             self._kill_all()
+            if statusz is not None:
+                # exception-unwinding supervisor keeps its roster entry
+                statusz.stop(deregister=sys.exc_info()[0] is None)
 
 
 # -- elastic worker CLI ------------------------------------------------------
@@ -920,6 +967,9 @@ def elastic_worker_main(argv: Optional[Sequence[str]] = None) -> int:
     tm = telemetry.init({"record_dir": cfg.get("record_dir"),
                          "rank": island, "run_id": cfg.get("run_id"),
                          "telemetry": cfg.get("telemetry")})
+    # causal tracing (§17): tracing=true mints a trace per exchange round
+    # in the island loop and propagates it over the wire to the center
+    tracing.init(cfg)
     lease = WorkerLease(cfg["lease_dir"], island, telemetry_=tm) \
         if cfg.get("lease_dir") else None
     if lease:
@@ -955,6 +1005,16 @@ def elastic_worker_main(argv: Optional[Sequence[str]] = None) -> int:
     trainer_cfg.pop("lease_dir", None)
     trainer = AsyncEASGDTrainer(factory, trainer_cfg, rule=rule)
     trainer.start()
+    statusz = None
+    if tm.enabled and cfg.get("record_dir") and cfg.get("statusz", True):
+        statusz = tracing.StatuszServer(
+            "worker", ident=island, run_dir=cfg["record_dir"],
+            telemetry_=tm,
+            extra=lambda: {
+                "steps": trainer.islands[0].steps_done,
+                "exchanges": trainer.islands[0].exchanges_done,
+                "skipped": trainer.islands[0].exchanges_skipped})
+        statusz.start()
     rc = 0
     try:
         while True:
@@ -975,6 +1035,10 @@ def elastic_worker_main(argv: Optional[Sequence[str]] = None) -> int:
         rc = 1
         raise
     finally:
+        if statusz is not None:
+            # a crashed/failed worker keeps its discovery doc: fleetz
+            # must list it DOWN, not lose it from the roster
+            statusz.stop(deregister=(rc == 0))
         if lease:
             if rc == 0:
                 lease.release()

@@ -47,14 +47,23 @@ from collections import OrderedDict
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 try:
-    from ..utils import telemetry
+    from ..utils import telemetry, tracing
 except ImportError:        # file-path load (jax-free lint probe): absolute
-    from theanompi_tpu.utils import telemetry
+    from theanompi_tpu.utils import telemetry, tracing
 
 #: Protocol version stamped into every header.  Bump on any framing or
 #: semantics change; both ends refuse a mismatch loudly (never silently
 #: misparse a peer from another release).
-WIRE_VERSION = 1
+#:
+#: v2 (round 16, docs/design.md §17): requests MAY carry a ``trace``
+#: header field (``{"t": trace_id, "s": span_id}`` — cross-process
+#: causal-tracing context) and replies MAY carry ``srv``
+#: (``{"q": queue_wait_s, "a": apply_s}`` — the server's time split).
+#: Both fields are OPTIONAL within v2: absent ⇒ exactly the v1 behavior,
+#: so tracing can be enabled per-process without config coordination.
+#: The bump marks the header-contract change itself — a v1 peer would
+#: silently drop both fields, and silent is what version checks forbid.
+WIRE_VERSION = 2
 
 # -- telemetry vocabulary (probed live by the schema-drift checker) ----------
 
@@ -68,8 +77,12 @@ WIRE_COUNTERS = ("wire.retry", "wire.timeout", "wire.corrupt",
                  "wire.reconnect", "wire.giveup",
                  "wire.dedup_hit", "wire.exchange_skipped",
                  "wire.center_reseed")
-#: Histograms: per-request round-trip seconds on success.
-WIRE_HISTS = ("wire.rtt",)
+#: Histograms: per-request round-trip seconds on success, plus the
+#: server's reply-header time split (``srv`` field, v2) — queue wait at
+#: the center lock and apply time under it — so client RTT is
+#: decomposable into wire transit vs center queueing vs center apply
+#: even with tracing disabled.
+WIRE_HISTS = ("wire.rtt", "wire.server_queue", "wire.server_apply")
 #: Gauges: seconds the last outage lasted, set when a connection heals —
 #: streamed in a ``gauges`` event so the Perfetto export renders an
 #: outage-duration counter track.
@@ -457,6 +470,8 @@ class WireClient:
         # last (respawns are seconds apart; the counter is per-client)
         self._seq = int(time.time() * 1000)
         self._outage_t0: Optional[float] = None
+        self._last_attempts = 1       # attempts of the LAST request (for
+        # the span's retry count; read under the same lock request holds)
 
     # -- plumbing -----------------------------------------------------------
 
@@ -504,17 +519,52 @@ class WireClient:
     # -- the request loop ---------------------------------------------------
 
     def request(self, header: dict, body: bytes = b"",
-                ) -> Tuple[dict, bytes]:
+                trace: Optional[dict] = None) -> Tuple[dict, bytes]:
         """One request/response round-trip, retried through failures.
 
         Center ops are idempotent under retry BY CONSTRUCTION: the token
         stamped here makes the server's dedup window apply a re-sent
-        mutating op exactly once and replay the original reply."""
+        mutating op exactly once and replay the original reply.
+
+        ``trace`` (optional, v2) is the caller's span context
+        (``Span.ctx()``): ONE ``wire.<op>`` span id is minted here and
+        stamped into the header — every retry of this request re-sends
+        the same ids, so the server spans they produce all join the one
+        client span (and a chaos-duplicated frame's twin is joined too,
+        tagged ``dedup`` server-side).  The span event carries the total
+        dt, the successful attempt's server ``q``/``a`` split, and the
+        retry count; a give-up still ends the span (``ok=false``)."""
         h = dict(header)
+        op = str(header.get("op"))
         with self._lock:
             h["tok"] = {"w": self.client_id, "seq": self._seq}
             self._seq += 1
-            return self._request_locked(h, body)
+            sid = None
+            if trace is not None:
+                sid = tracing.new_span_id()
+                h["trace"] = {"t": trace.get("t"), "s": sid}
+            t_req = time.time()
+            try:
+                resp, rbody = self._request_locked(h, body)
+            except BaseException as e:
+                tm = self._tm()
+                if trace is not None and tm.enabled:
+                    tracing.emit_wire_span(
+                        tm, trace, op, span=sid, t0=t_req,
+                        dt=time.time() - t_req, ok=False,
+                        err=repr(e)[:120],
+                        retries=self._last_attempts - 1)
+                raise
+            if trace is not None:
+                tm = self._tm()
+                if tm.enabled:
+                    srv = resp.get("srv") or {}
+                    tracing.emit_wire_span(
+                        tm, trace, op, span=sid, t0=t_req,
+                        dt=time.time() - t_req, q=srv.get("q"),
+                        a=srv.get("a"), dedup=bool(resp.get("dedup")),
+                        ok=True, retries=self._last_attempts - 1)
+            return resp, rbody
 
     def _request_locked(self, header: dict, body: bytes
                         ) -> Tuple[dict, bytes]:
@@ -523,6 +573,7 @@ class WireClient:
         attempts = 0
         for attempt in range(self.max_retries + 1):
             attempts = attempt + 1
+            self._last_attempts = attempts
             if attempt:
                 self._note_fail("wire.retry")
                 delay = self.backoff.delay(attempt - 1)
@@ -554,6 +605,17 @@ class WireClient:
                     raise RemoteOpError(
                         f"center server error: {resp.get('error')}")
                 self._note_ok(time.time() - t0)
+                srv = resp.get("srv")
+                if srv:
+                    # the v2 reply-header time split: RTT decomposable
+                    # into wire transit vs center queue vs center apply
+                    # even with tracing disabled (§17 satellite)
+                    tm = self._tm()
+                    if tm.enabled:
+                        if srv.get("q") is not None:
+                            tm.observe("wire.server_queue", float(srv["q"]))
+                        if srv.get("a") is not None:
+                            tm.observe("wire.server_apply", float(srv["a"]))
                 return resp, rbody
             except socket.timeout as e:
                 # the reply may still be in flight — the stream is no

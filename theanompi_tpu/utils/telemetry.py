@@ -223,7 +223,10 @@ class Telemetry:
 
     # -- events -------------------------------------------------------------
 
-    def event(self, name: str, ring_only: bool = False, **fields) -> None:
+    def event(self, name: str, /, ring_only: bool = False,
+              **fields) -> None:
+        # ``name`` is positional-ONLY so a field may also be called
+        # "name" (the §17 span events carry one) without colliding
         ev = {"ts": round(time.time(), 3), "run": self.run_id,
               "rank": self.rank, "ev": name}
         ev.update(fields)
@@ -360,7 +363,7 @@ class _Disabled:
     def phase(self, section, dt):
         pass
 
-    def event(self, name, ring_only=False, **fields):
+    def event(self, name, /, ring_only=False, **fields):
         pass
 
     def tail(self, n=8):
@@ -413,7 +416,12 @@ def init(config: Optional[dict] = None):
                             run_id=config.get("run_id"),
                             stream_dir=stream_dir,
                             flight_events=int(config.get(
-                                "telemetry_flight_events", FLIGHT_EVENTS)))
+                                "telemetry_flight_events", FLIGHT_EVENTS)),
+                            # low-rate emitters that die by SIGKILL (the
+                            # center process) flush eagerly so their
+                            # span/audit tail survives the kill
+                            flush_every=int(config.get(
+                                "telemetry_flush_every", 64)))
         else:
             new = DISABLED
     old, _ACTIVE = _ACTIVE, new

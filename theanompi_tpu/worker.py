@@ -20,7 +20,7 @@ from typing import Optional
 
 from .base import MeshProcess
 from .parallel.exchanger import get_exchanger
-from .utils import devprof, telemetry
+from .utils import devprof, telemetry, tracing
 from .utils.recorder import Recorder
 from .utils.sentry import TrainingSentry
 from .utils.watchdog import StallWatchdog
@@ -46,6 +46,10 @@ class Worker(MeshProcess):
         # set (or telemetry=true for in-memory metrics), else the inert
         # no-op; every component reads telemetry.active() lazily
         self.telemetry = telemetry.init(self.config)
+        # causal tracing (docs/design.md §17): off unless tracing=true —
+        # the exchanger's span stream + the wire propagation both gate on
+        # the ONE tracer `enabled` check
+        self.tracing = tracing.init(self.config)
         self.recorder = Recorder(self.config)
         self.recorder.telemetry = self.telemetry
         self.exchanger = get_exchanger(self.config.get("rule", self.rule),
@@ -172,6 +176,20 @@ class Worker(MeshProcess):
         if telem.enabled and config.get("sentry", True):
             sentry = TrainingSentry(config, telem)
         self.sentry = sentry
+        # live ops endpoint (utils/tracing, docs/design.md §17): a tiny
+        # statusz socket answering health/uptime/current-span/last-events
+        # queries over the wire framing, registered in the run dir so
+        # scripts/fleetz.py can aggregate the whole fleet.  Idle cost is
+        # zero (it only ever reads state other paths already maintain);
+        # statusz=false opts out.
+        statusz = None
+        if telem.enabled and config.get("record_dir") and \
+                config.get("statusz", True):
+            statusz = tracing.StatuszServer(
+                "worker", ident=int(config.get("rank", self.rank)),
+                run_dir=config["record_dir"], telemetry_=telem,
+                tracer_=self.tracing)
+            statusz.start()
 
         def on_stall(elapsed, label):
             StallWatchdog._default_handler(watchdog, elapsed, label)
@@ -288,6 +306,11 @@ class Worker(MeshProcess):
                         raise       # sole failure: surface it
                     print(f"async checkpoint ALSO failed during unwind: "
                           f"{ckpt_exc!r}", file=_sys.stderr, flush=True)
+            if statusz is not None:
+                # only a CLEAN exit deregisters: a crash keeps the
+                # discovery doc so fleetz lists this worker DOWN
+                import sys as _sys2
+                statusz.stop(deregister=_sys2.exc_info()[0] is None)
         if trace_stop_at is not None:   # window outlived training: flush it
             _stop_trace()
         if lease is not None:
