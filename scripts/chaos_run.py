@@ -203,6 +203,12 @@ def main(argv=None):
     ap.add_argument("--faults", default=None,
                     help="explicit schedule: kind@sec:worker[:dur],... "
                          "(worker 0 = the center — implies --center-proc)")
+    ap.add_argument("--faults-from", default=None,
+                    help="replay a REALIZED schedule file "
+                         "(chaos_realized.jsonl from a previous live run, "
+                         "or sim_realized.jsonl from scripts/"
+                         "simfleet_run.py) — process faults go to the "
+                         "monkey, net_* windows to the proxy")
     ap.add_argument("--seed", type=int, default=7,
                     help="seeded random faults when --faults is not given")
     ap.add_argument("--n-faults", type=int, default=1)
@@ -242,13 +248,40 @@ def main(argv=None):
     from theanompi_tpu.parallel.membership import parse_kv, run_elastic
     from theanompi_tpu.utils import chaos
 
-    schedule = chaos.parse_schedule(args.faults) if args.faults else \
-        chaos.seeded_schedule(args.seed,
-                              list(range(1, args.workers + 1)),
-                              n_faults=args.n_faults, t_min=args.t_min,
-                              t_max=args.t_max)
+    replayed = chaos.schedule_from_realized(args.faults_from) \
+        if args.faults_from else None
+    if replayed is not None:
+        # a realized file from a WIDER fleet (a 1,000-worker rehearsal)
+        # targets workers this replay doesn't have — dropping them
+        # silently would report a fault-free run as a faithful replay
+        wide = [f for f in replayed
+                if f.target > args.workers]
+        if wide:
+            print(f"warning: dropping {len(wide)}/{len(replayed)} "
+                  f"realized fault(s) targeting workers beyond "
+                  f"--workers {args.workers} (e.g. {wide[0]!r}) — "
+                  f"export the replay schedule from a sim run at the "
+                  f"live width (simfleet_run.py --workers "
+                  f"{args.workers}), or use --fidelity")
+            replayed = [f for f in replayed if f.target <= args.workers]
+        if not replayed:
+            print("error: nothing left to replay from "
+                  f"{args.faults_from}")
+            return 2
+        # one realized file carries both planes; split by kind
+        schedule = [f for f in replayed
+                    if f.kind not in chaos.NET_FAULT_KINDS]
+    elif args.faults:
+        schedule = chaos.parse_schedule(args.faults)
+    else:
+        schedule = chaos.seeded_schedule(
+            args.seed, list(range(1, args.workers + 1)),
+            n_faults=args.n_faults, t_min=args.t_min, t_max=args.t_max)
     net_schedule = None
-    if args.net_faults:
+    if replayed is not None:
+        net_schedule = [f for f in replayed
+                        if f.kind in chaos.NET_FAULT_KINDS] or None
+    elif args.net_faults:
         net_schedule = chaos.parse_schedule(args.net_faults)
     elif args.net_seed is not None:
         net_schedule = chaos.seeded_schedule(

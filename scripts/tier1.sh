@@ -20,4 +20,12 @@
 # .tpulint_cache/ hit: the gate costs well under a second.
 cd "$(dirname "$0")/.."
 python scripts/lint.py --check-baseline || { echo "tier1: tpulint gate FAILED (run scripts/lint.py for details)" >&2; exit 9; }
+# The simfleet determinism gate (docs/design.md §18): same seed must
+# produce a byte-identical event log, a different seed must not, and a
+# 512-worker invariant suite (kills, wedges, stragglers, net windows
+# through the REAL membership/reactor/dedup logic on a virtual clock)
+# must pass inside a CPU-seconds budget.  No subprocesses, no sockets,
+# no jax execution — it runs before pytest so a broken survivability
+# refactor fails in seconds.
+python scripts/simfleet_run.py --gate --budget 120 || { echo "tier1: simfleet gate FAILED (run scripts/simfleet_run.py --gate for details)" >&2; exit 8; }
 set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=${PIPESTATUS[0]}; echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c); exit $rc

@@ -94,6 +94,63 @@ def test_monkey_delay_hook_and_no_pid_drop():
     assert killf.applied and killf.error == "no-pid"
 
 
+def test_monkey_and_proxy_write_realized_schedule(tmp_path):
+    """Round-17 satellite: every fault that actually LANDS (and every
+    asked-but-missed drop) is appended to the realized-schedule log,
+    and the log parses back into a replayable schedule."""
+    path = str(tmp_path / chaos.REALIZED_SCHEDULE)
+    p = subprocess.Popen(["sleep", "30"])
+    try:
+        monkey = chaos.ChaosMonkey(
+            chaos.parse_schedule("kill@0.05:2,kill@0.1:9"),
+            pid_of=lambda t: p.pid if t == 2 else None,
+            grace_s=0.3, realized_path=path)
+        monkey.start()
+        p.wait(timeout=10)
+        time.sleep(0.6)                      # let the no-pid drop resolve
+        monkey.stop()
+    finally:
+        if p.poll() is None:
+            p.kill()
+    with open(path) as f:
+        docs = [json.loads(ln) for ln in f if ln.strip()]
+    by_target = {d["target"]: d for d in docs}
+    assert by_target[2]["kind"] == "kill" and \
+        by_target[2]["error"] is None and \
+        by_target[2]["source"] == "monkey"
+    assert by_target[9]["error"] == "no-pid"     # the miss is on record
+    # a proxy window-open appends to the SAME log dialect
+    proxy = chaos.ChaosProxy("127.0.0.1:1",
+                             chaos.parse_schedule("net_dup@0:-1:5"),
+                             realized_path=path)
+    proxy._emit(proxy.schedule[0])
+    sched = chaos.schedule_from_realized(path)
+    # errored faults are excluded; landed ones replay at their REAL
+    # relative landing time
+    assert sorted((f.kind, f.target) for f in sched) == \
+        [("kill", 2), ("net_dup", -1)]
+    assert all(0.0 <= f.at < 5.0 for f in sched)
+    dup = [f for f in sched if f.kind == "net_dup"][0]
+    assert chaos.fault_window_active(sched, "net_dup", 3, dup.at + 1.0)
+
+
+def test_fault_window_active_is_the_proxy_rule():
+    sched = chaos.parse_schedule("net_drop@10:-1:5,net_dup@20:3:2")
+    # -1 windows cover every client, incl. identity-unknown (None)
+    assert chaos.fault_window_active(sched, "net_drop", None, 12.0)
+    assert chaos.fault_window_active(sched, "net_drop", 7, 15.0)
+    assert not chaos.fault_window_active(sched, "net_drop", 7, 15.1)
+    # targeted windows cover only their worker, never None
+    assert chaos.fault_window_active(sched, "net_dup", 3, 21.0)
+    assert not chaos.fault_window_active(sched, "net_dup", 4, 21.0)
+    assert not chaos.fault_window_active(sched, "net_dup", None, 21.0)
+    # the live proxy delegates to the same rule
+    proxy = chaos.ChaosProxy("127.0.0.1:1", sched, t0=0.0)
+    proxy.t0 = proxy.clock.now() - 12.0          # "now" is t=12 rel
+    assert proxy._active("net_drop", 7)
+    assert not proxy._active("net_dup", 3)
+
+
 def test_find_child_pid(tmp_path):
     p = subprocess.Popen([sys.executable, "-c",
                           "import time; time.sleep(20)"])

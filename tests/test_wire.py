@@ -272,6 +272,53 @@ def test_dedup_window_claim_record_release_and_hwm():
     assert fresh_r is False                   # the claim did not persist
 
 
+def test_dedup_window_width_stress_eviction_and_hwm_roundtrip():
+    """The 1,000-client width contract (round 17): per-client windows
+    stay depth-bounded under seq churn (memory is O(clients × depth),
+    not O(ops)), HWMs stay exact after eviction, and a snapshot/restore
+    round-trip preserves BOTH the recognized-replay semantics and the
+    fresh-push semantics for every client."""
+    depth = 8
+    win = wire.DedupWindow(depth=depth, telemetry_=telemetry.DISABLED)
+    n_clients, seqs_per = 1000, 40
+    for seq in range(seqs_per):                  # interleaved churn
+        for c in range(n_clients):
+            tok = {"w": f"w{c}", "seq": seq}
+            dup, _ = win.check(tok, "push")
+            assert not dup, (c, seq)
+            win.record(tok, "push", {"ok": True})
+    # bounded memory: every client's window holds exactly `depth` tokens
+    assert len(win._seen) == n_clients
+    assert all(len(w) == depth for w in win._seen.values())
+    # HWMs exact for every client despite eviction of 32/40 tokens
+    assert win.hwm_snapshot() == {f"w{c}": seqs_per - 1
+                                  for c in range(n_clients)}
+    # evicted-but-below-HWM replays still dedup (synthesized reply)...
+    dup, cached = win.check({"w": "w500", "seq": 0}, "push")
+    assert dup and cached is None
+    # ...and cached-window replays return their recorded reply
+    dup, cached = win.check({"w": "w500", "seq": seqs_per - 1}, "push")
+    assert dup and cached == ({"ok": True}, b"")
+    hits_before = win.hits
+    # snapshot/restore round-trip at width
+    win2 = wire.DedupWindow(depth=depth, telemetry_=telemetry.DISABLED)
+    win2.restore(win.snapshot())
+    assert win2.hwm_snapshot() == win.hwm_snapshot()
+    assert win2.hits == hits_before
+    for c in (0, 499, 999):
+        dup, _ = win2.check({"w": f"w{c}", "seq": 0}, "push")      # old
+        assert dup
+        dup, _ = win2.check({"w": f"w{c}", "seq": seqs_per - 1},
+                            "push")                                # cached
+        assert dup
+        fresh, _ = win2.check({"w": f"w{c}", "seq": seqs_per + 7},
+                              "push")                              # fresh
+        assert fresh is False
+        win2.record({"w": f"w{c}", "seq": seqs_per + 7}, "push",
+                    {"ok": True})
+        assert win2.hwm_snapshot()[f"w{c}"] == seqs_per + 7
+
+
 def _raw_push(sock, island, seq, leaves, w="w1", op="push"):
     wire.send_msg(sock, {"op": op, "island": island,
                          "tok": {"w": w, "seq": seq}},

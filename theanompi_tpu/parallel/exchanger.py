@@ -55,7 +55,7 @@ import numpy as np
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
-from . import steps
+from . import steps, topology
 from ..jax_compat import shard_map
 from ..utils import devprof, telemetry, tracing
 from .mesh import WORKER_AXIS
@@ -688,56 +688,14 @@ class GOSGD_Exchanger(Exchanger):
     def extra_specs(self, param_specs):
         return {"alpha": P()}
 
-    @staticmethod
-    def _derangements(n: int, k: int, seed: int = 0x605) -> np.ndarray:
-        """k distinct random derangements of range(n) (static, seeded)."""
-        rng = np.random.RandomState(seed)
-        out, seen = [], set()
-        guard = 0
-        while len(out) < k and guard < 10000:
-            guard += 1
-            p = rng.permutation(n)
-            if n > 1 and (p == np.arange(n)).any():
-                continue
-            key = tuple(p)
-            if key in seen:
-                continue
-            seen.add(key)
-            out.append(p)
-        return np.asarray(out)
-
-    @staticmethod
-    def _iid_maps(n: int, k: int, seed: int = 0x1d1) -> np.ndarray:
-        """k static assignment maps with the reference's iid peer draws:
-        ``maps[k][i]`` is sender i's destination, uniform over the other
-        workers — NOT a bijection, so collisions (in-degree > 1) occur with
-        the same probability as in the reference's independent draws."""
-        if n == 1:
-            return np.zeros((k, 1), dtype=np.int64)   # self is the only peer
-        rng = np.random.RandomState(seed)
-        maps = np.empty((k, n), dtype=np.int64)
-        for m in range(k):
-            draw = rng.randint(0, n - 1, size=n)
-            # uniform over [n]\{i}: shift draws >= i up by one
-            maps[m] = draw + (draw >= np.arange(n))
-        return maps
-
-    @staticmethod
-    def _collision_rounds(dest: np.ndarray) -> list:
-        """Decompose an arbitrary assignment map into in-degree-rank rounds:
-        round r holds the pairs (sender, dest) where sender is destination's
-        r-th inbound.  Each round has unique sources AND unique destinations
-        — a partial permutation one ``lax.ppermute`` can route — and every
-        sender appears in exactly one round."""
-        rounds: list = []
-        seen: dict = {}
-        for i, d in enumerate(dest):
-            r = seen.get(int(d), 0)
-            seen[int(d)] = r + 1
-            while len(rounds) <= r:
-                rounds.append([])
-            rounds[r].append((i, int(d)))
-        return rounds
+    # The routing-table algebra is jax-free seeded numpy, shared with the
+    # simfleet width rehearsal — ONE implementation (parallel/topology.py)
+    # generates the tables both the traced ppermute branches here and the
+    # 1,000-worker virtual fleet route by.  Kept as staticmethods: tests
+    # and scripts/gosgd_mixing.py address them through the class.
+    _derangements = staticmethod(topology.derangements)
+    _iid_maps = staticmethod(topology.iid_maps)
+    _collision_rounds = staticmethod(topology.collision_rounds)
 
     def has_exchange(self) -> bool:
         return True
@@ -761,17 +719,11 @@ class GOSGD_Exchanger(Exchanger):
         if self.peers_mode == "perm":
             sub_perms = self._derangements(m, self.n_perms,
                                            seed=0x605 + self.family_seed)
-            perms = np.tile(np.arange(n), (len(sub_perms), 1))
-            for r, sp in enumerate(sub_perms):
-                for i, a in enumerate(active):
-                    perms[r][a] = active[int(sp[i])]
+            perms = topology.embed_active(sub_perms, active, n)
         elif self.peers_mode == "iid":
             sub_maps = self._iid_maps(m, self.n_perms,
                                       seed=0x1d1 + self.family_seed)
-            iid_maps = np.tile(np.arange(n), (len(sub_maps), 1))
-            for r, sm in enumerate(sub_maps):
-                for i, a in enumerate(active):
-                    iid_maps[r][a] = active[int(sm[i])]
+            iid_maps = topology.embed_active(sub_maps, active, n)
         mode = self.peers_mode
         assert mode in ("perm", "shift", "iid"), (
             f"unknown gosgd_peers={mode!r}; have 'perm', 'shift', 'iid'")

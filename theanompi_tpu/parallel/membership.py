@@ -41,6 +41,13 @@ controller**:
 Module-scope imports are stdlib-only (the tpulint schema-drift checker
 probes the membership event vocabulary from a jax-free process); jax and
 the trainer machinery import lazily inside the worker entry points.
+
+Every time comparison that DECIDES something (lease freshness, backoff
+due-times, crash-loop windows, straggler horizons) goes through the
+injectable clock seam (``utils/clock.py``, docs/design.md §18): real
+runs keep wall time via the :data:`~theanompi_tpu.utils.clock.WALL`
+default, while ``theanompi_tpu.simfleet`` drives this exact state
+machine with a virtual clock at 1,000-worker width.
 """
 
 from __future__ import annotations
@@ -56,8 +63,10 @@ from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 try:
     from ..utils import telemetry, tracing
+    from ..utils.clock import WALL
 except ImportError:        # file-path load (jax-free lint probe): absolute
     from theanompi_tpu.utils import telemetry, tracing
+    from theanompi_tpu.utils.clock import WALL
 
 # The membership transition vocabulary — consumed by
 # scripts/telemetry_report.py (instant markers in the Perfetto export) and
@@ -115,21 +124,24 @@ class WorkerLease:
     write."""
 
     def __init__(self, lease_dir: str, worker_id: int, telemetry_=None,
-                 min_interval_s: float = 2.0):
+                 min_interval_s: float = 2.0, clock=None):
         self.lease_dir = str(lease_dir)
         self.worker_id = int(worker_id)
         self.telemetry = telemetry_ if telemetry_ is not None \
             else telemetry.active()
         self.min_interval_s = float(min_interval_s)
+        self.clock = clock or WALL
         os.makedirs(self.lease_dir, exist_ok=True)
         self._step = 0
-        self._last_write = 0.0
+        # -inf, not 0.0: under a virtual clock the epoch IS ~0, and a
+        # 0.0 sentinel would throttle away the very first beat
+        self._last_write = -float("inf")
 
     def beat(self, step: Optional[int] = None, status: str = "live",
              **extra) -> None:
         if step is not None:
             self._step = int(step)
-        now = time.time()
+        now = self.clock.now()
         if status == "live" and not extra and \
                 now - self._last_write < self.min_interval_s:
             return
@@ -164,16 +176,25 @@ class Backoff:
     """Bounded exponential backoff + jitter (the bench probe-recovery
     pattern, PR 2): ``base·factor^attempt`` capped at ``cap``, scaled by a
     uniform ``1 ± jitter`` draw so fleet-mates restarting against the same
-    dead resource don't retry in lockstep."""
+    dead resource don't retry in lockstep.
+
+    The jitter draw is reproducible two ways: ``seed`` makes this
+    instance's stream deterministic on its own, and ``rng`` injects a
+    SHARED ``random.Random`` so a whole rehearsal (simfleet, the chaos
+    tests) draws every respawn delay from one seeded stream.  Default
+    (neither): a fresh unseeded stream — behavior unchanged."""
 
     def __init__(self, base: float = 1.0, factor: float = 2.0,
-                 cap: float = 30.0, jitter: float = 0.25, seed=None):
+                 cap: float = 30.0, jitter: float = 0.25, seed=None,
+                 rng=None):
         import random
         self.base = float(base)
         self.factor = float(factor)
         self.cap = float(cap)
         self.jitter = float(jitter)
-        self._rng = random.Random(seed)
+        assert rng is None or seed is None, \
+            "Backoff takes seed= OR rng=, not both"
+        self._rng = rng if rng is not None else random.Random(seed)
 
     def delay(self, attempt: int) -> float:
         d = min(self.base * (self.factor ** max(0, int(attempt))), self.cap)
@@ -187,13 +208,15 @@ class CrashLoopBreaker:
     the breaker trips; the caller exits nonzero with the flight-recorder
     tail printed."""
 
-    def __init__(self, limit: int = 5, window_s: float = 300.0):
+    def __init__(self, limit: int = 5, window_s: float = 300.0,
+                 clock=None):
         self.limit = int(limit)
         self.window_s = float(window_s)
+        self.clock = clock or WALL
         self._times: deque = deque()
 
     def record_failure(self, now: Optional[float] = None) -> bool:
-        now = time.time() if now is None else now
+        now = self.clock.now() if now is None else now
         self._times.append(now)
         while self._times and now - self._times[0] > self.window_s:
             self._times.popleft()
@@ -390,8 +413,17 @@ class MembershipController:
                  record_dir: Optional[str] = None,
                  straggle_windows: int = 3,
                  straggle_window_s: float = 5.0,
-                 min_active: int = 1):
+                 min_active: int = 1, clock=None,
+                 lease_source: Optional[Callable[[], Dict[int, dict]]]
+                 = None):
         self.lease_dir = lease_dir
+        # ``lease_source`` overrides WHERE lease docs come from, not what
+        # they mean: poll() folds whatever mapping it returns with the
+        # exact file-dir semantics.  simfleet feeds an in-memory table so
+        # 1,000 virtual workers heartbeat without 1,000 files; real runs
+        # leave it None and read lease_dir.
+        self.lease_source = lease_source
+        self.clock = clock or WALL
         self.lease_timeout = float(lease_timeout)
         self.telemetry = telemetry_ if telemetry_ is not None \
             else telemetry.active()
@@ -422,22 +454,24 @@ class MembershipController:
     # -- explicit transitions (supervisor / in-mesh callers) ----------------
 
     def join(self, worker: int, pid: Optional[int] = None,
-             reason: str = "spawn") -> None:
+             reason: str = "spawn", now: Optional[float] = None) -> None:
         st = self._entry(worker)
         rejoin = st["joins"] > 0
-        st.update(status="live", last_ts=time.time(), pid=pid,
-                  joins=st["joins"] + 1)
+        st.update(status="live",
+                  last_ts=self.clock.now() if now is None else now,
+                  pid=pid, joins=st["joins"] + 1)
         self._emit("worker_join", worker, "on_join",
                    reason=reason, rejoin=rejoin, pid=pid)
 
-    def leave(self, worker: int, reason: str = "exit", **info) -> None:
+    def leave(self, worker: int, reason: str = "exit",
+              now: Optional[float] = None, **info) -> None:
         st = self._entry(worker)
         if st["status"] in ("dead", "left"):
             return
         st["status"] = "left" if reason == "finished" else "dead"
         # lease docs written BEFORE this death must not resurrect the
         # worker (a killed process's last beat can still be 'fresh')
-        st["dead_ts"] = time.time()
+        st["dead_ts"] = self.clock.now() if now is None else now
         self._emit("worker_leave", worker, "on_leave", reason=reason, **info)
 
     def demote(self, worker: int, reason: str = "straggler", **info) -> bool:
@@ -456,6 +490,13 @@ class MembershipController:
         if st["status"] != "demoted":
             return
         st["status"] = "live"
+        # readmission forgives history: the cumulative ranking kept
+        # charging this worker while it was demoted, so the NEXT
+        # check_stragglers must re-baseline before judging it — without
+        # this a readmitted worker is instantly re-demoted on stale
+        # evidence (flapping, first demonstrated by a 1,000-worker
+        # simfleet rehearsal)
+        st["straggle_forgive"] = True
         self._emit("worker_join", worker, "on_readmit",
                    reason=reason, rejoin=True, pid=st.get("pid"))
 
@@ -483,21 +524,24 @@ class MembershipController:
         a clean finish; a lease older than ``lease_timeout`` is a death —
         covers both crashed AND wedged (SIGSTOPped) workers, which stop
         beating without exiting.  Returns the transitions this poll made."""
-        if not self.lease_dir:
+        if not (self.lease_dir or self.lease_source):
             return []
-        now = time.time() if now is None else now
+        now = self.clock.now() if now is None else now
+        leases = self.lease_source() if self.lease_source is not None \
+            else read_leases(self.lease_dir)
         before = len(self.transitions)
-        for wid, doc in sorted(read_leases(self.lease_dir).items()):
+        for wid, doc in sorted(leases.items()):
             st = self.workers.get(wid)
             fresh = now - float(doc.get("ts", 0)) <= self.lease_timeout
             if doc.get("status") == "left":
                 if st is not None and st["status"] in ("live", "demoted"):
-                    self.leave(wid, reason="finished")
+                    self.leave(wid, reason="finished", now=now)
                 continue
             if st is None or st["status"] in ("dead", "left", "new"):
                 if fresh and (st is None or
                               float(doc.get("ts", 0)) > st.get("dead_ts", 0)):
-                    self.join(wid, pid=doc.get("pid"), reason="lease")
+                    self.join(wid, pid=doc.get("pid"), reason="lease",
+                              now=now)
                 continue
             if fresh:
                 st["last_ts"] = float(doc["ts"])
@@ -505,7 +549,7 @@ class MembershipController:
         for wid, st in self.workers.items():
             if st["status"] in ("live", "demoted") and \
                     now - st["last_ts"] > self.lease_timeout:
-                self.leave(wid, reason="lease_expired",
+                self.leave(wid, reason="lease_expired", now=now,
                            age=round(now - st["last_ts"], 1))
         return self.transitions[before:]
 
@@ -533,7 +577,7 @@ class MembershipController:
                 # ranking below still reads the FULL stream by contract
                 # (windows_straggled/straggle_base count since run
                 # start).
-                horizon = time.time() - \
+                horizon = self.clock.now() - \
                     4 * max(1, self.straggle_windows) * \
                     self.straggle_window_s
                 recent = [e for e in events
@@ -567,7 +611,11 @@ class MembershipController:
             # windows straggled SINCE its last demotion, or a readmitted
             # (recovered) worker would be instantly re-demoted forever on
             # the evidence that got it demoted the first time
-            base = self.workers.get(wid, {}).get("straggle_base", 0)
+            st = self.workers.get(wid, {})
+            if st.get("straggle_forgive"):
+                st["straggle_base"] = ws
+                st["straggle_forgive"] = False
+            base = st.get("straggle_base", 0)
             if ws - base < self.straggle_windows:
                 continue
             cause = root_cause.get(wid) or root_cause.get(str(wid)) or {}
@@ -623,20 +671,23 @@ class ElasticSupervisor:
                  center_addr: Optional[str] = None,
                  center_max_restarts: int = 5,
                  center_lease_dir: Optional[str] = None,
-                 verbose: bool = True):
+                 verbose: bool = True, clock=None):
         self.cmd_for = cmd_for
         self.worker_ids = [int(w) for w in worker_ids]
         self.lease_dir = lease_dir
         self.record_dir = record_dir
         self.poll_s = float(poll_s)
+        self.clock = clock or WALL
         self.backoff = backoff or Backoff()
         self.max_restarts = int(max_restarts)
-        self.breaker = CrashLoopBreaker(crash_limit, crash_window_s)
+        self.breaker = CrashLoopBreaker(crash_limit, crash_window_s,
+                                        clock=self.clock)
         self.verbose = verbose
         self.controller = MembershipController(
             lease_dir=lease_dir, lease_timeout=lease_timeout,
             telemetry_=telemetry_, reactors=reactors,
-            record_dir=record_dir, straggle_windows=straggle_windows or 3)
+            record_dir=record_dir, straggle_windows=straggle_windows or 3,
+            clock=self.clock)
         self._straggle_enabled = straggle_windows > 0
         self._straggle_poll_s = float(straggle_poll_s)
         self._last_straggle_check = 0.0
@@ -712,7 +763,7 @@ class ElasticSupervisor:
         center crash-looped past its budget (caller stops the world)."""
         if self.center_cmd_for is None:
             return False
-        now = time.time()
+        now = self.clock.now()
         p = self.center_proc
         # a WEDGED center (alive, not beating — SIGSTOP, hung handler) is
         # as gone as a dead one: kill it, the death branch below respawns
@@ -821,13 +872,13 @@ class ElasticSupervisor:
             return False
         delay = self.backoff.delay(self.attempts[wid] - 1)
         self._log(f"worker {wid} {reason} (rc={rc}); respawn in {delay:.1f}s")
-        self._pending.append((time.time() + delay, wid))
+        self._pending.append((self.clock.now() + delay, wid))
         return False
 
     def run(self, timeout_s: float = 600.0) -> int:
         """Run the elastic world until every worker finished (rc 0): 0 — or
         nonzero on breaker trip / restart exhaustion / timeout."""
-        t0 = time.time()
+        t0 = self.clock.now()
         # live ops endpoint (§17): the supervisor is a long-lived process
         # too — fleetz shows its view of the fleet next to the workers'
         statusz = None
@@ -887,12 +938,12 @@ class ElasticSupervisor:
                 # throttled — the ranking re-reads the whole record_dir,
                 # which grows with the run: not per-0.25s-tick work)
                 if self._straggle_enabled and \
-                        time.time() - self._last_straggle_check > \
+                        self.clock.now() - self._last_straggle_check > \
                         self._straggle_poll_s:
-                    self._last_straggle_check = time.time()
+                    self._last_straggle_check = self.clock.now()
                     self.controller.check_stragglers()
                 # 4. due respawns
-                now = time.time()
+                now = self.clock.now()
                 due = [w for ts, w in self._pending if ts <= now]
                 self._pending = [(ts, w) for ts, w in self._pending
                                  if ts > now]
@@ -901,12 +952,12 @@ class ElasticSupervisor:
                 # 5. exit conditions
                 if len(self.done | self.failed) == len(self.worker_ids):
                     return 0 if not self.failed else 1
-                if time.time() - t0 > timeout_s:
+                if self.clock.now() - t0 > timeout_s:
                     self._log(f"timeout after {timeout_s:.0f}s — "
                               f"stopping the world")
                     self._kill_all()
                     return 1
-                time.sleep(self.poll_s)
+                self.clock.sleep(self.poll_s)
         finally:
             self._kill_all()
             if statusz is not None:
@@ -1151,11 +1202,24 @@ def run_elastic(rule: str, modelfile: str, modelclass: str,
     # wire-level chaos: the proxy sits between the WORKERS and the center
     # (the supervisor's membership ops take the direct road — the faults
     # under test are the training wire's)
+    # every landed fault (process AND wire level) appends to the run's
+    # realized-schedule log — the replay/diff artifact simfleet's
+    # fidelity cross-check consumes.  Truncate any previous run's file:
+    # the writers append, and a merged two-run history would replay
+    # every fault twice
+    realized = os.path.join(record_dir, "chaos_realized.jsonl") \
+        if record_dir else None
+    if realized and os.path.exists(realized):
+        try:
+            os.remove(realized)
+        except OSError:
+            realized = None
     proxy = None
     worker_addr = addr
     if net_chaos_schedule:
         from ..utils.chaos import ChaosProxy
-        proxy = ChaosProxy(addr, net_chaos_schedule, telemetry_=tm)
+        proxy = ChaosProxy(addr, net_chaos_schedule, telemetry_=tm,
+                           realized_path=realized)
         worker_addr = proxy.start()
 
     base_kv = dict(config)
@@ -1183,7 +1247,7 @@ def run_elastic(rule: str, modelfile: str, modelclass: str,
     if chaos_schedule:
         from ..utils.chaos import ChaosMonkey
         monkey = ChaosMonkey(chaos_schedule, pid_of=sup.pid_of,
-                             telemetry_=tm)
+                             telemetry_=tm, realized_path=realized)
         monkey.start()
     try:
         rc = sup.run(timeout_s=timeout_s)

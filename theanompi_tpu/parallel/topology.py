@@ -1,0 +1,103 @@
+"""Peer-routing table algebra — the jax-free half of the GoSGD/mesh
+topology story.
+
+The GoSGD exchanger's routing tables (derangements, iid assignment maps,
+collision-round decomposition) and the elastic active-set embedding are
+pure seeded numpy: nothing about them needs a device, a mesh, or jax.
+Round 17 moves them here so two consumers share ONE implementation:
+
+* :class:`~theanompi_tpu.parallel.exchanger.GOSGD_Exchanger` builds its
+  ``lax.switch``/``lax.ppermute`` branches from these tables (the traced
+  half stays in exchanger.py);
+* ``theanompi_tpu.simfleet`` regenerates the SAME tables under
+  membership churn (the real :class:`~.membership.MeshReactor` driving a
+  simulated exchanger), so gossip-mixing and Σα-conservation claims at
+  1,000-worker width are made about the production routing algebra, not
+  a reimplementation.
+
+Seeds are call-site-owned (exchanger keeps its historical ``0x605`` /
+``0x1d1`` family seeds) and the generator is the frozen-legacy
+``np.random.RandomState``, so tables are reproducible across runs and
+releases — the property both the AOT cache keys and the simfleet
+byte-identical event log rely on.
+"""
+
+from __future__ import annotations
+
+from typing import List, Sequence, Tuple
+
+import numpy as np
+
+
+def derangements(n: int, k: int, seed: int = 0x605) -> np.ndarray:
+    """k distinct random derangements of range(n) (static, seeded).
+
+    Draw-identical to the historical exchanger implementation (same
+    RandomState stream, same rejection rule) — only the bookkeeping is
+    vectorized, because simfleet regenerates these tables on every
+    membership transition of a 1,000-rank mesh."""
+    rng = np.random.RandomState(seed)
+    idx = np.arange(n)
+    out, seen = [], set()
+    guard = 0
+    while len(out) < k and guard < 10000:
+        guard += 1
+        p = rng.permutation(n)
+        if n > 1 and (p == idx).any():
+            continue
+        key = p.tobytes()
+        if key in seen:
+            continue
+        seen.add(key)
+        out.append(p)
+    return np.asarray(out)
+
+
+def iid_maps(n: int, k: int, seed: int = 0x1d1) -> np.ndarray:
+    """k static assignment maps with the reference's iid peer draws:
+    ``maps[k][i]`` is sender i's destination, uniform over the other
+    workers — NOT a bijection, so collisions (in-degree > 1) occur with
+    the same probability as in the reference's independent draws."""
+    if n == 1:
+        return np.zeros((k, 1), dtype=np.int64)   # self is the only peer
+    rng = np.random.RandomState(seed)
+    maps = np.empty((k, n), dtype=np.int64)
+    for m in range(k):
+        draw = rng.randint(0, n - 1, size=n)
+        # uniform over [n]\{i}: shift draws >= i up by one
+        maps[m] = draw + (draw >= np.arange(n))
+    return maps
+
+
+def collision_rounds(dest: np.ndarray) -> List[List[Tuple[int, int]]]:
+    """Decompose an arbitrary assignment map into in-degree-rank rounds:
+    round r holds the pairs (sender, dest) where sender is destination's
+    r-th inbound.  Each round has unique sources AND unique destinations
+    — a partial permutation one ``lax.ppermute`` can route — and every
+    sender appears in exactly one round."""
+    rounds: list = []
+    seen: dict = {}
+    for i, d in enumerate(dest):
+        r = seen.get(int(d), 0)
+        seen[int(d)] = r + 1
+        while len(rounds) <= r:
+            rounds.append([])
+        rounds[r].append((i, int(d)))
+    return rounds
+
+
+def embed_active(sub_tables: np.ndarray, active: Sequence[int],
+                 n: int) -> np.ndarray:
+    """Lift routing tables over the ACTIVE sub-fleet into full-width
+    tables: every inactive rank is a fixed point (``table[r][d] == d`` —
+    its α and replica are untouched until readmission), and the active
+    ranks route among themselves exactly as ``sub_tables`` prescribes
+    over ``range(len(active))``.  This is the elastic-membership
+    embedding the reaction matrix (docs/design.md §14) promises: demote
+    = drop out of the sub-fleet, readmit = regenerate with the rank back
+    in."""
+    act = np.asarray(list(active), dtype=np.int64)
+    tables = np.tile(np.arange(n), (len(sub_tables), 1))
+    if len(sub_tables) and len(act):
+        tables[:, act] = act[np.asarray(sub_tables, dtype=np.int64)]
+    return tables

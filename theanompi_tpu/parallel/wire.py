@@ -48,8 +48,10 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 
 try:
     from ..utils import telemetry, tracing
+    from ..utils.clock import WALL
 except ImportError:        # file-path load (jax-free lint probe): absolute
     from theanompi_tpu.utils import telemetry, tracing
+    from theanompi_tpu.utils.clock import WALL
 
 #: Protocol version stamped into every header.  Bump on any framing or
 #: semantics change; both ends refuse a mismatch loudly (never silently
@@ -445,9 +447,15 @@ class WireClient:
     def __init__(self, addr: str, client_id: Any = None, *,
                  op_timeout_s: float = 20.0, connect_timeout_s: float = 5.0,
                  max_retries: int = 8, deadline_s: float = 120.0,
-                 backoff=None, telemetry_=None):
+                 backoff=None, telemetry_=None, clock=None):
         host, port = str(addr).rsplit(":", 1)
         self.addr = (host, int(port))
+        # retry deadlines, backoff sleeps, outage spans, and the seq seed
+        # are DECISION times — behind the clock seam (utils/clock.py) so
+        # simfleet can rehearse the retry algebra in virtual time.  The
+        # per-request RTT observation stays wall time: it measures the
+        # wire, not a decision.
+        self.clock = clock or WALL
         self.client_id = str(client_id) if client_id is not None else \
             f"c{id(self) & 0xFFFFFF:x}"
         self.op_timeout_s = float(op_timeout_s)
@@ -468,7 +476,7 @@ class WireClient:
         # from 0 would have every push silently deduped as an 'old retry'.
         # Clock-based seeding keeps each incarnation strictly above the
         # last (respawns are seconds apart; the counter is per-client)
-        self._seq = int(time.time() * 1000)
+        self._seq = int(self.clock.now() * 1000)
         self._outage_t0: Optional[float] = None
         self._last_attempts = 1       # attempts of the LAST request (for
         # the span's retry count; read under the same lock request holds)
@@ -496,7 +504,7 @@ class WireClient:
     def _note_ok(self, dt: float) -> None:
         tm = self._tm()
         if self._outage_t0 is not None:
-            outage = time.time() - self._outage_t0
+            outage = self.clock.now() - self._outage_t0
             self._outage_t0 = None
             if tm.enabled:
                 tm.gauge("wire.outage_s", round(outage, 3))
@@ -511,7 +519,7 @@ class WireClient:
 
     def _note_fail(self, counter: Optional[str] = None) -> None:
         if self._outage_t0 is None:
-            self._outage_t0 = time.time()
+            self._outage_t0 = self.clock.now()
         tm = self._tm()
         if counter and tm.enabled:
             tm.counter(counter)
@@ -568,7 +576,7 @@ class WireClient:
 
     def _request_locked(self, header: dict, body: bytes
                         ) -> Tuple[dict, bytes]:
-        t_start = time.time()
+        t_start = self.clock.now()
         last_err: Optional[BaseException] = None
         attempts = 0
         for attempt in range(self.max_retries + 1):
@@ -577,9 +585,9 @@ class WireClient:
             if attempt:
                 self._note_fail("wire.retry")
                 delay = self.backoff.delay(attempt - 1)
-                if time.time() + delay - t_start > self.deadline_s:
+                if self.clock.now() + delay - t_start > self.deadline_s:
                     break
-                time.sleep(delay)
+                self.clock.sleep(delay)
             try:
                 if self._sock is None:
                     self._sock = self._connect()
@@ -636,7 +644,7 @@ class WireClient:
                 last_err = e
                 self._note_fail()
                 self._drop()
-            if time.time() - t_start > self.deadline_s:
+            if self.clock.now() - t_start > self.deadline_s:
                 break
         self._drop()
         tm = self._tm()
@@ -648,7 +656,8 @@ class WireClient:
         raise WireGiveUp(
             f"center {self.addr[0]}:{self.addr[1]} unreachable: gave up "
             f"on op {header.get('op')!r} after {attempts} attempts / "
-            f"{time.time() - t_start:.1f}s (deadline {self.deadline_s:.0f}s)"
+            f"{self.clock.now() - t_start:.1f}s "
+            f"(deadline {self.deadline_s:.0f}s)"
             f" — last error: {last_err!r}")
 
     def close(self) -> None:

@@ -1,0 +1,34 @@
+"""simfleet: a deterministic virtual-time fleet simulator (round 17).
+
+The survivability plane (PRs 8–11) is real code exercised small: ~8
+processes, wall-clock minutes.  simfleet rehearses the SAME code at
+production width — hundreds to thousands of workers, tens of thousands
+of exchange rounds, seconds of CPU — by replacing processes, sockets,
+and sleeps with a seeded discrete-event loop over a virtual clock
+(docs/design.md §18):
+
+* the **real** :class:`~theanompi_tpu.parallel.membership
+  .MembershipController` state machine (lease folding, dead-ts
+  resurrection guard, straggler demotion with the cumulative base),
+* the **real** reactors (:class:`~...membership.CenterReactor` island
+  demote/readmit, :class:`~...membership.MeshReactor` GoSGD derangement
+  regeneration via ``parallel/topology.py``),
+* the **real** :class:`~theanompi_tpu.parallel.wire.DedupWindow`
+  claim/record/HWM semantics and :class:`~...membership.Backoff`,
+* the **real** chaos grammar: ``chaos.parse_schedule`` /
+  ``chaos.seeded_schedule`` faults applied by the proxy's own
+  window-membership rule (``chaos.fault_window_active``).
+
+Same seed ⇒ byte-identical event log (``EventLog.sha256``).  The
+fidelity mode exports the realized simulated schedule and replays it
+through the live ChaosProxy/ChaosMonkey at small scale, asserting the
+same membership-event sequence modulo timing (``simfleet.fidelity``).
+
+Entry points: ``scripts/simfleet_run.py`` (CLI, determinism gate,
+fidelity cross-check) and :class:`simfleet.fleet.FleetSim`.
+"""
+
+from .clock import VirtualClock                              # noqa: F401
+from .events import EventLog, EventQueue                     # noqa: F401
+from .fleet import FleetSim                                  # noqa: F401
+from .invariants import check_invariants                     # noqa: F401

@@ -1,0 +1,170 @@
+"""Fidelity cross-check: the simulator's word, verified against reality.
+
+A simulator that only agrees with itself proves nothing.  This module
+closes the loop at small scale: run a schedule through :class:`FleetSim`,
+export the *realized* schedule (the same jsonl dialect the live
+harness's ``chaos_realized.jsonl`` speaks), replay it through the REAL
+elastic runtime — actual worker subprocesses, the actual
+ChaosMonkey/ChaosProxy — and assert both worlds produced the same
+*membership-event sequence* per worker, modulo timing:
+
+    join ( death rejoin )* finish        for a worker the schedule kills
+    join finish                          for one it leaves alone
+
+Event kinds and reasons are normalized (``crashed``/``wedged``/
+``lease_expired`` are all a *death*; ``respawn``/``lease`` rejoins are
+one *rejoin*) because WHICH detector fires first is timing, while THAT
+a kill produces exactly one death and one supervised rejoin is the
+contract under test.
+
+This is deliberately cheap (4 workers, one schedule) and sits next to
+the width rehearsal: simfleet argues at 1,000 workers, fidelity argues
+the simulator tells the truth at 4.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+from typing import Dict, List, Sequence
+
+try:
+    from ..utils.chaos import NET_FAULT_KINDS, schedule_from_realized
+except ImportError:        # file-path load: absolute
+    from theanompi_tpu.utils.chaos import (NET_FAULT_KINDS,
+                                           schedule_from_realized)
+
+from .fleet import FleetSim
+
+#: normalization: event kind + reason -> sequence token
+_DEATH_REASONS = ("crashed", "wedged", "lease_expired")
+
+
+def export_realized(realized: Sequence[dict], path: str, *,
+                    min_at: float = 0.0, scale: float = 1.0) -> str:
+    """Write a FleetSim's realized fault list in the live harness's
+    ``chaos_realized.jsonl`` dialect.  ``scale``/``min_at`` let a replay
+    re-time the schedule (live workers spend seconds importing jax
+    before a fault can land; virtual workers are live at t=0⁺)."""
+    with open(path, "w") as f:
+        for doc in realized:
+            out = dict(doc)
+            out["rel"] = round(max(float(doc["rel"]) * scale, min_at), 3)
+            f.write(json.dumps(out, sort_keys=True) + "\n")
+    return path
+
+
+def normalize_sequence(events: Sequence[dict]) -> Dict[int, List[str]]:
+    """Per-worker token sequences from membership events (each event a
+    dict with ``ev``/``worker``/``reason``/``rejoin`` fields — both the
+    sim log and the live telemetry stream satisfy this)."""
+    seqs: Dict[int, List[str]] = {}
+    for e in events:
+        ev, w = e.get("ev"), e.get("worker")
+        if w is None or int(w) < 0:
+            continue
+        w = int(w)
+        if ev == "worker_join":
+            tok = "rejoin" if e.get("rejoin") else "join"
+        elif ev == "worker_leave":
+            tok = "finish" if e.get("reason") == "finished" else (
+                "death" if e.get("reason") in _DEATH_REASONS else None)
+        elif ev == "worker_demote":
+            tok = "demote"
+        else:
+            continue
+        if tok is None:
+            continue
+        seq = seqs.setdefault(w, [])
+        # collapse repeats: a wedge can be seen by BOTH the lease expiry
+        # and the process exit — one death, two observations
+        if not (seq and seq[-1] == tok and tok in ("death", "rejoin")):
+            seq.append(tok)
+    return seqs
+
+
+def sim_membership_sequence(fleet: FleetSim) -> Dict[int, List[str]]:
+    return normalize_sequence(fleet.log.select(
+        "worker_join", "worker_leave", "worker_demote"))
+
+
+def live_membership_sequence(record_dir: str) -> Dict[int, List[str]]:
+    events = []
+    for p in sorted(glob.glob(os.path.join(record_dir,
+                                           "telemetry_rank*.jsonl"))):
+        with open(p) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    e = json.loads(line)
+                except ValueError:
+                    continue
+                if e.get("ev") in ("worker_join", "worker_leave",
+                                   "worker_demote"):
+                    events.append(e)
+    events.sort(key=lambda e: e.get("ts", 0))
+    return normalize_sequence(events)
+
+
+def crosscheck(record_dir: str, *, n_workers: int = 4,
+               schedule: str = "kill@6:1", steps: int = 40,
+               seed: int = 0, live_timeout_s: float = 420.0,
+               run_live: bool = True) -> dict:
+    """The acceptance-criteria cross-check: simulate ``schedule`` at
+    ``n_workers``, export the realized schedule, replay it through the
+    live elastic runtime (ChaosMonkey + ChaosProxy when net windows are
+    present), and compare membership sequences.
+
+    Returns ``{"ok", "sim", "live", "realized_path", "live_rc"}``;
+    ``run_live=False`` stops after the sim+export (for callers that
+    split the phases)."""
+    from ..parallel.membership import Backoff, run_elastic
+    from ..utils import chaos
+
+    os.makedirs(record_dir, exist_ok=True)
+    sched = chaos.parse_schedule(schedule)
+    max_at = max((f.at + f.duration for f in sched), default=0.0)
+    # virtual step time sized so the schedule lands MID-run, as it will
+    # live (live workers run ~0.2–0.5 s/step after a multi-second boot)
+    step_time = max(0.02, 2.5 * max_at / max(1, steps))
+    fleet = FleetSim(n_workers=n_workers, steps=steps, sync_freq=2,
+                     seed=seed, schedule=sched, n_shards=1,
+                     step_time_s=step_time, lease_timeout=60.0,
+                     gossip=False)
+    fleet.run()
+    realized_path = os.path.join(record_dir, "sim_realized.jsonl")
+    # live faults before ~6 s hit workers still importing jax; the monkey
+    # grace covers it, but landing them a touch later keeps them mid-run
+    export_realized(fleet.realized, realized_path, min_at=6.0)
+    sim_seq = sim_membership_sequence(fleet)
+    out = {"sim": sim_seq, "realized_path": realized_path,
+           "live": None, "live_rc": None, "ok": None}
+    if not run_live:
+        return out
+
+    live_sched = schedule_from_realized(realized_path)
+    live_dir = os.path.join(record_dir, "live")
+    proc_sched = [f for f in live_sched
+                  if f.kind not in NET_FAULT_KINDS]
+    rc = run_elastic(
+        "easgd", "tests.conftest", "TinyModel",
+        {"sync_freq": 2, "batch_size": 8}, n_workers,
+        record_dir=live_dir, steps=steps, host_devices=1,
+        chaos_schedule=proc_sched,
+        net_chaos_schedule=[f for f in live_sched
+                            if f.kind in NET_FAULT_KINDS] or None,
+        # target 0 = the center: it must exist as its own supervised
+        # process for the monkey to kill it (chaos_run derives this the
+        # same way)
+        center_proc=any(f.target == 0 for f in proc_sched),
+        timeout_s=live_timeout_s,
+        supervisor_kw={"poll_s": 0.2, "backoff": Backoff(base=0.3),
+                       "lease_timeout": 60.0})
+    live_seq = live_membership_sequence(live_dir)
+    out["live"] = live_seq
+    out["live_rc"] = rc
+    out["ok"] = rc == 0 and live_seq == sim_seq
+    return out

@@ -24,10 +24,18 @@ Fault kinds (POSIX process targets via ``pid_of``; in-process targets via
 
 Stdlib-only on purpose: the harness must import (and the schedule parse
 must run) in jax-free tooling and in the lint CLI's no-backend process.
+
+Round 17 (docs/design.md §18): window decisions go through the clock
+seam (``utils/clock.py``) and every fault that actually LANDS is
+appended to the run's :data:`REALIZED_SCHEDULE` log, so a live chaos run
+can be replayed (:func:`schedule_from_realized`,
+``chaos_run.py --faults-from``) or diffed against a simfleet rehearsal
+of the same schedule.
 """
 
 from __future__ import annotations
 
+import json
 import os
 import random
 import signal
@@ -35,6 +43,11 @@ import sys
 import threading
 import time
 from typing import Callable, Dict, List, Optional, Sequence
+
+try:
+    from .clock import WALL
+except ImportError:        # file-path load (jax-free tooling): absolute
+    from theanompi_tpu.utils.clock import WALL
 
 FAULT_KINDS = ("kill", "stop", "delay")
 
@@ -59,6 +72,65 @@ NET_DELAY_PER_FRAME_S = 0.25
 # marker) — the chaos gate matches worker_leave/worker_join transitions
 # against these
 FAULT_EVENT = "fault_injected"
+
+#: Filename (under a run's record_dir) of the REALIZED fault schedule:
+#: one JSON line per fault that actually landed, with wall + relative
+#: timestamps and the resolved target.  What a chaos run can be replayed
+#: or diffed from (:func:`schedule_from_realized`) — the scheduled list
+#: says what was asked for; this file says what happened.
+REALIZED_SCHEDULE = "chaos_realized.jsonl"
+
+
+def fault_window_active(schedule: Sequence["Fault"], kind: str, worker,
+                        now: float) -> bool:
+    """THE window-membership rule: is a fault window of ``kind`` covering
+    ``worker`` open at ``now`` (seconds relative to the schedule's t0)?
+    ``target == -1`` covers every client; ``worker=None`` (identity not
+    yet known) matches only the -1 windows.  Shared verbatim by the live
+    :class:`ChaosProxy` and simfleet's simulated transport, so the
+    simulator faults frames by the same rule the real proxy does."""
+    for f in schedule:
+        if f.kind != kind or not (f.at <= now <= f.at + f.duration):
+            continue
+        if f.target == -1 or (worker is not None
+                              and int(f.target) == int(worker)):
+            return True
+    return False
+
+
+def append_realized(path: Optional[str], doc: dict) -> None:
+    """Append one realized-fault line (crash-tolerant: a full disk or
+    unwritable dir must never kill the harness)."""
+    if not path:
+        return
+    try:
+        with open(path, "a") as f:
+            f.write(json.dumps(doc, sort_keys=True) + "\n")
+    except OSError:
+        pass
+
+
+def schedule_from_realized(path: str) -> List["Fault"]:
+    """Rebuild a replayable schedule from a realized log: each non-errored
+    line becomes a :class:`Fault` at its *relative* landing time — feed it
+    back to a ChaosMonkey/ChaosProxy (``chaos_run.py --faults-from``) or
+    diff it against a simulated one (simfleet's fidelity cross-check)."""
+    faults: List[Fault] = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                doc = json.loads(line)
+            except ValueError:
+                continue
+            if doc.get("error"):
+                continue               # never landed — nothing to replay
+            faults.append(Fault(str(doc["kind"]), float(doc["rel"]),
+                                int(doc["target"]),
+                                float(doc.get("duration", 0.0))))
+    return sorted(faults, key=lambda f: f.at)
 
 
 class Fault:
@@ -139,7 +211,8 @@ class ChaosMonkey(threading.Thread):
                  pid_of: Optional[Callable[[int], Optional[int]]] = None,
                  delay_hook: Optional[Callable[[int, float], None]] = None,
                  telemetry_=None, poll_s: float = 0.05,
-                 grace_s: float = 10.0, t0: Optional[float] = None):
+                 grace_s: float = 10.0, t0: Optional[float] = None,
+                 clock=None, realized_path: Optional[str] = None):
         super().__init__(daemon=True, name="chaos-monkey")
         # net_* faults are the ChaosProxy's job — a pid-targeted monkey
         # given a mixed schedule must not SIGSTOP a process because a
@@ -152,7 +225,9 @@ class ChaosMonkey(threading.Thread):
         self.telemetry = telemetry_
         self.poll_s = float(poll_s)
         self.grace_s = float(grace_s)
-        self.t0 = time.time() if t0 is None else float(t0)
+        self.clock = clock or WALL
+        self.t0 = self.clock.now() if t0 is None else float(t0)
+        self.realized_path = realized_path
         self._halt = threading.Event()
         self.applied: List[Fault] = []
 
@@ -163,6 +238,12 @@ class ChaosMonkey(threading.Thread):
 
     def _emit(self, fault: Fault, pid: Optional[int]) -> None:
         self.applied.append(fault)
+        now = self.clock.now()
+        append_realized(self.realized_path, {
+            "ts": round(now, 3), "rel": round(now - self.t0, 3),
+            "kind": fault.kind, "target": fault.target,
+            "duration": fault.duration, "pid": pid,
+            "error": fault.error, "source": "monkey"})
         tm = self.telemetry
         if tm is not None and getattr(tm, "enabled", False):
             tm.event(FAULT_EVENT, kind=fault.kind, worker=fault.target,
@@ -180,9 +261,15 @@ class ChaosMonkey(threading.Thread):
             return True
         pid = self.pid_of(fault.target) if self.pid_of else None
         if pid is None:
-            if time.time() - self.t0 - fault.at > self.grace_s:
+            if self.clock.now() - self.t0 - fault.at > self.grace_s:
                 fault.error = "no-pid"
                 fault.applied = True      # dropped, but resolved
+                now = self.clock.now()    # the realized log records the
+                append_realized(self.realized_path, {   # drop too — a
+                    "ts": round(now, 3),  # diff must see asked-but-missed
+                    "rel": round(now - self.t0, 3), "kind": fault.kind,
+                    "target": fault.target, "duration": fault.duration,
+                    "pid": None, "error": "no-pid", "source": "monkey"})
                 return True
             return False                  # target between lives — retry
         try:
@@ -210,7 +297,7 @@ class ChaosMonkey(threading.Thread):
     def run(self) -> None:
         pending = list(self.schedule)
         while pending and not self._halt.is_set():
-            now = time.time() - self.t0
+            now = self.clock.now() - self.t0
             still: List[Fault] = []
             for f in pending:
                 if f.at <= now:
@@ -285,7 +372,8 @@ class ChaosProxy:
     def __init__(self, upstream_addr: str, schedule: Sequence[Fault] = (),
                  listen_host: str = "127.0.0.1", listen_port: int = 0,
                  telemetry_=None, t0: Optional[float] = None,
-                 poll_s: float = 0.05):
+                 poll_s: float = 0.05, clock=None,
+                 realized_path: Optional[str] = None):
         import socket as _socket
         host, port = str(upstream_addr).rsplit(":", 1)
         self.upstream = (host, int(port))
@@ -295,7 +383,9 @@ class ChaosProxy:
         self.listen_host = listen_host
         self.listen_port = int(listen_port)
         self.telemetry = telemetry_
-        self.t0 = time.time() if t0 is None else float(t0)
+        self.clock = clock or WALL
+        self.t0 = self.clock.now() if t0 is None else float(t0)
+        self.realized_path = realized_path
         self.poll_s = float(poll_s)
         self._socket = _socket
         self._halt = threading.Event()
@@ -309,18 +399,19 @@ class ChaosProxy:
     # -- schedule -----------------------------------------------------------
 
     def _active(self, kind: str, worker) -> bool:
-        now = time.time() - self.t0
-        for f in self.schedule:
-            if f.kind != kind or not (f.at <= now <= f.at + f.duration):
-                continue
-            if f.target == -1 or (worker is not None
-                                  and int(f.target) == int(worker)):
-                return True
-        return False
+        return fault_window_active(self.schedule, kind, worker,
+                                   self.clock.now() - self.t0)
 
     def _emit(self, fault: Fault) -> None:
         fault.applied = True
-        self.applied.append(fault)
+        with self._lock:
+            self.applied.append(fault)
+        now = self.clock.now()
+        append_realized(self.realized_path, {
+            "ts": round(now, 3), "rel": round(now - self.t0, 3),
+            "kind": fault.kind, "target": fault.target,
+            "duration": fault.duration, "pid": None,
+            "error": None, "source": "proxy"})
         tm = self.telemetry
         if tm is not None and getattr(tm, "enabled", False):
             tm.event(FAULT_EVENT, kind=fault.kind, worker=fault.target,
@@ -445,7 +536,7 @@ class ChaosProxy:
     def _monitor_loop(self) -> None:
         pending = [f for f in self.schedule if not f.applied]
         while pending and not self._halt.is_set():
-            now = time.time() - self.t0
+            now = self.clock.now() - self.t0
             still = []
             for f in pending:
                 if f.at <= now:

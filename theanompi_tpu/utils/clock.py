@@ -1,0 +1,68 @@
+"""The clock seam: every host-side *decision* clock behind one interface.
+
+The survivability plane (membership leases, wire retry deadlines, chaos
+fault windows — docs/design.md §14–§15) makes its decisions by comparing
+timestamps.  Until round 17 those comparisons read ``time.time()``
+directly, which welds the logic to wall time: rehearsing a 1,000-worker
+fault schedule then costs 1,000 processes and wall-clock minutes.  This
+module is the seam that unwelds it (docs/design.md §18):
+
+* :class:`Clock` — the two-method contract (``now()``/``sleep()``)
+  decision logic is written against.
+* :class:`WallClock` / :data:`WALL` — the default.  Real runs behave
+  EXACTLY as before: ``now()`` is ``time.time()``, ``sleep()`` is
+  ``time.sleep()``.
+* ``theanompi_tpu.simfleet.clock.VirtualClock`` — the simulator's
+  manually-advanced clock.  It lives in simfleet (utils must not import
+  upward); only the interface is defined here.
+
+Two rules keep the seam honest:
+
+1. **Decision logic only.**  Telemetry event timestamps, log lines, and
+   file mtimes stay on wall time — they describe when something really
+   happened.  The clock seam covers times that are *compared*: lease
+   freshness, backoff due-times, fault-window membership, retry
+   deadlines.
+2. **No host clocks in traced code.**  The seam is host-side
+   orchestration; tpulint's trace-purity checker still forbids any
+   ``now()`` (like any ``time.time()``) inside functions that flow into
+   ``jax.jit``/``lax.scan``.
+
+Stdlib-only: the chaos harness and the membership module import this in
+jax-free tooling (lint probes, ``scripts/simfleet_run.py``).
+"""
+
+from __future__ import annotations
+
+import time
+
+
+class Clock:
+    """The injectable time source.  ``now()`` returns seconds (an opaque,
+    monotonically comparable epoch — wall seconds for :class:`WallClock`,
+    virtual seconds for the simulator); ``sleep(dt)`` blocks the caller
+    for ``dt`` of those seconds."""
+
+    def now(self) -> float:
+        raise NotImplementedError
+
+    def sleep(self, dt: float) -> None:
+        raise NotImplementedError
+
+
+class WallClock(Clock):
+    """Real time — the default everywhere, preserving pre-seam behavior
+    bit for bit (``now`` IS ``time.time``)."""
+
+    def now(self) -> float:
+        return time.time()
+
+    def sleep(self, dt: float) -> None:
+        if dt > 0:
+            time.sleep(dt)
+
+
+#: The process-wide default.  ``clock or WALL`` is the idiom every
+#: seam-carrying constructor uses, so passing ``clock=None`` (or nothing)
+#: keeps wall-time semantics.
+WALL = WallClock()
