@@ -21,7 +21,13 @@ recorded in the metric string), ``BENCH_CFG`` (JSON config overrides —
 transformer dims, tp/pp/sp), ``BENCH_SPC`` (steps_per_call) +
 ``BENCH_SYNTH_BATCHES``, ``BENCH_BN_DTYPE`` (bn_norm_dtype lever),
 ``BENCH_MFU`` (=1 adds the MFU column; ``BENCH_SPC_MFU=0`` disables the
-spc>1 single-step-flops derivation), ``BENCH_REAL_DATA`` (=1 drives the
+spc>1 single-step-flops derivation), ``BENCH_BUCKET_BYTES`` (bucketed
+overlap-scheduled wire, ``parallel/buckets.py``: splits every exchange
+collective into ~N-byte async start/done buckets; the row JSON then
+carries ``bucket_bytes`` + ``n_buckets`` — vocabulary pinned as
+``devprof.BUCKET_ROW_COLUMNS`` — and the ``-bucket<sz>`` label suffix
+keeps bucketed rows from serving as last_good for monolithic ones),
+``BENCH_REAL_DATA`` (=1 drives the
 whole disk→augment→device pipeline; + ``BENCH_DATA_DIR``,
 ``BENCH_WIRE_U8``), ``BENCH_WINLOAD`` (=1, with BENCH_SPC>1: para_load
 window mode — the producer stacks+stages whole spc windows off the hot
@@ -223,7 +229,28 @@ def _cfg_matches(cfg: str) -> bool:
         return False
     if ("u8w" in parts) != (os.environ.get("BENCH_WIRE_U8") == "1"):
         return False
+    # bucketed-wire rows (BENCH_BUCKET_BYTES, label token bucket<sz> per
+    # _bucket_label): a different collective schedule — never an honest
+    # fallback for the monolithic control row or vice versa
+    bb = os.environ.get("BENCH_BUCKET_BYTES", "")
+    want_bucket = f"bucket{_bucket_label(int(bb))}" if bb and bb != "0" \
+        else None
+    has_bucket = any(p.startswith("bucket") for p in parts)
+    if (want_bucket is not None) != has_bucket:
+        return False
+    if want_bucket is not None and want_bucket not in parts:
+        return False
     return True
+
+
+def _bucket_label(nbytes: int) -> str:
+    """Label token for one bucket size: 4194304 → '4m', 65536 → '64k',
+    else the raw byte count (matrix labels stay short and unambiguous)."""
+    if nbytes % (1 << 20) == 0:
+        return f"{nbytes >> 20}m"
+    if nbytes % (1 << 10) == 0:
+        return f"{nbytes >> 10}k"
+    return str(nbytes)
 
 
 def _matrix_round(path: str) -> int:
@@ -532,6 +559,10 @@ def bench_row_config(environ=None):
         config["steps_per_call"] = int(env["BENCH_SPC"])
     if env.get("BENCH_BN_DTYPE"):
         config["bn_norm_dtype"] = env["BENCH_BN_DTYPE"]
+    if env.get("BENCH_BUCKET_BYTES"):
+        # bucketed overlap-scheduled collectives (parallel/buckets.py):
+        # every exchange wire splits into ~N-byte async start/done pairs
+        config["bucket_bytes"] = int(env["BENCH_BUCKET_BYTES"])
     if env.get("BENCH_WIRE_U8") == "1":
         # u8-wire staging: host ships uint8 crops, device casts+subtracts
         # (4× smaller host→device transfers — the real-data lever)
@@ -886,11 +917,13 @@ def main() -> int:
                  f"{K80_ALEXNET_IPS:.0f} img/s, not a measured reference"
                  if kind == "images" else
                  "vs_baseline n/a for sequence models")
+    bucket_b = int(config.get("bucket_bytes", 0) or 0)
+    bucket_note = f", bucket={_bucket_label(bucket_b)}" if bucket_b else ""
     out = {
         "metric": f"{kind}_per_sec_per_chip ({model_name} batch "
                   f"{model.batch_size} {rule.upper()}, {n_chips} chip(s), "
                   f"{jax.devices()[0].platform}, prng={prng or 'default'}"
-                  f"{', spc=' + str(spc) if spc > 1 else ''}"
+                  f"{', spc=' + str(spc) if spc > 1 else ''}{bucket_note}"
                   f"{', real-data (disk->native augment->device)' if real_data else ''}"
                   f"{', winload (producer-staged spc windows)' if winload else ''}"
                   f"; {base_note})",
@@ -915,6 +948,17 @@ def main() -> int:
         out["aot_donate"] = _cc.donated_load_safe(mesh)
     if mfu is not None:
         out["mfu"] = mfu
+    if bucket_b:
+        # the bucketed-wire columns (devprof.BUCKET_ROW_COLUMNS — the
+        # schema-drift checker pins both names against bench.py): the
+        # knob and the planner's resulting collectives-per-exchange, so
+        # overlap_ratio movements can be read against bucket count
+        out["bucket_bytes"] = bucket_b
+        try:
+            out["n_buckets"] = model.exchanger.n_buckets()
+        except Exception as e:
+            print(f"bench: n_buckets unavailable ({e!r})", file=sys.stderr)
+            out["n_buckets"] = None
     if trace_profile is not None:
         # trace-derived columns (utils/devprof, BENCH_TRACE=1): device
         # compute/comm/EXPOSED-comm time over the traced window and the
@@ -982,7 +1026,7 @@ def _apply_flagship_defaults() -> None:
     shaping = ("BENCH_MODEL", "BENCH_RULE", "BENCH_BATCH", "BENCH_STRATEGY",
                "BENCH_CFG", "BENCH_SPC", "BENCH_SYNTH_BATCHES",
                "BENCH_BN_DTYPE", "BENCH_REAL_DATA", "BENCH_WIRE_U8",
-               "BENCH_WINLOAD")
+               "BENCH_WINLOAD", "BENCH_BUCKET_BYTES")
     if any(k in os.environ for k in shaping):
         return
     if os.environ.get("PALLAS_AXON_REMOTE_COMPILE") == "0":

@@ -1,0 +1,63 @@
+#!/usr/bin/env bash
+# Round-9 perf matrix — the bucketed-overlap round (ISSUE 13 tentpole):
+# read overlap_ratio up / exposed_comm_secs down straight off the
+# BENCH_TRACE columns, bucketed rows vs their monolithic controls.
+#
+# Same discipline as perf_matrix_r8.sh (the PR 3 prewarm machinery):
+#   1. prewarm: every staged r9 row's program — bucketed schedules
+#      included, their AOT key carries bucket_bytes — compiles into the
+#      executable store BEFORE the window (utils/compile_cache.py).
+#   2. canary: one cheap row must report `cache: hit`, or the pass
+#      aborts loudly instead of burning the hardware window compiling.
+#   3. the scans: each row JSON carries bucket_bytes / n_buckets
+#      (devprof.BUCKET_ROW_COLUMNS) next to overlap_ratio /
+#      exposed_comm_secs (devprof.TRACE_ROW_COLUMNS), so the acceptance
+#      comparison is one jq away:
+#        jq -r 'select(.result) | [.config, .result.n_buckets,
+#               .result.overlap_ratio, .result.exposed_comm_secs] | @tsv'
+# Rows come from scripts/rows.py --round r9 (the same manifest prewarm
+# consumed); rows already measured in the out-file are skipped.
+#   ./scripts/perf_matrix_r9.sh [out_file]
+set -u -o pipefail
+OUT="${1:-perf_matrix_r9.jsonl}"
+cd "$(dirname "$0")/.."
+. scripts/_bench_row.sh
+
+CACHE="${BENCH_COMPILE_CACHE:-/tmp/jax_bench_cache}"
+
+# 1. prewarm (idempotent: cached rows skip in ~ms); live backend venue
+# first, topology venue fallback when the tunnel can't answer
+echo "== prewarm -> $CACHE" >&2
+timeout -s KILL 3000 python -u scripts/prewarm_cache.py --rows r9 \
+    --cache "$CACHE" --platform tpu >&2 \
+  || timeout -s KILL 3000 python -u scripts/prewarm_cache.py --rows r9 \
+    --cache "$CACHE" --platform topology:v5e:2x2x1 >&2 \
+  || echo "== prewarm failed (rows will compile on the clock)" >&2
+
+# 2. canary: the cheapest r9 program must hit the executable cache — a
+# miss means the bucketed key composition drifted from prewarm's
+echo "== canary: alexnet-b128-bucket4m must report cache: hit" >&2
+canary=$(env BENCH_SKIP_PROBE="${BENCH_SKIP_PROBE:-1}" \
+             BENCH_MODEL=alexnet BENCH_BUCKET_BYTES=4194304 \
+             BENCH_ITERS=5 \
+             BENCH_COMPILE_CACHE="$CACHE" python bench.py 2>>"${OUT%.jsonl}.err" | tail -1)
+echo "$canary" | python -c '
+import json, sys
+row = json.loads(sys.stdin.read())
+cache = row.get("cache")
+assert cache == "hit", (
+    f"canary row is cache: {cache!r}, not \"hit\" — the bucketed "
+    f"program key does not match what prewarm stored (row: {row}); "
+    f"aborting before the heavy rows burn the window on compiles")
+print("== canary hit (compile %ss, n_buckets=%s)"
+      % (row.get("compile_secs"), row.get("n_buckets")), file=sys.stderr)
+' || exit 1
+echo "{\"config\": \"alexnet-b128-bucket4m-canary\", \"result\": $canary}" >> "$OUT"
+
+# 3. the staged rows (bucketed + monolithic controls, every one tracing)
+while read -r line; do
+  eval "run $line"
+done < <(python scripts/rows.py --round r9 --sh)
+
+python scripts/merge_matrix.py "$OUT"
+cat "$OUT"

@@ -96,6 +96,25 @@ ROWS: List[Row] = [
        BENCH_SYNTH_BATCHES=8),
     _r("alexnet-b128-realdata-spc4-winload", "r7 r8", BENCH_MODEL="alexnet",
        BENCH_SPC=4, BENCH_REAL_DATA=1, BENCH_WINLOAD=1),
+    # -- round-9 bucketed-overlap rows (ISSUE 13): every row captures a
+    #    BENCH_TRACE window so overlap_ratio / exposed_comm_secs land in
+    #    the row JSON, bucketed and monolithic-control alike — the
+    #    acceptance comparison is read straight off the BENCH_TRACE
+    #    columns at fixed model/rule/spc ----------------------------------
+    _r("alexnet-b128-trace", "r9 heavy", BENCH_MODEL="alexnet",
+       BENCH_TRACE=1),                           # monolithic BSP control
+    _r("alexnet-b128-bucket4m-trace", "r9 heavy", BENCH_MODEL="alexnet",
+       BENCH_BUCKET_BYTES=4194304, BENCH_TRACE=1),
+    _r("vgg16-b32-onebit-trace", "r9 heavy", BENCH_MODEL="vgg16",
+       BENCH_STRATEGY="onebit", BENCH_TRACE=1),  # compressed-wire control
+    _r("vgg16-b32-onebit-bucket4m-trace", "r9 heavy", BENCH_MODEL="vgg16",
+       BENCH_STRATEGY="onebit", BENCH_BUCKET_BYTES=4194304, BENCH_TRACE=1),
+    _r("alexnet-b128-easgd-spc8-trace", "r9 heavy", BENCH_MODEL="alexnet",
+       BENCH_RULE="easgd", BENCH_SPC=8, BENCH_SYNTH_BATCHES=8,
+       BENCH_TRACE=1),                           # monolithic psum control
+    _r("alexnet-b128-easgd-spc8-bucket4m-trace", "r9 heavy",
+       BENCH_MODEL="alexnet", BENCH_RULE="easgd", BENCH_SPC=8,
+       BENCH_SYNTH_BATCHES=8, BENCH_BUCKET_BYTES=4194304, BENCH_TRACE=1),
 ]
 
 

@@ -1121,6 +1121,75 @@ def test_collective_discipline_start_done_pairing(tmp_path):
     assert "unbalanced async collective pair" in found[0].message
 
 
+def test_collective_discipline_discarded_ticket(tmp_path):
+    """The bucket-balance probe: a start whose ticket hits the floor is
+    flagged even when another pair balances the scope's counts."""
+    code = (
+        "from jax import lax\n"
+        "def exchange(xs):\n"
+        "    lax.psum_start(xs[0], 'workers')\n"       # discarded!
+        "    t = lax.psum_start(xs[1], 'workers')\n"
+        "    a = lax.psum_done(t)\n"
+        "    b = lax.psum_done(t)\n"                   # counts balance...
+        "    return a + b\n")
+    found = lint_snippet(tmp_path, "x.py", code, "collective-discipline")
+    assert len(found) == 1 and found[0].line == 3
+    assert "leaked in-flight collective" in found[0].message
+
+
+def test_collective_discipline_bucket_loop_balanced_ok(tmp_path):
+    """The bucketed-wire shape (parallel/buckets.py): starts collected
+    into a ticket list, dones drained from it — balanced, clean."""
+    code = (
+        "from theanompi_tpu.jax_compat import psum_start, psum_done\n"
+        "def exchange(vecs):\n"
+        "    tickets = [psum_start(v, 'workers') for v in vecs]\n"
+        "    return [psum_done(t) for t in tickets]\n")
+    assert lint_snippet(tmp_path, "x.py", code,
+                        "collective-discipline") == []
+
+
+def test_collective_discipline_shim_module_exempt():
+    """The shim-definition module is the pairing boundary: each half
+    wraps its one-sided lax call by construction — no findings on the
+    real file."""
+    found = core.run_lint(REPO, paths=["theanompi_tpu/jax_compat.py"],
+                          only=["collective-discipline"])
+    assert found == [], [f.render() for f in found]
+
+
+def test_injection_dropped_done_in_buckets(tmp_path):
+    """Live injection (the ISSUE 13 bucket-balance gate): drop the ONE
+    psum_done from the real bucketed-psum engine and the checker must
+    catch the leaked in-flight buckets; the unmodified file is clean."""
+    clean = core.run_lint(REPO, paths=["theanompi_tpu/parallel/buckets.py"],
+                          only=["collective-discipline"])
+    assert clean == [], [f.render() for f in clean]
+    rel = _inject(tmp_path, "theanompi_tpu/parallel/buckets.py",
+                  "lambda t: psum_done(t))",
+                  "lambda t: t.value)")
+    found = core.run_lint(str(tmp_path), paths=[rel],
+                          only=["collective-discipline"])
+    assert any("unbalanced async collective pair" in f.message
+               and "psum_start" in f.message for f in found), \
+        [f.render() for f in found]
+
+
+def test_injection_dropped_done_in_onebit_strategy(tmp_path):
+    """Same gate on the compressed wire: strip the all_gather_done from
+    OneBit's bucketed decode loop → unbalanced pair."""
+    rel = _inject(tmp_path, "theanompi_tpu/parallel/strategies.py",
+                  "compress_ops.unpack_signs_weighted_sum(\n"
+                  "                all_gather_done(t), all_scales)",
+                  "compress_ops.unpack_signs_weighted_sum(\n"
+                  "                t.value, all_scales)")
+    found = core.run_lint(str(tmp_path), paths=[rel],
+                          only=["collective-discipline"])
+    assert any("unbalanced async collective pair" in f.message
+               and "all_gather_start" in f.message for f in found), \
+        [f.render() for f in found]
+
+
 # ---------------------------------------------------------------------------
 # sharding-schema
 # ---------------------------------------------------------------------------
@@ -1280,13 +1349,11 @@ def test_injection_axis_typo_in_exchanger(tmp_path):
 def test_injection_rank_conditional_psum_in_strategies(tmp_path):
     rel = _inject(
         tmp_path, "theanompi_tpu/parallel/strategies.py",
-        "        inv = 1.0 / size\n"
-        "        if self.wire_dtype is None:\n"
+        "        if wd is None:\n"
         "            out = jax.tree.map(lambda g: lax.psum(g, axis) * inv"
         ", tree)",
-        "        inv = 1.0 / size\n"
         "        rank = lax.axis_index(axis)\n"
-        "        if self.wire_dtype is None:\n"
+        "        if wd is None:\n"
         "            if rank == 0:\n"
         "                tree = jax.tree.map(lambda g: lax.psum(g, axis),"
         " tree)\n"

@@ -26,11 +26,18 @@ bucketed overlap-scheduled collectives before they exist:
    Dataflow is per-function: names assigned from the two APIs taint,
    taint propagates through assignments.
 
-3. **Paired start/done APIs match.**  Async collective pairs
-   (``lax.<x>_start`` / ``lax.<x>_done`` — the shape the item-1
-   bucketed overlap schedule will lean on) must balance within one
-   function scope: a start with no done leaks an in-flight collective,
-   a done with no start is undefined.
+3. **Paired start/done APIs match — the bucket-balance probe.**  Async
+   collective pairs (``lax.<x>_start`` / ``lax.<x>_done`` and the
+   ``jax_compat`` shims the bucketed overlap wire of
+   ``parallel/buckets.py`` issues) must balance within one function
+   scope: a start with no done leaks an in-flight collective, a done
+   with no start is undefined.  Additionally a ``<x>_start`` whose
+   ticket is DISCARDED (a bare expression statement) is flagged even
+   when another start/done pair balances the counts — the in-flight
+   token must reach its done.  The shim-definition module itself
+   (``theanompi_tpu/jax_compat.py``) is exempt: each shim half
+   lexically contains its one-sided underlying ``lax`` call by
+   construction — that file IS the pairing boundary.
 """
 
 from __future__ import annotations
@@ -54,6 +61,11 @@ RANK_SOURCES = {
 }
 
 _ASYNC_MODULES = ("jax.lax.", "theanompi_tpu.jax_compat.")
+
+# the module DEFINING the async shims: each `<x>_start`/`<x>_done` half
+# wraps its one-sided underlying lax call, so pairing is structurally
+# one-sided there by construction — exempt from the balance probe
+_SHIM_MODULE = "theanompi_tpu.jax_compat"
 
 
 def _async_pair(resolved: Optional[str]) -> Optional[Tuple[str, str]]:
@@ -167,9 +179,12 @@ class CollectiveDisciplineChecker(Checker):
         stmts = list(self._scope_stmts(sf, scope))
         seen_hazard: Set[Tuple[int, int]] = set()
 
-        # 1 + 3: axis validity and start/done balance.  Each call is
-        # visited exactly once: through the expression roots of its own
-        # statement (nested block statements are yielded separately).
+        # 1 + 3: axis validity and start/done balance (the bucket-balance
+        # probe).  Each call is visited exactly once: through the
+        # expression roots of its own statement (nested block statements
+        # are yielded separately).  The shim-definition module is exempt
+        # from pairing — each shim half is one-sided by construction.
+        check_pairs = sf.resolver.module != _SHIM_MODULE
         pairs: Dict[str, Dict[str, List[ast.Call]]] = {}
         for st in stmts:
             for expr in self._stmt_exprs(st):
@@ -188,9 +203,21 @@ class CollectiveDisciplineChecker(Checker):
                                     "(declared: "
                                     f"{', '.join(sorted(valid))})"))
                     ap = _async_pair(resolved)
-                    if ap is not None:
+                    if ap is not None and check_pairs:
                         pairs.setdefault(ap[0], {}).setdefault(
                             ap[1], []).append(call)
+                        if ap[1] == "start" and isinstance(st, ast.Expr) \
+                                and st.value is call:
+                            # ticket discarded on the floor: even with the
+                            # counts balanced elsewhere, THIS in-flight
+                            # collective can never be awaited
+                            findings.append(Finding(
+                                self.name, sf.path, call.lineno,
+                                call.col_offset,
+                                f"leaked in-flight collective: "
+                                f"`{ap[0]}_start` ticket is discarded "
+                                f"(bare expression statement) — it can "
+                                f"never reach `{ap[0]}_done`"))
         for prefix, sides in sorted(pairs.items()):
             starts = sides.get("start", [])
             dones = sides.get("done", [])

@@ -145,6 +145,36 @@ def device_schema_errors(devprof, sentry, telemetry,
                        f"TRACE_ROW_COLUMNS "
                        f"{sorted(devprof.TRACE_ROW_COLUMNS)}"))
 
+    # 2b. the bucketed-wire row columns (BENCH_BUCKET_BYTES rows) must
+    # stay disjoint from the trace vocabulary — a collision would let one
+    # emitter silently overwrite the other's column in the row JSON —
+    # and bench.py must emit exactly the declared names (string-level
+    # probe: bench imports jax, so the live-row check stays lexical)
+    bucket_cols = getattr(devprof, "BUCKET_ROW_COLUMNS", None)
+    if not bucket_cols:
+        errors.append((DEVPROF_PATH,
+                       "BUCKET_ROW_COLUMNS missing from devprof — the "
+                       "bucketed bench rows have no pinned vocabulary"))
+    else:
+        clash = sorted(set(bucket_cols) & set(devprof.TRACE_ROW_COLUMNS))
+        if clash:
+            errors.append((DEVPROF_PATH,
+                           f"BUCKET_ROW_COLUMNS collide with "
+                           f"TRACE_ROW_COLUMNS: {clash}"))
+        root = os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__)))))
+        bench_path = os.path.join(root, "bench.py")
+        if os.path.exists(bench_path):
+            with open(bench_path) as f:
+                src = f.read()
+            missing = [c for c in bucket_cols if f'"{c}"' not in src]
+            if missing:
+                errors.append(("bench.py",
+                               f"bucketed row column(s) {missing} "
+                               f"declared in devprof.BUCKET_ROW_COLUMNS "
+                               "never appear in bench.py — the rows "
+                               "would ship without them"))
+
     # 3. the sentry's anomaly event: a live instance pushed into a NaN
     # must emit ANOMALY_EVENT with a declared kind and an iter field
     tm2 = telemetry.Telemetry(rank=0, run_id="drift-check")

@@ -87,6 +87,10 @@ COLLECTIVES = {
     "ppermute": 1, "pshuffle": 1, "all_gather": 1,
     "all_gather_invariant": 1, "all_to_all": 1, "psum_scatter": 1,
     "axis_index": 0, "axis_size": 0,
+    # async start halves (the bucketed-wire shims in jax_compat — their
+    # `_done` twins take a ticket, not an axis, and are covered by the
+    # collective-discipline pairing probe instead)
+    "psum_start": 1, "all_gather_start": 1, "ppermute_start": 1,
 }
 
 _COLLECTIVE_MODULES = ("jax.lax.", "theanompi_tpu.jax_compat.")

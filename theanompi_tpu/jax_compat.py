@@ -1,4 +1,4 @@
-"""Version-portability shim for ``shard_map``.
+"""Version-portability shims: ``shard_map`` and async collectives.
 
 The framework is written against current jax (``jax.shard_map`` with the
 vma varying-axes type system).  The container this repo grows in may pin
@@ -11,11 +11,35 @@ On the legacy path ``check_rep=False``: the old replication checker
 predates the vma typing this code is written for (per-worker varying
 scan carries, ``steps.anchor_invariant``) and rejects valid programs
 here; on current jax the vma system supersedes it anyway.
+
+**Async collective start/done pairs** (the bucketed-overlap wire,
+``parallel/buckets.py``): some jaxlibs expose an explicit async
+collective surface (``lax.psum_start``/``psum_done``-shaped APIs that
+return an in-flight token); most — including this one — do not, and rely
+on XLA's latency-hiding scheduler to convert independent collectives to
+``<op>-start``/``<op>-done`` HLO pairs itself.  The shims below give the
+exchange path ONE calling convention for both worlds:
+
+* when the running jaxlib exposes the async API, ``<x>_start`` returns
+  its real in-flight ticket and ``<x>_done`` awaits it;
+* otherwise (the sync fallback) ``<x>_start`` issues the plain
+  collective eagerly — the ticket IS the result — and ``<x>_done``
+  unwraps it.  Scheduling-wise nothing is lost: each bucket is still its
+  own independent collective for the latency-hiding scheduler to
+  overlap with the backward pass.
+
+Discipline contract (enforced by tpulint's collective-discipline
+checker): every ``<x>_start`` call's ticket must reach a matching
+``<x>_done`` in the same scope — a dropped ticket is a leaked in-flight
+collective the day a real async surface binds.
 """
 
 from __future__ import annotations
 
+from typing import Any, NamedTuple
+
 import jax
+from jax import lax
 
 
 def shard_map(f, *, mesh, in_specs, out_specs):
@@ -25,3 +49,63 @@ def shard_map(f, *, mesh, in_specs, out_specs):
     from jax.experimental.shard_map import shard_map as _sm
     return _sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
                check_rep=False)
+
+
+# -- async collective start/done ---------------------------------------------
+
+# True when the running jaxlib exposes a real async start/done surface;
+# the sync fallback below is used otherwise (0.4.x has none).
+HAS_ASYNC_COLLECTIVES = all(
+    hasattr(lax, n) for n in ("psum_start", "psum_done"))
+
+
+class _SyncTicket(NamedTuple):
+    """Sync-fallback in-flight token: the collective already ran eagerly,
+    the ticket carries its result to the paired ``<x>_done``."""
+
+    value: Any
+
+
+def psum_start(x, axis_name):
+    """Begin one bucket's cross-worker sum; returns an in-flight ticket
+    for :func:`psum_done`."""
+    if HAS_ASYNC_COLLECTIVES:
+        return lax.psum_start(x, axis_name)
+    return _SyncTicket(lax.psum(x, axis_name))
+
+
+def psum_done(ticket):
+    """Await one :func:`psum_start` ticket and return the reduced value."""
+    if HAS_ASYNC_COLLECTIVES:
+        return lax.psum_done(ticket)
+    return ticket.value
+
+
+def all_gather_start(x, axis_name):
+    """Begin one bucket's all-gather (compressed wires ship packed
+    buckets); returns an in-flight ticket for :func:`all_gather_done`."""
+    if hasattr(lax, "all_gather_start"):
+        return lax.all_gather_start(x, axis_name)
+    return _SyncTicket(lax.all_gather(x, axis_name))
+
+
+def all_gather_done(ticket):
+    """Await one :func:`all_gather_start` ticket."""
+    if hasattr(lax, "all_gather_done"):
+        return lax.all_gather_done(ticket)
+    return ticket.value
+
+
+def ppermute_start(x, axis_name, perm):
+    """Begin one bucket's peer-to-peer permute (GoSGD gossip payloads);
+    returns an in-flight ticket for :func:`ppermute_done`."""
+    if hasattr(lax, "ppermute_start"):
+        return lax.ppermute_start(x, axis_name, perm)
+    return _SyncTicket(lax.ppermute(x, axis_name, perm))
+
+
+def ppermute_done(ticket):
+    """Await one :func:`ppermute_start` ticket."""
+    if hasattr(lax, "ppermute_done"):
+        return lax.ppermute_done(ticket)
+    return ticket.value

@@ -47,8 +47,11 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
+from ..jax_compat import (all_gather_done, all_gather_start, psum_done,
+                          psum_start)
 from ..utils import helper_funcs
 from ..ops import compress as compress_ops
+from . import buckets
 
 
 class Strategy:
@@ -62,11 +65,27 @@ class Strategy:
     # replicated-leaf segments, so the exchanger re-imposes replication on
     # the replicated leaves afterwards (a pmean over 'model')
     flattens = False
+    # bucketed overlap-scheduled wire (parallel/buckets.py, ROADMAP item
+    # 1): > 0 splits this strategy's collectives into ~bucket_bytes
+    # slices issued as async start/done pairs; 0 keeps the monolithic
+    # wire.  Set by BSP_Exchanger from config['bucket_bytes'] — a
+    # SCHEDULE knob only: bucketed ≡ monolithic bit-for-bit, pinned per
+    # strategy in tests/test_buckets.py.
+    bucket_bytes = 0
 
     def init_state(self, params) -> Any:
         """Per-worker persistent state (unsharded template; the exchanger adds
         the leading ``[n_workers]`` axis)."""
         return ()
+
+    def n_buckets(self, params, bucket_bytes: int) -> Optional[int]:
+        """Wire slices one exchange of a ``params``-shaped payload ships
+        at ``bucket_bytes`` (bench's ``n_buckets`` row column).  The
+        default models the fp32 leaf payload (allreduce-family);
+        compressed strategies override with their packed layouts; None =
+        this strategy's wire does not bucket (ring's hand-rolled chunk
+        pipeline, the no-comm probe)."""
+        return buckets.count_buckets(params, bucket_bytes)
 
     def __call__(self, tree, state, *, axis: str, size: int):
         raise NotImplementedError
@@ -83,6 +102,9 @@ class NoComm(Strategy):
     """
 
     name = "none"
+
+    def n_buckets(self, params, bucket_bytes: int):
+        return None                       # no collective, nothing to slice
 
     def __call__(self, tree, state, *, axis: str, size: int):
         inv = 1.0 / size
@@ -104,10 +126,24 @@ class AllReduce(Strategy):
 
     def __call__(self, tree, state, *, axis: str, size: int):
         inv = 1.0 / size
-        if self.wire_dtype is None:
+        wd = self.wire_dtype
+        if self.bucket_bytes > 0:
+            # per-bucket async psum pairs: all starts issued before the
+            # first done so the latency-hiding scheduler can overlap the
+            # buckets with the backprop tail.  The wire cast (if any)
+            # happens per bucket — same elementwise cast→psum→cast chain
+            # as the monolithic leaf, so bit-identity holds either way.
+            plan = buckets.plan_buckets(tree, self.bucket_bytes)
+            vecs = buckets.pack(tree, plan)
+            tickets = [psum_start(v if wd is None else v.astype(wd), axis)
+                       for v in vecs]
+            summed = [psum_done(t) for t in tickets]
+            reduced = [(s if wd is None else s.astype(v.dtype)) * inv
+                       for s, v in zip(summed, vecs)]
+            return buckets.unpack(reduced, tree, plan), state
+        if wd is None:
             out = jax.tree.map(lambda g: lax.psum(g, axis) * inv, tree)
         else:
-            wd = self.wire_dtype
             out = jax.tree.map(
                 lambda g: lax.psum(g.astype(wd), axis).astype(g.dtype) * inv, tree
             )
@@ -132,6 +168,11 @@ class Ring(Strategy):
         self.wire_dtype = wire_dtype
         self.name = "ring" if wire_dtype is None else "ring16"
         self.flattens = True
+
+    def n_buckets(self, params, bucket_bytes: int):
+        # the ring IS a chunk pipeline already (2(size-1) ppermute hops
+        # over size-th slices) — the bucket planner does not re-slice it
+        return None
 
     def __call__(self, tree, state, *, axis: str, size: int):
         if size == 1:
@@ -206,16 +247,47 @@ class OneBit(Strategy):
         padded = n + (-n) % compress_ops.PACK_ALIGN
         return jnp.zeros((padded,), jnp.float32)
 
+    def _segment_elems(self, bucket_bytes: int) -> int:
+        """fp32 elements per wire bucket, rounded DOWN to the pack-kernel
+        grid (PACK_ALIGN) so every bucket's packed buffer is whole tiles
+        — the pack/decode pair is blockwise, which is exactly why
+        bucketed ≡ monolithic bit-for-bit."""
+        return max(compress_ops.PACK_ALIGN,
+                   (int(bucket_bytes) // 4 // compress_ops.PACK_ALIGN)
+                   * compress_ops.PACK_ALIGN)
+
+    def n_buckets(self, params, bucket_bytes: int):
+        n = helper_funcs.tree_size(params)
+        n += (-n) % compress_ops.PACK_ALIGN
+        seg = self._segment_elems(bucket_bytes)
+        return max(1, -(-n // seg))
+
     def __call__(self, tree, state, *, axis: str, size: int):
         flat = helper_funcs.flatten_tree(
             tree, pad_to_multiple_of=compress_ops.PACK_ALIGN)
         c = flat + state
         scale = jnp.mean(jnp.abs(c)) + 1e-12
-        packed = compress_ops.pack_signs(c)           # uint32 [P/4096, 128]
         new_state = c - scale * jnp.sign(jnp.where(c == 0, 1.0, c))
-        all_packed = lax.all_gather(packed, axis)      # P/8 bytes/worker on the wire
-        all_scales = lax.all_gather(scale, axis)       # [size]
-        signs_sum = compress_ops.unpack_signs_weighted_sum(all_packed, all_scales)
+        all_scales = lax.all_gather(scale, axis)       # [size] — one scalar
+        if self.bucket_bytes > 0:
+            # per-bucket wire: pack+gather each PACK_ALIGN-aligned slice
+            # of the error-fed vector as its own async all-gather pair
+            # (all starts before the first done), decode per bucket with
+            # the GLOBAL scale — the scale is one mean over the whole
+            # vector in both modes, so bucketing stays bit-identical
+            n = c.shape[0]
+            seg = self._segment_elems(self.bucket_bytes)
+            bounds = [(a, min(a + seg, n)) for a in range(0, n, seg)]
+            tickets = [all_gather_start(compress_ops.pack_signs(c[a:b]),
+                                        axis) for a, b in bounds]
+            segs = [compress_ops.unpack_signs_weighted_sum(
+                all_gather_done(t), all_scales) for t in tickets]
+            signs_sum = segs[0] if len(segs) == 1 else jnp.concatenate(segs)
+        else:
+            packed = compress_ops.pack_signs(c)       # uint32 [P/4096, 128]
+            all_packed = lax.all_gather(packed, axis)  # P/8 bytes/worker
+            signs_sum = compress_ops.unpack_signs_weighted_sum(all_packed,
+                                                               all_scales)
         mean = signs_sum / size
         return helper_funcs.unflatten_like(tree, mean), new_state
 
@@ -262,12 +334,24 @@ class TopK(Strategy):
         padded = n + (-n) % self.chunk
         return jnp.zeros((padded,), jnp.float32)
 
+    def _k_c(self) -> int:
+        """Selected entries per chunk row — ONE derivation for the
+        exchange itself and the n_buckets bench column."""
+        return self.k or max(1, int(round(self.chunk * self.ratio)))
+
+    def _rows_per_bucket(self, k_c: int, bucket_bytes: int) -> int:
+        """Chunk rows per wire bucket: a row ships ``k_c`` bf16 values +
+        ``k_c`` int16 offsets = 4·k_c bytes.  Shared by the bucketed
+        exchange and n_buckets so the bench column can't drift from the
+        collectives actually issued."""
+        return max(1, int(bucket_bytes) // (4 * k_c))
+
     def __call__(self, tree, state, *, axis: str, size: int):
         flat = helper_funcs.flatten_tree(tree, pad_to_multiple_of=self.chunk)
         c = flat + state
         n = c.shape[0]
         n_chunks = n // self.chunk
-        k_c = self.k or max(1, int(round(self.chunk * self.ratio)))
+        k_c = self._k_c()
         c2 = c.reshape(n_chunks, self.chunk)
         _, idx = lax.top_k(jnp.abs(c2), k_c)            # [C, k_c] row-wise
         vals = jnp.take_along_axis(c2, idx, axis=1)     # [C, k_c] fp32
@@ -281,16 +365,49 @@ class TopK(Strategy):
         wire_idx = idx.astype(jnp.int16)
         residual = vals - wire_vals.astype(jnp.float32)
         new_state = c2.at[rows, idx].set(residual).reshape(-1)
-        all_vals = lax.all_gather(wire_vals, axis)      # [size, C, k_c]
-        all_idx = lax.all_gather(wire_idx, axis)
+        if self.bucket_bytes > 0:
+            # per-bucket wire: the (vals, idx) pairs of ~bucket_bytes
+            # worth of CHUNK ROWS ride as their own async all-gather
+            # pairs; each bucket decodes into its own disjoint dense
+            # segment (chunk c only ever scatters into
+            # [c·chunk, (c+1)·chunk)), so the per-bucket scatter-adds
+            # reproduce the monolithic scatter bit-for-bit
+            rows_per = self._rows_per_bucket(k_c, self.bucket_bytes)
+            bounds = [(a, min(a + rows_per, n_chunks))
+                      for a in range(0, n_chunks, rows_per)]
+            tickets = [(all_gather_start(wire_vals[a:b], axis),
+                        all_gather_start(wire_idx[a:b], axis), a, b)
+                       for a, b in bounds]
+            segs = []
+            for tv, ti, a, b in tickets:
+                sv = all_gather_done(tv)                # [size, b-a, k_c]
+                si = all_gather_done(ti)
+                base = (jnp.arange(b - a, dtype=jnp.int32)
+                        * self.chunk)[None, :, None]
+                lidx = si.astype(jnp.int32) + base      # segment-local
+                seg = jnp.zeros(((b - a) * self.chunk,), jnp.float32)
+                segs.append(seg.at[lidx.reshape(-1)].add(
+                    sv.astype(jnp.float32).reshape(-1)))
+            dense = segs[0] if len(segs) == 1 else jnp.concatenate(segs)
+        else:
+            all_vals = lax.all_gather(wire_vals, axis)  # [size, C, k_c]
+            all_idx = lax.all_gather(wire_idx, axis)
 
-        base = (jnp.arange(n_chunks, dtype=jnp.int32) * self.chunk)[None, :, None]
-        gidx = all_idx.astype(jnp.int32) + base          # global indices
-        dense = jnp.zeros((n,), jnp.float32)
-        dense = dense.at[gidx.reshape(-1)].add(
-            all_vals.astype(jnp.float32).reshape(-1))
+            base = (jnp.arange(n_chunks, dtype=jnp.int32)
+                    * self.chunk)[None, :, None]
+            gidx = all_idx.astype(jnp.int32) + base      # global indices
+            dense = jnp.zeros((n,), jnp.float32)
+            dense = dense.at[gidx.reshape(-1)].add(
+                all_vals.astype(jnp.float32).reshape(-1))
         mean = dense / size
         return helper_funcs.unflatten_like(tree, mean), new_state
+
+    def n_buckets(self, params, bucket_bytes: int):
+        n = helper_funcs.tree_size(params)
+        n += (-n) % self.chunk
+        n_chunks = n // self.chunk
+        rows_per = self._rows_per_bucket(self._k_c(), bucket_bytes)
+        return max(1, -(-n_chunks // rows_per))
 
 
 class PowerSGD(Strategy):
@@ -362,14 +479,27 @@ class PowerSGD(Strategy):
                               "e": jnp.zeros((0, 0), jnp.float32)})
         return state
 
+    def n_buckets(self, params, bucket_bytes: int):
+        # the compressible leaves' P/Q factor psums are per-leaf small
+        # collectives already (their own pipeline); the planner buckets
+        # the DENSE remainder (vectors, norms, tiny matrices)
+        dense = [l for l in jax.tree.leaves(params)
+                 if not self._compressible(np.shape(l))]
+        return buckets.count_buckets(dense, bucket_bytes) if dense else 0
+
     def __call__(self, tree, state, *, axis: str, size: int):
         inv = 1.0 / size
         leaves, treedef = jax.tree_util.tree_flatten(tree)
         assert len(leaves) == len(state), (len(leaves), len(state))
         out, new_state = [], []
+        dense_ids: list = []
         for g, st in zip(leaves, state):
             if not self._compressible(np.shape(g)):
-                out.append(lax.psum(g, axis) * inv)
+                if self.bucket_bytes > 0:
+                    dense_ids.append(len(out))   # bucketed sum below
+                    out.append(g)
+                else:
+                    out.append(lax.psum(g, axis) * inv)
                 new_state.append(st)
                 continue
             shape = g.shape
@@ -386,6 +516,14 @@ class PowerSGD(Strategy):
             # it (values are identical everywhere; this is a type cast)
             from .steps import _vary
             new_state.append({"q": _vary(Qn, axis), "e": Mp - Mhat})
+        if dense_ids:
+            # the dense remainder rides the bucket planner: one async
+            # psum pair per ~bucket_bytes of incompressible leaves
+            # (element-wise sum — bit-identical to the leaf-wise psums)
+            summed = buckets.bucketed_psum([out[i] for i in dense_ids],
+                                           axis, self.bucket_bytes)
+            for i, s in zip(dense_ids, summed):
+                out[i] = s * inv
         return jax.tree_util.tree_unflatten(treedef, out), new_state
 
 

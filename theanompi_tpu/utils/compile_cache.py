@@ -214,6 +214,13 @@ def key_extra(fn: str, model=None, exchanger=None,
                              getattr(exchanger, "mode", ""),
                              getattr(strat, "name", ""),
                              getattr(exchanger, "exchange_freq", 1)))
+        bb = int(getattr(exchanger, "bucket_bytes", 0) or 0)
+        if bb:
+            # the bucketed-wire schedule (parallel/buckets.py) reshapes
+            # the collective sequence: a bucketed and a monolithic build
+            # of the same rule must never share an entry (belt-and-braces
+            # over the HLO hash, like the rule signature)
+            extra["bucket_bytes"] = bb
     return extra
 
 

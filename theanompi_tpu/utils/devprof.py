@@ -36,8 +36,13 @@ compute-op intervals are union-merged, and
 Comm ops are matched by HLO opcode prefix (``all-reduce``,
 ``all-gather``, ``reduce-scatter``, ``all-to-all``,
 ``collective-permute``, ... including their async ``-start``/``-done``
-forms, whose ``-done`` wait IS the exposed time under XLA's
-latency-hiding scheduler).
+forms).  Each async pair is merged into ONE comm interval spanning
+start-begin → done-end — the whole in-flight window — matched in
+timestamp order per op class, same-lane first, then across lanes with
+the merged interval landing on the START's lane (a runtime that parks
+the done on a dedicated async-collective stream must not read as a
+second, fully-exposed collective while the issuing lane's compute hides
+the real one).
 
 Host dispatch anchors: the worker loop and the standalone exchange tag
 their dispatches with ``jax.profiler.TraceAnnotation`` spans named
@@ -93,6 +98,18 @@ TRACE_ROW_COLUMNS = (
     "device_compute_secs",
     "device_comm_secs",
     "device_mfu",
+)
+
+# The bench-row columns BENCH_BUCKET_BYTES adds (the bucketed-wire rows,
+# parallel/buckets.py): the configured bucket size and the collectives
+# -per-exchange count the planner produced.  Declared HERE — the one
+# jax-free schema home for bench-row vocabularies — so the tpulint
+# schema-drift checker can pin bench's emission against it and guarantee
+# it stays disjoint from TRACE_ROW_COLUMNS (a name collision would
+# silently overwrite a trace column in the row JSON).
+BUCKET_ROW_COLUMNS = (
+    "bucket_bytes",
+    "n_buckets",
 )
 
 # HLO opcodes whose device time is collective/communication time.  Async
@@ -186,6 +203,67 @@ def _intersection_measure(a: List[Tuple[float, float]],
     return total
 
 
+def _async_base(cls: str) -> Optional[Tuple[str, str]]:
+    """``('all-reduce', 'start'|'done')`` for an async-pair op class."""
+    for side in ("start", "done"):
+        if cls.endswith("-" + side):
+            return cls[:-(len(side) + 1)], side
+    return None
+
+
+def _merge_async_pairs(comm_ev: Dict[Tuple, List[Tuple[float, float, str]]]
+                       ) -> Dict[Tuple, List[Tuple[float, float]]]:
+    """Comm events → per-lane intervals, with each async
+    ``<op>-start``/``<op>-done`` pair merged into ONE interval spanning
+    start-begin → done-end.
+
+    Under XLA's latency-hiding scheduler the pair brackets one in-flight
+    collective; counting the two ops as separate slivers mis-attributes
+    it twice over: the in-flight window between them vanishes from
+    ``comm_secs``, and when the runtime puts the halves on DIFFERENT
+    lanes (a dedicated async-collective stream), the same collective is
+    counted on both lanes — the done sliver then reads as fully exposed
+    even while the start's lane is busy with the compute that hides it.
+    Pairs are matched k-th-start ↔ k-th-done in timestamp order per op
+    class, same-lane first, then across lanes (the merged interval lands
+    on the START's lane — where the collective was issued, and where the
+    compute that may hide it runs).  Unpaired halves and plain sync
+    collectives keep their own intervals."""
+    out: Dict[Tuple, List[Tuple[float, float]]] = {
+        lane: [] for lane in comm_ev}
+    # base op class -> side -> [(ts, end, lane)], ts-ordered
+    leftovers: Dict[str, Dict[str, List[Tuple[float, float, Tuple]]]] = {}
+    for lane, evs in comm_ev.items():
+        by_base: Dict[str, Dict[str, List[Tuple[float, float]]]] = {}
+        for ts, end, cls in evs:
+            ab = _async_base(cls)
+            if ab is None:
+                out[lane].append((ts, end))
+            else:
+                by_base.setdefault(ab[0], {}).setdefault(
+                    ab[1], []).append((ts, end))
+        for base, sides in by_base.items():
+            starts = sorted(sides.get("start", []))
+            dones = sorted(sides.get("done", []))
+            for (s0, s1), (d0, d1) in zip(starts, dones):
+                out[lane].append((s0, max(s1, d1, d0)))
+            for side, rest in (("start", starts[len(dones):]),
+                               ("done", dones[len(starts):])):
+                for ts, end in rest:
+                    leftovers.setdefault(base, {}).setdefault(
+                        side, []).append((ts, end, lane))
+    # cross-lane pairing of the leftovers (start on the compute lane,
+    # done on a dedicated async stream — or vice versa)
+    for base, sides in leftovers.items():
+        starts = sorted(sides.get("start", []))
+        dones = sorted(sides.get("done", []))
+        for (s0, s1, lane_s), (d0, d1, _lane_d) in zip(starts, dones):
+            out[lane_s].append((s0, max(s1, d1, d0)))
+        for ts, end, lane in starts[len(dones):] + dones[len(starts):]:
+            out[lane].append((ts, end))
+    return {lane: iv for lane, iv in out.items() if iv}
+
+
 # -- attribution ------------------------------------------------------------
 
 
@@ -197,12 +275,14 @@ def attribute(events: Iterable[dict]) -> Dict[str, Any]:
     per-``hlo_module`` breakdown, the top op classes by device time, and
     the host dispatch-anchor counts (``train_dispatches`` /
     ``exchange_dispatches``)."""
-    # lane = (pid, tid); per lane the comm/compute interval lists (us)
-    comm_iv: Dict[Tuple, List[Tuple[float, float]]] = {}
+    # lane = (pid, tid); per lane the compute interval lists and the comm
+    # EVENT lists (us; comm keeps the op class so async start/done pairs
+    # can merge into one in-flight interval — see _merge_async_pairs)
+    comm_ev: Dict[Tuple, List[Tuple[float, float, str]]] = {}
     comp_iv: Dict[Tuple, List[Tuple[float, float]]] = {}
-    # module -> ("comm"|"compute") -> lane -> intervals: the per-module
-    # breakdown keeps the lane split so device A's compute can't masquerade
-    # as overlap for device B's collective
+    # module -> ("comm"|"compute") -> lane -> intervals/events: the
+    # per-module breakdown keeps the lane split so device A's compute
+    # can't masquerade as overlap for device B's collective
     per_module: Dict[str, Dict[str, Dict[Tuple, List]]] = {}
     op_totals: Dict[str, List[float]] = {}            # class -> [us, count]
     train_dispatches = 0
@@ -234,18 +314,24 @@ def attribute(events: Iterable[dict]) -> Dict[str, Any]:
         # and merging them into one lane would let host A's compute mask
         # host B's collective as overlap
         lane = (ev.get("_src"), ev.get("pid"), ev.get("tid"))
-        iv = (ts, ts + dur)
-        comm = is_comm_op(name)
-        (comm_iv if comm else comp_iv).setdefault(lane, []).append(iv)
         cls = op_class(name)
+        comm = is_comm_op(name)
+        if comm:
+            comm_ev.setdefault(lane, []).append((ts, ts + dur, cls))
+        else:
+            comp_iv.setdefault(lane, []).append((ts, ts + dur))
         tot = op_totals.setdefault(cls, [0.0, 0])
         tot[0] += dur
         tot[1] += 1
         mod = str(args.get("hlo_module", "?"))
         m = per_module.setdefault(mod, {"comm": {}, "compute": {}})
-        m["comm" if comm else "compute"].setdefault(lane, []).append(iv)
+        if comm:
+            m["comm"].setdefault(lane, []).append((ts, ts + dur, cls))
+        else:
+            m["compute"].setdefault(lane, []).append((ts, ts + dur))
 
-    def _breakdown(comm_by_lane, comp_by_lane):
+    def _breakdown(comm_events, comp_by_lane):
+        comm_by_lane = _merge_async_pairs(comm_events)
         comm_us = comp_us = exposed_us = 0.0
         for lane in set(comm_by_lane) | set(comp_by_lane):
             cu = _union(comm_by_lane.get(lane, []))
@@ -256,7 +342,7 @@ def attribute(events: Iterable[dict]) -> Dict[str, Any]:
             exposed_us += c - _intersection_measure(cu, pu)
         return comm_us, comp_us, exposed_us
 
-    comm_us, comp_us, exposed_us = _breakdown(comm_iv, comp_iv)
+    comm_us, comp_us, exposed_us = _breakdown(comm_ev, comp_iv)
     modules: Dict[str, dict] = {}
     for mod, m in per_module.items():
         mc, mp, mx = _breakdown(m["comm"], m["compute"])
@@ -278,7 +364,7 @@ def attribute(events: Iterable[dict]) -> Dict[str, Any]:
         "exposed_comm_secs": round(exposed, 6),
         "overlap_ratio": (round(1.0 - exposed / comm_secs, 4)
                           if comm_secs > 0 else None),
-        "lanes": len(set(comm_iv) | set(comp_iv)),
+        "lanes": len(set(comm_ev) | set(comp_iv)),
         # lanes that actually carry compute — the denominator for
         # per-device compute-busy time (a dedicated async collective
         # stream is a lane, but averaging compute over it would halve it)
