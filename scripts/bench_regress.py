@@ -1,0 +1,207 @@
+#!/usr/bin/env python
+"""Self-judging throughput gate: fresh rows vs the committed trajectory.
+
+Every hardware window so far was judged by a human reading BENCH_r*.json
+next to the new rows.  This gate makes the comparison mechanical so the
+next window can close on itself (``scripts/perf_matrix_r9.sh`` runs it
+last): for each row label (the ``config`` field), the baseline is the
+BEST *fresh* measurement in the committed trajectory — rows tagged
+``stale: true`` (the PR 2 wedge-fallback flag), carrying a ``STALE
+last-good`` metric, a ``degraded`` marker, or a top-level ``error`` are
+EXCLUDED (a wedged round's re-emitted number must not become the bar,
+in either direction) — and a fresh row more than ``--threshold`` percent
+below its label's baseline fails the gate.
+
+Usage:
+    python scripts/bench_regress.py fresh.jsonl [more...]
+        [--baseline GLOB ...] [--threshold PCT] [--json OUT]
+
+Inputs may be perf-matrix JSONL (``{"config": ..., "result": {...}}``
+lines) or BENCH_r*.json single-row files; the baseline defaults to the
+committed ``BENCH_r*.json`` trajectory plus every committed
+``perf_matrix_r*.jsonl``.  Exit codes: 0 = no regression, 2 = nothing
+comparable (no fresh rows, or no baseline overlaps them — a warning,
+not a verdict), 3 = regression past the threshold.
+
+Stdlib only — runnable on the TPU host with no jax env active.
+"""
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_METRIC_LABEL_RE = re.compile(r"\(([a-z0-9_]+) batch (\d+)", re.I)
+
+
+def _row_from_result(result, label=None, error=None):
+    """One normalized row dict from a result payload (perf-matrix
+    ``result`` or BENCH ``parsed``), or None when there is no value."""
+    if not isinstance(result, dict):
+        return None
+    value = result.get("value")
+    try:
+        value = float(value)
+    except (TypeError, ValueError):
+        return None
+    metric = str(result.get("metric", ""))
+    if label is None:
+        label = result.get("config") or \
+            (result.get("last_good") or {}).get("config")
+    if label is None:
+        m = _METRIC_LABEL_RE.search(metric)
+        if m:
+            label = f"{m.group(1)}-b{m.group(2)}"
+    blob = (metric + str(result.get("note", ""))).lower()
+    stale = bool(result.get("stale")) or "stale last-good" in blob \
+        or bool(error) or bool(result.get("error"))
+    degraded = "degraded" in blob
+    return {"label": str(label or "default"), "value": value,
+            "stale": stale, "degraded": degraded,
+            "unit": result.get("unit")}
+
+
+def load_rows(path):
+    """All normalized rows from one artifact, either format."""
+    rows = []
+    try:
+        with open(path) as f:
+            text = f.read()
+    except OSError as e:
+        print(f"bench_regress: cannot read {path}: {e}", file=sys.stderr)
+        return rows
+    if path.endswith(".jsonl"):
+        for line in text.splitlines():
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                doc = json.loads(line)
+            except ValueError:
+                continue
+            row = _row_from_result(doc.get("result"),
+                                   label=doc.get("config"))
+            if row:
+                rows.append(row)
+        return rows
+    try:
+        doc = json.loads(text)
+    except ValueError:
+        return rows
+    parsed = doc.get("parsed", doc) if isinstance(doc, dict) else doc
+    items = parsed if isinstance(parsed, list) else [parsed]
+    for item in items:
+        if not isinstance(item, dict):
+            continue
+        row = _row_from_result(item, error=item.get("error"))
+        if row:
+            rows.append(row)
+    return rows
+
+
+def build_baseline(paths):
+    """label -> (best fresh value, source path).  Stale/degraded rows are
+    excluded per the module docstring."""
+    best = {}
+    for path in paths:
+        for row in load_rows(path):
+            if row["stale"] or row["degraded"]:
+                continue
+            cur = best.get(row["label"])
+            if cur is None or row["value"] > cur[0]:
+                best[row["label"]] = (row["value"], path)
+    return best
+
+
+def judge(fresh_rows, baseline, threshold_pct):
+    """Per-label verdicts: ``regression`` / ``ok`` / ``improved`` /
+    ``new`` (no baseline) / ``stale-skipped``."""
+    verdicts = []
+    for row in fresh_rows:
+        if row["stale"] or row["degraded"]:
+            verdicts.append({**row, "verdict": "stale-skipped"})
+            continue
+        base = baseline.get(row["label"])
+        if base is None:
+            verdicts.append({**row, "verdict": "new"})
+            continue
+        base_v, src = base
+        delta_pct = 100.0 * (row["value"] - base_v) / base_v if base_v \
+            else 0.0
+        verdict = "ok"
+        if delta_pct < -threshold_pct:
+            verdict = "regression"
+        elif delta_pct > threshold_pct:
+            verdict = "improved"
+        verdicts.append({**row, "verdict": verdict,
+                         "baseline": base_v, "baseline_src": src,
+                         "delta_pct": round(delta_pct, 2)})
+    return verdicts
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("fresh", nargs="+",
+                    help="fresh row artifact(s): perf-matrix .jsonl or "
+                         "BENCH-style .json")
+    ap.add_argument("--baseline", action="append", default=None,
+                    metavar="GLOB",
+                    help="baseline artifact glob(s); default: the "
+                         "committed BENCH_r*.json + perf_matrix_r*.jsonl")
+    ap.add_argument("--threshold", type=float, default=10.0, metavar="PCT",
+                    help="regression tolerance in percent (default 10)")
+    ap.add_argument("--json", default=None, metavar="OUT",
+                    help="write the machine-readable verdicts here")
+    args = ap.parse_args(argv)
+
+    globs = args.baseline or [os.path.join(ROOT, "BENCH_r*.json"),
+                              os.path.join(ROOT, "perf_matrix_r*.jsonl")]
+    base_paths = sorted(p for g in globs for p in glob.glob(g))
+    # the fresh file under judgment must not also serve as its own bar
+    fresh_abs = {os.path.abspath(p) for p in args.fresh}
+    base_paths = [p for p in base_paths
+                  if os.path.abspath(p) not in fresh_abs]
+    baseline = build_baseline(base_paths)
+
+    fresh_rows = [r for p in args.fresh for r in load_rows(p)]
+    verdicts = judge(fresh_rows, baseline, args.threshold)
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump({"threshold_pct": args.threshold,
+                       "baseline_files": base_paths,
+                       "verdicts": verdicts}, f, indent=1, sort_keys=True)
+
+    regressions = [v for v in verdicts if v["verdict"] == "regression"]
+    judged = [v for v in verdicts if v["verdict"] not in ("stale-skipped",)]
+    for v in verdicts:
+        if v["verdict"] in ("stale-skipped", "new"):
+            print(f"  {v['label']:<28} {v['value']:>12.2f}  "
+                  f"[{v['verdict']}]")
+        else:
+            print(f"  {v['label']:<28} {v['value']:>12.2f}  vs best "
+                  f"{v['baseline']:.2f} ({v['delta_pct']:+.1f}%) "
+                  f"[{v['verdict']}]")
+    if not fresh_rows:
+        print("bench_regress: no comparable fresh rows — nothing judged",
+              file=sys.stderr)
+        return 2
+    if not any("baseline" in v for v in judged):
+        print("bench_regress: no fresh label overlaps the baseline "
+              "trajectory — nothing judged", file=sys.stderr)
+        return 2
+    if regressions:
+        print(f"BENCH REGRESSION GATE FAIL: {len(regressions)} label(s) "
+              f"more than {args.threshold:g}% below their best fresh "
+              f"baseline", file=sys.stderr)
+        return 3
+    print(f"bench_regress: PASS ({len(judged)} row(s) within "
+          f"{args.threshold:g}% of the trajectory)")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -227,6 +227,12 @@ def main(argv=None):
                          "not given")
     ap.add_argument("--net-n-faults", type=int, default=3)
     ap.add_argument("--net-duration", type=float, default=3.0)
+    ap.add_argument("--fleetmon", action="store_true",
+                    help="run the fleet-health collector (utils/fleetmon)"
+                         " for this run and close with the alert-audit: "
+                         "every landed fault whose symptom a rule covers "
+                         "must raise its alert within one evaluation "
+                         "window")
     ap.add_argument("--record-dir", required=True)
     ap.add_argument("--host-devices", type=int, default=1,
                     help="simulated chips per worker (CPU venue)")
@@ -296,6 +302,11 @@ def main(argv=None):
              if center_proc else ""))
     config = parse_kv(args.config)
     config.setdefault("sync_freq", args.sync_freq)
+    if args.fleetmon:
+        config["fleetmon"] = True
+        # the wedge rule must out-wait healthy silence but fire inside a
+        # stop fault — half the lease timeout mirrors the live default
+        config.setdefault("fleetmon_heartbeat_s", args.lease_timeout / 2.0)
     t0 = time.time()
     rc = run_elastic(
         args.rule, args.modelfile, args.modelclass, config, args.workers,
@@ -325,6 +336,40 @@ def main(argv=None):
     center_ok, _stats = audit_center(args.record_dir, len(center_kills),
                                      require_dedup=dup_injected)
     ok = ok and center_ok
+    if args.fleetmon:
+        # the §20 alert-audit: match every landed fault whose symptom a
+        # rule covers to its alert, from the realized log + the alert
+        # events the collector streamed into this run's telemetry
+        from theanompi_tpu.utils import fleetmon
+        sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+        import telemetry_report as tr
+        events = tr.load_events(args.record_dir)
+        alert_events = [e for e in events
+                        if e["ev"] == fleetmon.ALERT_EVENT]
+        realized = []
+        realized_path = os.path.join(args.record_dir,
+                                     "chaos_realized.jsonl")
+        if os.path.exists(realized_path):
+            with open(realized_path) as f:
+                for line in f:
+                    try:
+                        realized.append(json.loads(line))
+                    except ValueError:
+                        continue
+        rules = fleetmon.default_rules(
+            heartbeat_s=float(config["fleetmon_heartbeat_s"]))
+        alert_ok, lines = fleetmon.audit_alerts(
+            alert_events, realized, rules,
+            eval_window_s=float(config.get("fleetmon_eval_s", 2.0)))
+        for line in lines:
+            print(line)
+        if not alert_ok:
+            print("ALERT AUDIT FAIL: a covered fault raised no alert "
+                  "within its window")
+            ok = False
+        else:
+            print(f"alert audit: PASS ({len(alert_events)} alert(s) "
+                  f"fired)")
     if not ok:
         return 4
     if args.verify_loss or args.loss_threshold is not None:

@@ -12,10 +12,19 @@ behind; a cleanly-exited one removes it).
 
 Usage:
     python scripts/fleetz.py <record_dir> [--json] [--events N]
+                             [--watch] [--interval S] [--iterations N]
 
 ``--events N`` additionally tails the last N flight-ring events of every
 reachable process (the cross-process "what is everyone doing right now"
 that used to need N terminals).
+
+``--watch`` re-probes and re-prints every ``--interval`` seconds — the
+live control-room view.  When the run serves a fleet-health collector
+(``utils/fleetmon``, registered in the same roster under role
+``fleetmon``), each frame also shows its recent alerts and fleet rank
+count.  ``--iterations N`` bounds the loop (N=1 is the single-shot test
+mode; 0 = forever); the exit code reflects the LAST frame's roster (any
+DOWN row → 2, same as the one-shot contract).
 
 Runs jax-free: the package parent is bootstrapped synthetically (the
 ``scripts/lint.py`` pattern) so ``utils/tracing.py`` loads without
@@ -82,16 +91,30 @@ def print_table(rows):
         print("  ".join(v.ljust(w) for v, w in zip(row, widths)))
 
 
-def main(argv=None):
-    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
-    ap.add_argument("record_dir")
-    ap.add_argument("--json", action="store_true",
-                    help="machine-readable output (one JSON doc)")
-    ap.add_argument("--events", type=int, default=0, metavar="N",
-                    help="also tail each live process's last N "
-                         "flight-ring events")
-    ap.add_argument("--timeout", type=float, default=2.0)
-    args = ap.parse_args(argv)
+def print_alerts(rows, timeout_s=2.0, n=5):
+    """When a fleet-health collector is in the roster, show its recent
+    alerts + fleet size — the control-room summary line."""
+    for r in rows:
+        if r.get("role") != "fleetmon" or r.get("down"):
+            continue
+        try:
+            rep = tracing.statusz_query(r["addr"], "alerts", n=n,
+                                        timeout_s=timeout_s)
+        except Exception:
+            continue
+        alerts = rep.get("alerts", [])
+        print(f"\nfleetmon: {len(r.get('ranks', []))} rank(s) streaming, "
+              f"{r.get('alerts', 0)} alert(s) total, "
+              f"{r.get('evaluations', 0)} evaluation(s)")
+        for a in alerts[-n:]:
+            who = "fleet" if a.get("rank") is None else f"w{a['rank']}"
+            print(f"  ALERT {a.get('rule')} [{who}] "
+                  f"{a.get('series')}={a.get('value')} "
+                  f"(threshold {a.get('threshold')}) ts={a.get('ts')}")
+
+
+def one_frame(args):
+    """One probe → print pass; returns the exit code for this frame."""
     docs = tracing.read_statusz_docs(args.record_dir)
     if not docs:
         print(f"no statusz endpoints registered under "
@@ -104,6 +127,7 @@ def main(argv=None):
         print(json.dumps({"fleet": rows}, default=str))
     else:
         print_table(rows)
+        print_alerts(rows, args.timeout)
     if args.events:
         for r in rows:
             if r.get("down"):
@@ -123,6 +147,38 @@ def main(argv=None):
     # any DOWN row is worth a nonzero exit: a dead process left its
     # discovery file behind (clean exits deregister)
     return 0 if all(not r.get("down") for r in rows) else 2
+
+
+def main(argv=None):
+    import time
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("record_dir")
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable output (one JSON doc)")
+    ap.add_argument("--events", type=int, default=0, metavar="N",
+                    help="also tail each live process's last N "
+                         "flight-ring events")
+    ap.add_argument("--watch", action="store_true",
+                    help="re-probe every --interval seconds (live view)")
+    ap.add_argument("--interval", type=float, default=2.0,
+                    help="--watch refresh period (default 2s)")
+    ap.add_argument("--iterations", type=int, default=0, metavar="N",
+                    help="--watch frame budget (0 = forever; 1 = the "
+                         "single-shot test mode)")
+    ap.add_argument("--timeout", type=float, default=2.0)
+    args = ap.parse_args(argv)
+    if not args.watch:
+        return one_frame(args)
+    frame = 0
+    rc = 1
+    while True:
+        frame += 1
+        print(f"--- fleetz watch frame {frame} "
+              f"({time.strftime('%H:%M:%S')}) ---")
+        rc = one_frame(args)
+        if args.iterations and frame >= args.iterations:
+            return rc
+        time.sleep(args.interval)
 
 
 if __name__ == "__main__":

@@ -61,3 +61,13 @@ done < <(python scripts/rows.py --round r9 --sh)
 
 python scripts/merge_matrix.py "$OUT"
 cat "$OUT"
+
+# 4. closing gate: the fresh rows must sit within BENCH_REGRESS_PCT
+# (default 10%) of each label's best fresh committed reading — stale/
+# degraded trajectory rows are excluded from the bar, so a wedged
+# round's fallback can neither hide nor fake a regression.  The window
+# self-judges instead of waiting for a human diff.
+python scripts/bench_regress.py "$OUT" \
+    --threshold "${BENCH_REGRESS_PCT:-10}" \
+    --json "${OUT%.jsonl}_regress.json" \
+  || { echo "== bench_regress: throughput regression gate FAILED" >&2; exit 7; }

@@ -49,7 +49,8 @@ def build_fleet(args, seed=None, workers=None) -> FleetSim:
         n_shards=args.shards, schedule=sched, net_schedule=net,
         n_faults=args.n_faults, net_n_faults=args.net_n_faults,
         n_stragglers=args.stragglers,
-        fault_t_min=args.t_min, fault_t_max=args.t_max)
+        fault_t_min=args.t_min, fault_t_max=args.t_max,
+        fleetmon=args.fleetmon)
 
 
 def report(fleet, cpu_s) -> bool:
@@ -64,6 +65,12 @@ def report(fleet, cpu_s) -> bool:
           f"dedup_hits={sum(s['center']['dedup_hits_per_shard'])} "
           f"restarts={s['center']['restarts']}")
     print(f"  frames faulted: {s['frames_faulted'] or 'none'}")
+    if s.get("fleetmon"):
+        fm = s["fleetmon"]
+        by = ", ".join(f"{k}×{v}" for k, v in fm["by_rule"].items()) \
+            or "none"
+        print(f"  fleetmon: {fm['alerts']} alert(s) over "
+              f"{fm['evaluations']} evaluation(s) — {by}")
     ok_all = True
     for name, ok, detail in check_invariants(fleet):
         ok_all &= ok
@@ -149,6 +156,10 @@ def main(argv=None) -> int:
                     help="seeded net windows when --net-faults absent")
     ap.add_argument("--stragglers", type=int, default=20,
                     help="persistent stragglers (4x step time)")
+    ap.add_argument("--fleetmon", action="store_true",
+                    help="rehearse the §20 health plane: the REAL "
+                         "FleetCollector + SLO rule engine on the "
+                         "virtual clock; alerts join the event log")
     ap.add_argument("--t-min", type=float, default=10.0)
     ap.add_argument("--t-max", type=float, default=150.0)
     ap.add_argument("--log-out", default=None,

@@ -73,7 +73,7 @@ TRACKED_EVENTS = ("phase", "train_record", "val_record", "gauges",
                   "fatal_signal", "worker_join", "worker_leave",
                   "worker_demote", "fault_injected",
                   "center_down", "center_restored", "wire",
-                  "span", "statusz")
+                  "span", "statusz", "alert")
 
 # gauges-event keys drawn as Perfetto counter tracks (plus
 # images_per_sec from train_record events); heartbeat.iter is the
@@ -86,7 +86,7 @@ TRACE_COUNTER_KEYS = ("hbm_bytes_in_use", "prefetch.queue_depth",
 INSTANT_EVENTS = ("anomaly", "crash", "stall", "fatal_signal",
                   "worker_join", "worker_leave", "worker_demote",
                   "fault_injected", "center_down", "center_restored",
-                  "wire", "statusz")
+                  "wire", "statusz", "alert")
 
 # The critical-path component vocabulary (mirrors utils/tracing.py
 # COMPONENTS — schema-drift-probed): every second of a traced exchange
@@ -583,6 +583,22 @@ def build_trace(events):
                                   for k in ("trace", "span", "parent",
                                             "q", "a", "retries", "island")
                                   if ev.get(k) is not None}})
+        elif kind == "alert":
+            # fleet-health SLO alerts (utils/fleetmon): the marker names
+            # the firing RULE and value, so the Perfetto timeline reads
+            # "alert:step_time_degraded=0.41 (w3)" at the instant the
+            # rule engine fired — next to the fault/membership markers
+            # that explain it
+            who = "fleet" if ev.get("worker") is None \
+                else f"w{ev['worker']}"
+            val = ev.get("value")
+            label = f"alert:{ev.get('rule', '?')}"
+            if val is not None:
+                label += f"={val:g}" if isinstance(val, (int, float)) \
+                    else f"={val}"
+            body.append({"ph": "i", "pid": rank, "tid": 0,
+                         "ts": us(ev["ts"]), "s": "p",
+                         "name": f"{label} ({who})", "cat": "alert"})
         elif kind in INSTANT_EVENTS:
             parts = []
             if "worker" in ev:          # membership/chaos events name the
@@ -657,6 +673,14 @@ def build_report(record_dir, window_s=10.0, events=None):
         if ev["ev"] in ("worker_join", "worker_leave", "worker_demote",
                         "fault_injected", "center_down",
                         "center_restored")]
+    # fleet-health SLO alerts (utils/fleetmon): what the rule engine
+    # fired during the window, cited next to the wire health it explains
+    alerts = [{"ts": ev["ts"], "rule": ev.get("rule"),
+               "series": ev.get("series"), "scope": ev.get("scope"),
+               "worker": ev.get("worker"), "value": ev.get("value"),
+               "threshold": ev.get("threshold"),
+               "action": ev.get("action")}
+              for ev in events if ev["ev"] == "alert"]
     return {
         "record_dir": os.path.abspath(record_dir),
         "runs": runs, "ranks": ranks, "events": len(events),
@@ -667,6 +691,7 @@ def build_report(record_dir, window_s=10.0, events=None):
         "flags": health_flags(events, summaries),
         "counters": {r: s.get("counters", {}) for r, s in summaries.items()},
         "wire": wire_health(events, summaries),
+        "alerts": alerts,
         "traces": trace_summary(events, window_s),
         "membership_events": membership,
         "crash_events": crashes,
@@ -752,6 +777,26 @@ def print_report(rep):
                       if w.get("outages") else "")
             print(f"  rank {rank}: {rtt}"
                   + (f" — {churn}" if churn else "") + outage)
+        wire_alerts = [a for a in rep.get("alerts", ())
+                       if str(a.get("series", "")).startswith("wire")]
+        if wire_alerts:
+            # the SLO verdicts behind those numbers: which wire rules
+            # fired in this window, on whom
+            cite = ", ".join(
+                f"{a['rule']}"
+                + ("[fleet]" if a.get("worker") is None
+                   else f"[w{a['worker']}]")
+                for a in wire_alerts[-6:])
+            print(f"  alerts fired: {cite}")
+    alerts = rep.get("alerts")
+    if alerts:
+        print(f"\nfleet-health alerts ({len(alerts)} fired):")
+        for a in alerts[-10:]:
+            who = "fleet" if a.get("worker") is None \
+                else f"worker {a['worker']}"
+            act = f" -> {a['action']}" if a.get("action") else ""
+            print(f"  {a['rule']} on {who}: {a['series']}={a['value']} "
+                  f"(threshold {a['threshold']}){act}")
     tr = rep.get("traces")
     if tr:
         jr = (f"{tr['join_rate']:.1%} joined" if tr.get("join_rate")

@@ -1,0 +1,85 @@
+"""scripts/bench_regress.py: the self-judging throughput gate —
+per-label best-fresh baseline, stale/degraded exclusion, exit codes."""
+
+import importlib.util
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+spec = importlib.util.spec_from_file_location(
+    "_bench_regress", os.path.join(REPO, "scripts", "bench_regress.py"))
+br = importlib.util.module_from_spec(spec)
+spec.loader.exec_module(br)
+
+
+def _write_bench(path, value, metric="images_per_sec_per_chip "
+                 "(alexnet batch 128 BSP, 1 chip(s), tpu)", **extra):
+    doc = {"parsed": dict({"value": value, "metric": metric,
+                           "unit": "images/sec/chip"}, **extra)}
+    with open(path, "w") as f:
+        json.dump(doc, f)
+
+
+def _write_jsonl(path, rows):
+    with open(path, "w") as f:
+        for config, result in rows:
+            f.write(json.dumps({"config": config, "result": result}) + "\n")
+
+
+def _baseline_dir(tmp_path):
+    """A committed trajectory: two fresh BENCH readings (the best wins),
+    one stale wedge-fallback carrying a HIGHER number (must be excluded
+    from the bar), and a perf-matrix file with a second label."""
+    _write_bench(str(tmp_path / "BENCH_r01.json"), 13000.0)
+    _write_bench(str(tmp_path / "BENCH_r02.json"), 13300.0)
+    _write_bench(str(tmp_path / "BENCH_r05.json"), 14162.0,
+                 metric="STALE last-good (alexnet-b128) — wedged",
+                 error="tunnel wedged")
+    _write_jsonl(str(tmp_path / "perf_matrix_r07.jsonl"),
+                 [("vgg16-easgd", {"value": 900.0}),
+                  ("vgg16-easgd", {"value": 950.0, "stale": True}),
+                  ("null-row", None)])
+    return [str(tmp_path / "BENCH_r*.json"),
+            str(tmp_path / "perf_matrix_r*.jsonl")]
+
+
+def test_baseline_excludes_stale_and_keeps_best(tmp_path):
+    base = br.build_baseline(
+        sorted(p for g in _baseline_dir(tmp_path)
+               for p in __import__("glob").glob(g)))
+    assert base["alexnet-b128"][0] == 13300.0   # not the stale 14162
+    assert base["vgg16-easgd"][0] == 900.0      # not the stale 950
+
+
+def test_gate_pass_regression_and_new_labels(tmp_path):
+    globs = _baseline_dir(tmp_path)
+    fresh = str(tmp_path / "fresh.jsonl")
+    # within 10% of the 13300 bar: PASS (and a new label is informational)
+    _write_jsonl(fresh, [("alexnet-b128", {"value": 12500.0}),
+                         ("brand-new", {"value": 1.0})])
+    args = [fresh] + [a for g in globs for a in ("--baseline", g)]
+    assert br.main(args + ["--threshold", "10"]) == 0
+    # >10% below: exit 3, and the verdict names the regression
+    _write_jsonl(fresh, [("alexnet-b128", {"value": 11000.0})])
+    out = str(tmp_path / "verdicts.json")
+    assert br.main(args + ["--threshold", "10", "--json", out]) == 3
+    with open(out) as f:
+        verdicts = json.load(f)["verdicts"]
+    assert verdicts[0]["verdict"] == "regression" \
+        and verdicts[0]["baseline"] == 13300.0
+    # a stale FRESH row is skipped, never judged (the wedge fallback
+    # re-emission can't fail its own gate)
+    _write_jsonl(fresh, [("alexnet-b128", {"value": 11000.0,
+                                           "stale": True})])
+    assert br.main(args + ["--threshold", "10"]) == 2
+    # no overlap with the trajectory at all: exit 2 (warning, no verdict)
+    _write_jsonl(fresh, [("never-seen", {"value": 5.0})])
+    assert br.main(args + ["--threshold", "10"]) == 2
+
+
+def test_r9_script_wires_the_gate():
+    with open(os.path.join(REPO, "scripts", "perf_matrix_r9.sh")) as f:
+        src = f.read()
+    assert "bench_regress.py" in src and "exit 7" in src

@@ -246,6 +246,40 @@ def test_profile_row_fields_columns_and_device_mfu():
     assert fields["overlap_ratio"] == pytest.approx(0.5)
 
 
+def test_bubble_fraction_per_lane_idle_gaps():
+    """ISSUE 14 satellite (ROADMAP item 2's bench column): per-lane idle
+    gaps between compute intervals inside the dispatch window, span-
+    weighted across compute lanes."""
+    prof = devprof.attribute([
+        # lane 1: compute [0,10] + [20,30] → span 30, busy 20, idle 10
+        _op(0, 10, "fusion.1"),
+        _op(20, 10, "fusion.2"),
+        # lane 2: compute [0,40] → span 40, no idle
+        _op(0, 40, "while.1", tid=2),
+    ])
+    assert prof["bubble_fraction"] == pytest.approx(10.0 / 70.0, abs=1e-4)
+    # a collective inside the gap does NOT fill the bubble: from the
+    # compute pipeline's perspective an exposed comm stall is a stall
+    prof2 = devprof.attribute([
+        _op(0, 10, "fusion.1"),
+        _op(20, 10, "fusion.2"),
+        _op(0, 40, "while.1", tid=2),
+        _op(12, 6, "all-reduce.1"),
+    ])
+    assert prof2["bubble_fraction"] == pytest.approx(10.0 / 70.0,
+                                                     abs=1e-4)
+    # no compute at all → None (and the row column carries it verbatim)
+    prof3 = devprof.attribute([_op(0, 5, "all-reduce.1")])
+    assert prof3["bubble_fraction"] is None
+    assert devprof.profile_row_fields(prof3)["bubble_fraction"] is None
+    assert "bubble_fraction" in devprof.TRACE_ROW_COLUMNS
+    assert devprof.profile_row_fields(prof)["bubble_fraction"] == \
+        prof["bubble_fraction"]
+    # a perfectly packed single lane is bubble-free
+    assert devprof.attribute([_op(0, 50, "fusion.1")])[
+        "bubble_fraction"] == pytest.approx(0.0)
+
+
 # -- training sentry --------------------------------------------------------
 
 def _rec(i, cost=1.0, ips=100.0):

@@ -1,0 +1,542 @@
+"""Fleet health plane (utils/fleetmon, docs/design.md §20): rule-engine
+episode semantics, the wire-framed collector service, alert-driven
+supervision, the simfleet rehearsal, and the chaos alert-audit."""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+import time
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from theanompi_tpu.parallel import wire  # noqa: E402
+from theanompi_tpu.parallel.membership import ElasticSupervisor  # noqa: E402
+from theanompi_tpu.simfleet import FleetSim, VirtualClock  # noqa: E402
+from theanompi_tpu.utils import chaos, fleetmon, telemetry, tracing  # noqa
+
+
+def _rule(**kw):
+    base = {"name": "r", "series": "step_p99", "predicate": "threshold",
+            "op": ">", "value": 1.0, "scope": "rank"}
+    base.update(kw)
+    return base
+
+
+# -- rule grammar -------------------------------------------------------------
+
+def test_rule_grammar_validation():
+    fleetmon.validate_rules(fleetmon.DEFAULT_RULES)
+    fleetmon.validate_rules(fleetmon.default_rules(
+        step_p99_s=0.5, hbm_headroom_bytes=1e9))
+    for bad, msg in [
+            (_rule(predicate="nope"), "predicate"),
+            (_rule(series="nope"), "series"),
+            (_rule(op="!="), "op"),
+            (_rule(bogus_key=1), "unknown key"),
+            (_rule(predicate="sustained"), "window_s"),
+            (_rule(predicate="fleet_quantile", quantile=7.0), "quantile"),
+            (_rule(action="explode"), "action"),
+            ({"series": "step_p99"}, "name")]:
+        with pytest.raises(ValueError, match=msg):
+            fleetmon.validate_rules([bad])
+    with pytest.raises(ValueError, match="duplicate"):
+        fleetmon.validate_rules([_rule(), _rule()])
+
+
+# -- episode semantics (the no-flapping contract) -----------------------------
+
+def test_threshold_episode_fires_once_until_clear():
+    clk = VirtualClock()
+    col = fleetmon.FleetCollector(rules=[_rule()], clock=clk,
+                                  telemetry_=telemetry.DISABLED)
+    col.ingest({"step_p99": 3.0}, rank=1)
+    assert len(col.evaluate()) == 1
+    # persisting breach: NO re-fire, however many evaluations pass
+    for _ in range(5):
+        clk.advance_to(clk.now() + 1.0)
+        col.ingest({"step_p99": 3.0}, rank=1)
+        assert col.evaluate() == []
+    # clears, then a NEW breach opens a new episode
+    clk.advance_to(clk.now() + 1.0)
+    col.ingest({"step_p99": 0.1}, rank=1)
+    assert col.evaluate() == []
+    clk.advance_to(clk.now() + 1.0)
+    col.ingest({"step_p99": 9.0}, rank=1)
+    fired = col.evaluate()
+    assert len(fired) == 1 and fired[0]["value"] == 9.0
+    assert len(col.alerts) == 2
+
+
+def test_sustained_needs_full_window_and_blip_resets():
+    clk = VirtualClock()
+    col = fleetmon.FleetCollector(
+        rules=[_rule(predicate="sustained", window_s=5.0)], clock=clk,
+        telemetry_=telemetry.DISABLED)
+    for _ in range(4):                      # 4s of breach: under window
+        col.ingest({"step_p99": 3.0}, rank=1)
+        assert col.evaluate() == []
+        clk.advance_to(clk.now() + 1.0)
+    col.ingest({"step_p99": 0.5}, rank=1)   # blip clears: window resets
+    assert col.evaluate() == []
+    for i in range(7):
+        clk.advance_to(clk.now() + 1.0)
+        col.ingest({"step_p99": 3.0}, rank=1)
+        fired = col.evaluate()
+        assert bool(fired) == (i == 5), f"iteration {i}: {fired}"
+    assert len(col.alerts) == 1
+
+
+def test_rate_of_change_on_cumulative_counter():
+    clk = VirtualClock()
+    col = fleetmon.FleetCollector(
+        rules=[{"name": "wire_degraded", "series": "wire_retries",
+                "predicate": "rate_of_change", "op": ">", "value": 0.5,
+                "window_s": 4.0, "scope": "rank"}],
+        clock=clk, telemetry_=telemetry.DISABLED)
+    for v in (0, 0, 0, 0, 0):               # flat baseline: no alert
+        col.ingest({"wire_retries": float(v)}, rank=2)
+        assert col.evaluate() == []
+        clk.advance_to(clk.now() + 1.0)
+    for v in (3, 6, 9):                     # burst: slope ~3/s
+        col.ingest({"wire_retries": float(v)}, rank=2)
+        clk.advance_to(clk.now() + 1.0)
+    assert len(col.evaluate()) == 1
+    for _ in range(6):                      # counter flat again: clears
+        clk.advance_to(clk.now() + 1.0)
+        col.ingest({"wire_retries": 9.0}, rank=2)
+        col.evaluate()
+    assert len(col.alerts) == 1
+    for v in (12, 15, 18):                  # second fault, second episode
+        clk.advance_to(clk.now() + 1.0)
+        col.ingest({"wire_retries": float(v)}, rank=2)
+        col.evaluate()
+    assert len(col.alerts) == 2
+
+
+def test_fleet_quantile_needs_two_ranks_and_scopes_fleet():
+    clk = VirtualClock()
+    col = fleetmon.FleetCollector(
+        rules=[{"name": "queue_starved", "series": "queue_depth",
+                "predicate": "fleet_quantile", "quantile": 0.5,
+                "op": "<", "value": 1.0, "scope": "fleet",
+                "action": "flight_dump"}],
+        clock=clk, telemetry_=telemetry.DISABLED)
+    col.ingest({"queue_depth": 0.0}, rank=1)
+    assert col.evaluate() == []             # one rank is not a fleet
+    col.ingest({"queue_depth": 0.0}, rank=2)
+    col.ingest({"queue_depth": 4.0}, rank=3)
+    fired = col.evaluate()
+    assert len(fired) == 1 and fired[0]["scope"] == "fleet" \
+        and fired[0]["rank"] is None
+    assert col.pop_actions() == fired and col.pop_actions() == []
+
+
+def test_heartbeat_age_derived_and_clean_exit_retires():
+    clk = VirtualClock()
+    col = fleetmon.FleetCollector(
+        rules=[{"name": "heartbeat_lost", "series": "heartbeat_age_s",
+                "predicate": "threshold", "op": ">", "value": 5.0,
+                "scope": "rank"}],
+        clock=clk, telemetry_=telemetry.DISABLED)
+    col.ingest({"steps": 1.0}, rank=1)
+    col.ingest({"steps": 1.0}, rank=2, status="left")   # clean departure
+    clk.advance_to(clk.now() + 10.0)
+    fired = col.evaluate()
+    assert [a["rank"] for a in fired] == [1]    # the retired rank stays
+    assert col.retired == {2}                   # silent without alerting
+    # the rank streams again (a respawn): episode clears, age resets
+    col.ingest({"steps": 2.0}, rank=1)
+    assert col.evaluate() == []
+
+
+# -- emission side ------------------------------------------------------------
+
+def test_snapshot_from_telemetry_fields_and_disabled():
+    assert fleetmon.snapshot_from_telemetry(telemetry.DISABLED) == {}
+    tm = telemetry.Telemetry(rank=0, run_id="snap")
+    for v in (0.1, 0.2, 0.3):
+        tm.observe("phase.train", v)
+        tm.observe("wire.rtt", v / 10)
+    tm.gauge("images_per_sec", 123.0)
+    tm.gauge("hbm_min_headroom_bytes", 1e9)
+    tm.gauge("prefetch.queue_depth", 2.0)
+    tm.gauge("heartbeat.iter", 17.0)
+    tm.counter("wire.retry", 3)
+    snap = fleetmon.snapshot_from_telemetry(tm)
+    assert snap["img_s"] == 123.0 and snap["steps"] == 17.0
+    assert snap["queue_depth"] == 2.0
+    assert snap["hbm_headroom_bytes"] == 1e9
+    assert 0.1 <= snap["step_p50"] <= snap["step_p99"] <= 0.3
+    assert snap["wire_retries"] == 3.0
+    assert set(snap) <= set(fleetmon.METRIC_FIELDS)
+    # alert events carry the schema fields and go through ONE emitter
+    col = fleetmon.FleetCollector(rules=[_rule()], telemetry_=tm)
+    col.ingest({"step_p99": 5.0}, rank=4)
+    col.evaluate()
+    evs = [e for e in tm.tail(8) if e["ev"] == fleetmon.ALERT_EVENT]
+    assert len(evs) == 1 and evs[0]["rule"] == "r" \
+        and evs[0]["worker"] == 4 and evs[0]["threshold"] == 1.0
+
+
+def test_exposition_covers_every_series_and_restore_keeps_episodes():
+    clk = VirtualClock()
+    col = fleetmon.FleetCollector(rules=[_rule()], clock=clk,
+                                  telemetry_=telemetry.DISABLED)
+    col.ingest({k: 1.0 for k in fleetmon.METRIC_FIELDS}, rank=0)
+    col.ingest({"step_p99": 7.0}, rank=1)
+    assert len(col.evaluate()) == 1
+    text = col.expose_text()
+    for name in fleetmon.FLEET_SERIES:
+        assert f"theanompi_{name}" in text, name
+    assert "theanompi_fleet_alerts_total 1" in text
+    # snapshot/restore: alerts AND the firing state survive — a restored
+    # collector must not re-fire the episode it already alerted on
+    snap = json.loads(json.dumps(col.snapshot()))    # disk round-trip
+    col2 = fleetmon.FleetCollector(rules=[_rule()], clock=clk,
+                                   telemetry_=telemetry.DISABLED)
+    col2.restore(snap)
+    assert len(col2.alerts) == 1
+    col2.ingest({"step_p99": 7.0}, rank=1)
+    assert col2.evaluate() == []
+
+
+# -- the wire service ---------------------------------------------------------
+
+def test_server_ingest_dedup_ops_and_restart(tmp_path):
+    d = str(tmp_path)
+    srv = fleetmon.FleetMonServer(
+        rules=[_rule()], run_dir=d, snapshot_dir=os.path.join(d, "snap"),
+        eval_window_s=0.1, telemetry_=telemetry.DISABLED)
+    host, port = srv.start()
+    addr = f"{host}:{port}"
+    try:
+        tm = telemetry.Telemetry(rank=3, run_id="live")
+        tm.observe("phase.train", 2.0)
+        st = fleetmon.MetricStreamer(addr, rank=3, telemetry_=tm)
+        assert st.push()
+        # a RETRIED snapshot (same idempotency token) ingests once
+        s = socket.create_connection((host, port))
+        h = {"op": fleetmon.METRICS_OP, "rank": 9, "role": "worker",
+             "status": "live", "tok": {"w": "w9", "seq": 5}}
+        body = json.dumps({"steps": 1.0}).encode()
+        wire.send_msg(s, h, body)
+        assert wire.recv_msg(s)[0]["ok"]
+        wire.send_msg(s, h, body)
+        resp = wire.recv_msg(s)[0]
+        assert resp["ok"] and resp.get("dedup") is True
+        s.close()
+        assert srv.collector.samples_ingested == 2    # 3 sends, 2 lands
+        # ops: series / rollup / alerts / exposition / statusz health
+        c = wire.WireClient(addr, client_id="probe")
+        resp, _ = c.request({"op": "series", "rank": 3,
+                             "series": "step_p99"})
+        assert resp["ok"] and len(resp["samples"]) == 1
+        resp, body = c.request({"op": "exposition"})
+        assert resp["ok"] and b"theanompi_step_p99" in body
+        deadline = time.time() + 5.0                 # eval thread fires
+        while time.time() < deadline and not srv.collector.alerts:
+            time.sleep(0.05)
+        resp, _ = c.request({"op": "alerts"})
+        assert resp["ok"] and resp["alerts"] \
+            and resp["alerts"][0]["rule"] == "r"
+        rep = tracing.statusz_query(addr, "health")
+        assert rep["ok"] and rep["role"] == "fleetmon" \
+            and rep["samples"] == 2
+        c.close()
+        # restart on the same port restores series + alerts + episodes,
+        # and the streamer rides the outage (a failed send is dropped,
+        # the next one lands — §15 retry + §14 snapshot machinery)
+        srv.stop(deregister=False)
+        assert not st.push() and st.failed == 1
+        srv2 = fleetmon.FleetMonServer(
+            rules=[_rule()], run_dir=d,
+            snapshot_dir=os.path.join(d, "snap"), eval_window_s=0.1,
+            telemetry_=telemetry.DISABLED)
+        srv2.start(port=port)
+        try:
+            assert len(srv2.collector.alerts) >= 1
+            assert srv2.collector.samples_ingested == 2
+            assert st.push()
+            assert srv2.collector.samples_ingested == 3
+        finally:
+            srv2.stop()
+        st.stop(final=False)
+        tm.close()
+    finally:
+        srv.stop()
+
+
+# -- alert-driven supervision -------------------------------------------------
+
+def test_supervisor_tick_applies_demote_and_flight_dump(tmp_path):
+    d = str(tmp_path)
+    tm = telemetry.Telemetry(rank=0, run_id="sup", stream_dir=d)
+    srv = fleetmon.FleetMonServer(rules=fleetmon.default_rules(),
+                                  telemetry_=telemetry.DISABLED)
+    sup = ElasticSupervisor(lambda w, a: ["true"], [1, 2], str(tmp_path),
+                            record_dir=d, telemetry_=tm, fleetmon=srv)
+    sup.controller.join(1, pid=11)
+    sup.controller.join(2, pid=22)
+    # a statusz endpoint in the run dir: the fleet-wide flight dump
+    # must reach it (the §17 `flight` op)
+    sz = tracing.StatuszServer("worker", ident=1, run_dir=d,
+                               telemetry_=tm)
+    sz.start()
+    try:
+        srv.collector.alerts.append({})   # not actionable — ignored
+        srv.collector.actions.append(
+            {"rule": "heartbeat_lost", "series": "heartbeat_age_s",
+             "rank": 1, "value": 30.0, "threshold": 10.0,
+             "action": "demote"})
+        srv.collector.actions.append(
+            {"rule": "queue_starved", "series": "queue_depth",
+             "rank": None, "value": 0.0, "threshold": 1.0,
+             "action": "flight_dump"})
+        sup._tick_fleetmon()
+        assert sup.alert_demotions == [("heartbeat_lost", 1)]
+        assert sup.controller.workers[1]["status"] == "demoted"
+        demotes = [e for e in tm.tail(16) if e["ev"] == "worker_demote"]
+        assert demotes and demotes[-1]["rule"] == "heartbeat_lost" \
+            and demotes[-1]["reason"] == "alert"
+        assert sup.flight_dumps_requested == 1
+        assert os.path.exists(os.path.join(d, "flight_rank0.jsonl"))
+        # the supervisor's own liveness sample joined the fleet view
+        assert -2 in srv.collector.roles
+    finally:
+        sz.stop()
+        tm.close()
+
+
+# -- fleetz: roster/exit-code contracts + --watch -----------------------------
+
+def test_fleetz_watch_single_iteration_and_down_exit(tmp_path):
+    d = str(tmp_path)
+    tm = telemetry.Telemetry(rank=1, run_id="fz", stream_dir=d)
+    sz = tracing.StatuszServer("worker", ident=1, run_dir=d,
+                               telemetry_=tm)
+    sz.start()
+    srv = fleetmon.FleetMonServer(rules=[_rule()], run_dir=d,
+                                  eval_window_s=0.1,
+                                  telemetry_=telemetry.DISABLED)
+    srv.start()
+    srv.collector.ingest({"step_p99": 5.0}, rank=1)
+    deadline = time.time() + 5.0
+    while time.time() < deadline and not srv.collector.alerts:
+        time.sleep(0.05)
+    try:
+        # healthy roster: --watch --iterations 1 runs ONE frame, exits 0,
+        # and surfaces the collector's alert line in the live view
+        out = subprocess.run(
+            [sys.executable, os.path.join(REPO, "scripts", "fleetz.py"),
+             d, "--watch", "--iterations", "1"],
+            capture_output=True, text=True)
+        assert out.returncode == 0, out.stderr + out.stdout
+        assert "fleetz watch frame 1" in out.stdout
+        assert "fleetmon" in out.stdout and "ALERT r" in out.stdout
+        # a ghost doc (crashed process kept its roster entry): DOWN → 2,
+        # same contract in watch mode
+        ghost = os.path.join(tracing.statusz_dir(d), "worker_9.json")
+        with open(ghost, "w") as f:
+            json.dump({"role": "worker", "id": 9, "pid": 99999,
+                       "host": "127.0.0.1", "port": 9}, f)
+        out = subprocess.run(
+            [sys.executable, os.path.join(REPO, "scripts", "fleetz.py"),
+             d, "--watch", "--iterations", "1", "--timeout", "0.5"],
+            capture_output=True, text=True)
+        assert out.returncode == 2, out.stderr + out.stdout
+        assert "DOWN" in out.stdout
+    finally:
+        srv.stop()
+        sz.stop()
+        tm.close()
+
+
+# -- the simfleet rehearsal (§20 acceptance) ----------------------------------
+
+def _rehearsal(seed=5):
+    sched = chaos.parse_schedule("kill@10:3,stop@12:4:25,delay@8:5:40")
+    net = chaos.parse_schedule("net_partition@20:-1:6")
+    f = FleetSim(n_workers=12, steps=800, sync_freq=8, seed=seed,
+                 n_stragglers=0, schedule=list(sched),
+                 net_schedule=list(net), fleetmon=True)
+    f.run()
+    return f
+
+
+def test_simfleet_rehearsal_exact_alerts_deterministic_no_flapping():
+    f1, f2 = _rehearsal(), _rehearsal()
+    # same seed ⇒ byte-identical event log INCLUDING the alert lines
+    assert f1.log.sha256() == f2.log.sha256()
+    alerts = f1.log.select("alert")
+    got = sorted((a["rule"], a["worker"]) for a in alerts)
+    # the expected alert set for this schedule, exactly: the delayed
+    # straggler (w5) trips the sustained step-time rule, the wedge (w4)
+    # outlives the lease timeout, the partition's retry bursts trip the
+    # wire rate rule on the workers caught mid-push; the KILL (w3) is
+    # healed by supervised respawn faster than any heartbeat threshold —
+    # it must NOT alert (that is the supervision plane's job)
+    assert ("step_time_degraded", 5) in got
+    assert ("heartbeat_lost", 4) in got
+    assert any(r == "wire_degraded" for r, _ in got)
+    assert not any(w == 3 and r == "heartbeat_lost" for r, w in got)
+    # no flapping: one alert per (rule, rank) episode in this schedule
+    assert len(got) == len(set(got))
+    # the audit closes: every covered landed fault matched to its alert
+    # within one evaluation window (virtual time base on both sides)
+    ok, lines = fleetmon.audit_alerts(
+        f1.health.collector.alerts, f1.realized,
+        f1.health.collector.rules,
+        eval_window_s=f1.health.eval_window_s,
+        interval_s=FleetSim.BEAT_EVERY_S)
+    assert ok, "\n".join(lines)
+    assert sum("alert-audit:" in ln for ln in lines) >= 3
+    assert f1.summary["fleetmon"]["alerts"] == len(alerts)
+    assert f1.summary["finished"] == 12
+
+
+def test_simfleet_default_has_no_health_plane():
+    # fleetmon off (the default): no collector, no summary key, so the
+    # §18 determinism hashes of existing gates are untouched
+    f = FleetSim(n_workers=4, steps=64, sync_freq=8, seed=1)
+    f.run()
+    assert f.health is None and "fleetmon" not in f.summary
+    assert f.log.select("alert") == []
+
+
+# -- the live chaos alert-audit ----------------------------------------------
+
+def test_live_alert_audit_stop_and_delay(tmp_path):
+    """Live machinery end to end, no subprocesses: three streamers over
+    real sockets feed the real collector; a SIGSTOP-shaped fault (one
+    streamer silenced) and a delay-shaped fault (one rank's step
+    histogram inflated) land per a real chaos schedule, and the §20
+    audit matches each landed fault to its alert within one evaluation
+    window."""
+    rules = [
+        {"name": "heartbeat_lost", "series": "heartbeat_age_s",
+         "predicate": "threshold", "op": ">", "value": 1.2,
+         "scope": "rank", "action": "demote", "roles": ("worker",)},
+        {"name": "step_time_degraded", "series": "step_p99",
+         "predicate": "sustained", "op": ">", "value": 0.5,
+         "window_s": 0.6, "scope": "rank", "roles": ("worker",)},
+    ]
+    tm0 = telemetry.Telemetry(rank=0, run_id="audit",
+                              stream_dir=str(tmp_path))
+    srv = fleetmon.FleetMonServer(rules=rules, eval_window_s=0.2,
+                                  telemetry_=tm0)
+    host, port = srv.start()
+    addr = f"{host}:{port}"
+    schedule = chaos.parse_schedule("stop@0.6:2:2.0,delay@0.6:3:1.5")
+    tms, streamers = {}, {}
+    try:
+        for rank in (1, 2, 3):
+            tms[rank] = telemetry.Telemetry(rank=rank, run_id="audit")
+            tms[rank].observe("phase.train", 0.1)
+            streamers[rank] = fleetmon.MetricStreamer(
+                addr, rank=rank, interval_s=0.2, telemetry_=tms[rank])
+            streamers[rank].start()
+        t0 = time.time()
+        realized = []
+        for f in schedule:                    # land the faults
+            time.sleep(max(0.0, t0 + f.at - time.time()))
+            realized.append({"ts": time.time(), "kind": f.kind,
+                             "target": f.target,
+                             "duration": f.duration, "error": None})
+            if f.kind == "stop":              # SIGSTOP: silence, resume
+                streamers[f.target]._halt.set()
+                streamers[f.target].join(timeout=2)
+            else:                             # delay: inflated steps
+                for _ in range(8):
+                    tms[f.target].observe("phase.train", 2.0)
+        time.sleep(2.6)                       # wedge runs its duration
+        streamers[2] = fleetmon.MetricStreamer(   # SIGCONT: beats resume
+            addr, rank=2, interval_s=0.2, telemetry_=tms[2])
+        streamers[2].start()
+        time.sleep(0.6)
+        alerts = list(srv.collector.alerts)
+        ok, lines = fleetmon.audit_alerts(alerts, realized, rules,
+                                          eval_window_s=0.2,
+                                          interval_s=0.2)
+        assert ok, "\n".join(lines) + f"\nalerts: {alerts}"
+        # ... and the alert EVENTS landed in the telemetry stream with
+        # the demote action queued for the supervisor
+        evs = [e for e in tm0.tail(16) if e["ev"] == fleetmon.ALERT_EVENT]
+        assert any(e["rule"] == "heartbeat_lost" and e["worker"] == 2
+                   for e in evs)
+        assert any(a["action"] == "demote"
+                   for a in srv.collector.pop_actions())
+    finally:
+        for st in streamers.values():
+            st.stop(final=False)
+        for t in tms.values():
+            t.close()
+        srv.stop()
+        tm0.close()
+
+
+# -- report + drift-probe integration ----------------------------------------
+
+def test_report_renders_alert_markers_and_cites_wire_alerts(tmp_path):
+    d = str(tmp_path)
+    tm = telemetry.Telemetry(rank=0, run_id="rep", stream_dir=d)
+    col = fleetmon.FleetCollector(
+        rules=[{"name": "wire_degraded", "series": "wire_retries",
+                "predicate": "rate_of_change", "op": ">", "value": 0.5,
+                "window_s": 0.2, "scope": "rank"}],
+        telemetry_=tm)
+    col.ingest({"wire_retries": 0.0}, rank=1)
+    time.sleep(0.25)
+    col.ingest({"wire_retries": 9.0}, rank=1)
+    col.evaluate()
+    assert len(col.alerts) == 1
+    tm.counter("wire.retry", 9)     # the wire-health row the citation
+    tm.close()                      # attaches to
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        "_fleetmon_report", os.path.join(REPO, "scripts",
+                                         "telemetry_report.py"))
+    tr_mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(tr_mod)
+    events = tr_mod.load_events(d)
+    rep = tr_mod.build_report(d, events=events)
+    assert rep["alerts"] and rep["alerts"][0]["rule"] == "wire_degraded"
+    trace = tr_mod.build_trace(events)
+    markers = [e for e in trace["traceEvents"]
+               if e.get("cat") == "alert"
+               and str(e.get("name", "")).startswith("alert:")]
+    assert markers and "wire_degraded" in markers[0]["name"] \
+        and "=" in markers[0]["name"]         # rule name + firing value
+    import contextlib
+    import io
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        tr_mod.print_report(rep)
+    out = buf.getvalue()
+    assert "alerts fired: wire_degraded[w1]" in out
+    assert "fleet-health alerts" in out
+
+
+def test_schema_drift_fleetmon_probes_clean():
+    from theanompi_tpu.analysis.checkers import schema_drift as sd
+    membership = sd._load_by_path(
+        os.path.join("theanompi_tpu", "parallel", "membership.py"),
+        "_t_fleetmon_membership")
+    report = sd._load_telemetry_report()
+    errors = sd.fleetmon_schema_errors(fleetmon, membership, telemetry,
+                                       report)
+    assert errors == [], errors
+    # and the probe FIRES on a broken vocabulary: a coverage entry
+    # naming a rule that no stock set defines
+    orig = fleetmon.FAULT_ALERT_COVERAGE
+    fleetmon.FAULT_ALERT_COVERAGE = dict(orig, delay=("renamed_rule",))
+    try:
+        errors = sd.fleetmon_schema_errors(fleetmon, membership,
+                                           telemetry, report)
+        assert any("renamed_rule" in str(e) for e in errors)
+    finally:
+        fleetmon.FAULT_ALERT_COVERAGE = orig

@@ -491,6 +491,38 @@ def test_module_level_emit_helpers_are_recording_calls(tmp_path):
                         "telemetry-hot-path") == []
 
 
+FLEETMON_BAD = """
+from theanompi_tpu.utils import fleetmon, telemetry
+
+def eval_loop(alerts):
+    tm = telemetry.active()
+    for a in alerts:
+        fleetmon.emit_alert(tm, a)
+"""
+
+FLEETMON_GOOD = """
+from theanompi_tpu.utils import fleetmon, telemetry
+
+def eval_loop(alerts):
+    tm = telemetry.active()
+    for a in alerts:
+        if tm.enabled:
+            fleetmon.emit_alert(tm, a)
+"""
+
+
+def test_fleetmon_emission_api_is_a_recording_call(tmp_path):
+    """Round 18: the checker knows the fleet-health emission API —
+    `fleetmon.emit_alert(...)` unguarded in a hot file (fleetmon.py
+    itself joined the set) is a finding; the enabled guard clears it."""
+    found = lint_snippet(tmp_path, "fleetmon.py", FLEETMON_BAD,
+                         "telemetry-hot-path")
+    assert len(found) == 1
+    assert "emit_alert" in found[0].message
+    assert lint_snippet(tmp_path, "fleetmon.py", FLEETMON_GOOD,
+                        "telemetry-hot-path") == []
+
+
 def test_telemetry_hot_path_only_applies_to_hot_files(tmp_path):
     # the same unguarded call in a non-hot-path file is NOT a finding
     assert lint_snippet(tmp_path, "report_tool.py", TELEMETRY_BAD,

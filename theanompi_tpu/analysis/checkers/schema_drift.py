@@ -43,6 +43,7 @@ MEMBERSHIP_PATH = "theanompi_tpu/parallel/membership.py"
 CHAOS_PATH = "theanompi_tpu/utils/chaos.py"
 WIRE_PATH = "theanompi_tpu/parallel/wire.py"
 TRACING_PATH = "theanompi_tpu/utils/tracing.py"
+FLEETMON_PATH = "theanompi_tpu/utils/fleetmon.py"
 
 # one lane, one module: a compute span [0,50]us and a comm span [40,60]us
 # → compute 50us, comm 20us, exposed 10us, overlap 0.5 — a COMPLETE
@@ -557,6 +558,130 @@ def tracing_schema_errors(tracing, telemetry,
     return errors
 
 
+def fleetmon_schema_errors(fleetmon, membership, telemetry,
+                           telemetry_report=None) -> List[tuple]:
+    """Round-18 probes: the fleet-health vocabulary (docs/design.md
+    §20).  LIVE checks, all jax-free:
+
+    * the stock rule sets pass their own grammar validator, and every
+      rule name :data:`FAULT_ALERT_COVERAGE` promises the alert-audit
+      exists in the full stock set — a renamed rule would silently
+      vacate the audit;
+    * a live collector fed a breaching sample fires EXACTLY ONE
+      ``alert`` event carrying rule/series/worker/value/threshold, and
+      does NOT re-fire while the breach persists (the no-flapping
+      episode contract IS schema);
+    * a demote-actioned alert driven through :func:`fleetmon.apply_alert`
+      lands a ``worker_demote`` event CITING the firing rule by name,
+      and that name exists in the rule set;
+    * the text exposition covers every registered fleet series;
+    * the report tracks the ``alert`` event kind."""
+    errors: List[tuple] = []
+    if fleetmon is None:
+        return errors
+
+    # 1. rule grammar: the stock sets must validate, and the audit's
+    # coverage map must name real rules (the FULL set — step_time rules
+    # are opt-in by threshold)
+    try:
+        fleetmon.validate_rules(fleetmon.DEFAULT_RULES)
+        full = fleetmon.validate_rules(fleetmon.default_rules(
+            step_p99_s=1.0, hbm_headroom_bytes=1.0))
+    except ValueError as e:
+        errors.append((FLEETMON_PATH,
+                       f"the stock rule set fails its own validator: {e}"))
+        full = []
+    full_names = {r["name"] for r in full}
+    for kind, names in fleetmon.FAULT_ALERT_COVERAGE.items():
+        missing = sorted(set(names) - full_names)
+        if missing:
+            errors.append((FLEETMON_PATH,
+                           f"FAULT_ALERT_COVERAGE[{kind!r}] names rule(s) "
+                           f"{missing} absent from default_rules(...) — "
+                           "the alert-audit for that fault kind is "
+                           "vacuously uncovered"))
+
+    # 2. a live breach fires exactly one schema-complete alert event,
+    # and holds (no flapping) while the breach persists
+    tm = telemetry.Telemetry(rank=0, run_id="drift-check")
+    rules = [{"name": "probe_rule", "series": "step_p99",
+              "predicate": "threshold", "op": ">", "value": 1.0,
+              "scope": "rank"}]
+    col = fleetmon.FleetCollector(rules=rules, telemetry_=tm)
+    col.ingest({"step_p99": 5.0}, rank=3)
+    first = col.evaluate()
+    col.ingest({"step_p99": 6.0}, rank=3)
+    second = col.evaluate()
+    evs = [e for e in tm.tail(8) if e["ev"] == fleetmon.ALERT_EVENT]
+    if len(first) != 1 or len(evs) != 1:
+        errors.append((FLEETMON_PATH,
+                       f"one breaching sample fired {len(first)} alert(s) "
+                       f"/ {len(evs)} event(s) — expected exactly 1"))
+    elif second:
+        errors.append((FLEETMON_PATH,
+                       "a persisting breach RE-fired on the next "
+                       "evaluation — the no-flapping episode contract "
+                       "is broken"))
+    else:
+        ev = evs[-1]
+        missing = [k for k in ("rule", "series", "worker", "value",
+                               "threshold") if k not in ev]
+        if missing:
+            errors.append((FLEETMON_PATH,
+                           f"alert event lacks field(s) {missing}: "
+                           f"{sorted(ev)}"))
+
+    # 3. an alert-driven demotion cites a real rule name in the
+    # worker_demote event (the §20 closed loop)
+    if membership is not None:
+        tm2 = telemetry.Telemetry(rank=0, run_id="drift-check")
+        ctl = membership.MembershipController(telemetry_=tm2)
+        ctl.join(1, pid=1)
+        ctl.join(2, pid=2)
+        alert = {"rule": "probe_rule", "series": "step_p99",
+                 "rank": 1, "value": 5.0, "threshold": 1.0,
+                 "action": "demote"}
+        if not fleetmon.apply_alert(ctl, alert):
+            errors.append((FLEETMON_PATH,
+                           "apply_alert did not demote a live worker"))
+        else:
+            demotes = [e for e in tm2.tail(8)
+                       if e["ev"] == "worker_demote"]
+            if not demotes or demotes[-1].get("rule") != "probe_rule":
+                errors.append((FLEETMON_PATH,
+                               f"alert-driven worker_demote does not "
+                               f"cite the firing rule: "
+                               f"{demotes[-1] if demotes else None}"))
+            elif demotes[-1]["rule"] not in {r["name"] for r in
+                                             col.rules}:
+                errors.append((FLEETMON_PATH,
+                               f"demote cites rule "
+                               f"{demotes[-1]['rule']!r} that exists in "
+                               "no active rule set"))
+
+    # 4. the exposition covers every registered fleet series
+    col2 = fleetmon.FleetCollector(rules=[], telemetry_=telemetry.DISABLED)
+    col2.ingest({k: 1.0 for k in fleetmon.METRIC_FIELDS}, rank=0)
+    text = col2.expose_text()
+    missing = [s for s in fleetmon.FLEET_SERIES
+               if ("theanompi_" + s) not in text]
+    if missing:
+        errors.append((FLEETMON_PATH,
+                       f"expose_text() omits registered fleet series "
+                       f"{missing} — a scrape would silently miss them"))
+
+    # 5. the report consumes the alert vocabulary
+    if telemetry_report is not None:
+        tracked = set(getattr(telemetry_report, "TRACKED_EVENTS", ()))
+        missing = sorted(set(fleetmon.ALERT_EVENTS) - tracked)
+        if missing:
+            errors.append((REPORT_PATH,
+                           f"TRACKED_EVENTS is missing fleet-health "
+                           f"event kind(s) {missing} — alerts would be "
+                           "dropped from report and Perfetto export"))
+    return errors
+
+
 def thread_role_coverage_errors(root: Optional[str] = None) -> List[tuple]:
     """Round-15 probe: the host-concurrency pass is only as good as its
     thread-role map, so every ``threading.Thread(...)``/``Timer(...)``
@@ -696,6 +821,16 @@ class SchemaDriftChecker(Checker):
         except ImportError:
             tracing_mod = None
         errors += tracing_schema_errors(tracing_mod, telemetry, report)
+        # round 18: the fleet-health plane — rule grammar, alert event
+        # schema + no-flapping, rule-cited demotions, exposition
+        # coverage (utils/fleetmon is stdlib-only by contract,
+        # importable through the synthetic package like telemetry)
+        try:
+            from theanompi_tpu.utils import fleetmon as fleetmon_mod
+        except ImportError:
+            fleetmon_mod = None
+        errors += fleetmon_schema_errors(fleetmon_mod, membership,
+                                         telemetry, report)
         # round 15: the thread-role map must see and resolve every
         # Thread/Timer spawn in the thread-heaviest runtime modules
         errors += thread_role_coverage_errors()

@@ -572,6 +572,9 @@ def center_main(argv: Optional[List[str]] = None) -> int:
     ap.add_argument("--lease-id", type=int, default=0)
     ap.add_argument("--record-dir", default=None)
     ap.add_argument("--run-id", default=None)
+    ap.add_argument("--metrics-addr", default=None,
+                    help="fleet-health collector address (utils/fleetmon"
+                         ") — the center streams metric snapshots there")
     ap.add_argument("--max-seconds", type=float, default=0.0,
                     help="self-terminate after this long (0 = forever)")
     args = ap.parse_args(argv)
@@ -612,6 +615,17 @@ def center_main(argv: Optional[List[str]] = None) -> int:
         lease = WorkerLease(args.lease_dir, args.lease_id, telemetry_=tm)
         lease.beat(srv.center.n_updates)
 
+    # fleet health plane (§20): the center is a long-lived process too —
+    # its snapshot stream (rank −1, role `center`) puts its apply rate
+    # and liveness on the same fleet dashboard as the workers'
+    streamer = None
+    if args.metrics_addr:
+        from ..utils.fleetmon import MetricStreamer
+        streamer = MetricStreamer(
+            args.metrics_addr, rank=-1, role="center", telemetry_=tm,
+            extra=lambda: {"steps": srv.center.n_updates})
+        streamer.start()
+
     halt = threading.Event()
     signal.signal(signal.SIGTERM, lambda *_: halt.set())
     try:
@@ -625,6 +639,8 @@ def center_main(argv: Optional[List[str]] = None) -> int:
         if args.max_seconds and time.time() - t0 > args.max_seconds:
             break
     srv.stop(final_snapshot=True)
+    if streamer is not None:
+        streamer.stop(final=True)     # clean exit: retire, don't alert
     if statusz is not None:
         statusz.stop()
     if lease is not None:

@@ -671,7 +671,7 @@ class ElasticSupervisor:
                  center_addr: Optional[str] = None,
                  center_max_restarts: int = 5,
                  center_lease_dir: Optional[str] = None,
-                 verbose: bool = True, clock=None):
+                 verbose: bool = True, clock=None, fleetmon=None):
         self.cmd_for = cmd_for
         self.worker_ids = [int(w) for w in worker_ids]
         self.lease_dir = lease_dir
@@ -711,6 +711,12 @@ class ElasticSupervisor:
         self._center_due: Optional[float] = None      # pending respawn ts
         self._center_probe = False                    # awaiting restored?
         self._center_downs = 0
+        # -- fleet health plane (round 18, docs/design.md §20): a
+        # FleetMonServer whose collector's actionable alerts this loop
+        # drains — the alert-driven half of supervision
+        self.fleetmon = fleetmon
+        self.alert_demotions: List[Tuple[str, int]] = []
+        self.flight_dumps_requested = 0
 
     # chaos harness hook: the CURRENT pid of a worker (None between lives);
     # target CENTER_ID resolves the supervised center process
@@ -823,6 +829,36 @@ class ElasticSupervisor:
                     flush()
         return False
 
+    # -- alert-driven supervision (round 18) ---------------------------------
+
+    def _tick_fleetmon(self) -> None:
+        """Drain the collector's actionable alerts: a per-rank ``demote``
+        alert feeds the EXISTING demotion path with the firing rule
+        cited in the ``worker_demote`` event (``fleetmon.apply_alert``),
+        and a fleet-scoped ``flight_dump`` alert asks every statusz
+        endpoint for its flight ring.  The supervisor also ingests its
+        own liveness sample, so the fleet view includes it."""
+        fm = self.fleetmon
+        if fm is None:
+            return
+        from ..utils import fleetmon as _fleetmon
+        fm.collector.ingest({"steps": float(len(self.done))}, rank=-2,
+                            role="supervisor")
+        for alert in fm.collector.pop_actions():
+            if alert.get("action") == "demote":
+                if _fleetmon.apply_alert(self.controller, alert):
+                    self.alert_demotions.append(
+                        (str(alert.get("rule")), int(alert["rank"])))
+                    self._log(f"alert {alert['rule']} "
+                              f"(value {alert.get('value')}) demoted "
+                              f"worker {alert['rank']}")
+            elif alert.get("action") == "flight_dump" and self.record_dir:
+                paths = _fleetmon.fleet_flight_dump(
+                    self.record_dir, reason=f"alert {alert.get('rule')}")
+                self.flight_dumps_requested += 1
+                self._log(f"alert {alert['rule']}: fleet-wide flight "
+                          f"dump ({len(paths)} ring(s) written)")
+
     def _stop_center(self) -> None:
         p = self.center_proc
         if p is None:
@@ -889,7 +925,8 @@ class ElasticSupervisor:
                 extra=lambda: {"workers": self.controller.status(),
                                "done": sorted(self.done),
                                "failed": sorted(self.failed),
-                               "center_downs": self._center_downs})
+                               "center_downs": self._center_downs,
+                               "alert_demotions": len(self.alert_demotions)})
             statusz.start()
         if self.center_cmd_for is not None:
             self._spawn_center()
@@ -942,6 +979,10 @@ class ElasticSupervisor:
                         self._straggle_poll_s:
                     self._last_straggle_check = self.clock.now()
                     self.controller.check_stragglers()
+                # 3b. alert-driven supervision: drain the fleet-health
+                # collector's actionable alerts (rule-cited demotions,
+                # fleet-wide flight dumps)
+                self._tick_fleetmon()
                 # 4. due respawns
                 now = self.clock.now()
                 due = [w for ts, w in self._pending if ts <= now]
@@ -1066,6 +1107,18 @@ def elastic_worker_main(argv: Optional[Sequence[str]] = None) -> int:
                 "exchanges": trainer.islands[0].exchanges_done,
                 "skipped": trainer.islands[0].exchanges_skipped})
         statusz.start()
+    # fleet health plane (§20): stream this island's metric snapshots
+    # to the run's FleetCollector — the snapshot stream doubles as the
+    # health heartbeat (a kill/wedge silences it with no cooperation)
+    streamer = None
+    if cfg.get("metrics_addr"):
+        from ..utils.fleetmon import MetricStreamer
+        streamer = MetricStreamer(
+            str(cfg["metrics_addr"]), rank=island, role="worker",
+            interval_s=float(cfg.get("metrics_interval_s", 1.0)),
+            telemetry_=tm,
+            extra=lambda: {"steps": trainer.islands[0].steps_done})
+        streamer.start()
     rc = 0
     try:
         while True:
@@ -1086,6 +1139,10 @@ def elastic_worker_main(argv: Optional[Sequence[str]] = None) -> int:
         rc = 1
         raise
     finally:
+        if streamer is not None:
+            # a clean exit sends one final `left` sample so the collector
+            # retires this rank instead of alerting on its silence
+            streamer.stop(final=(rc == 0))
         if statusz is not None:
             # a crashed/failed worker keeps its discovery doc: fleetz
             # must list it DOWN, not lose it from the roster
@@ -1182,6 +1239,10 @@ def run_elastic(rule: str, modelfile: str, modelclass: str,
                    "--run-id", str(run_id)]
             if record_dir:
                 cmd += ["--record-dir", record_dir]
+            if metrics_addr:
+                # bound at spawn time: the fleetmon server starts before
+                # the supervisor spawns anything
+                cmd += ["--metrics-addr", metrics_addr]
             return cmd
 
         # the supervisor's own client: SHORT deadline — reactor calls and
@@ -1222,9 +1283,33 @@ def run_elastic(rule: str, modelfile: str, modelclass: str,
                            realized_path=realized)
         worker_addr = proxy.start()
 
+    # fleet health plane (round 18, docs/design.md §20): a FleetCollector
+    # service every process streams metric snapshots to; its rule engine
+    # emits `alert` events into the run's telemetry stream and queues
+    # actionable alerts the supervisor loop drains.  The metrics wire is
+    # DIRECT (never through the chaos proxy): observability must survive
+    # the faults it reports on.
+    fleetmon_srv = None
+    metrics_addr = None
+    if record_dir and config.get("fleetmon"):
+        from ..utils.fleetmon import FleetMonServer, default_rules
+        rules = config.get("fleetmon_rules") or default_rules(
+            heartbeat_s=float(config.get("fleetmon_heartbeat_s", 10.0)),
+            step_p99_s=config.get("fleetmon_step_p99_s"),
+            step_window_s=float(config.get("fleetmon_step_window_s", 10.0)))
+        fleetmon_srv = FleetMonServer(
+            rules=rules, run_dir=record_dir,
+            snapshot_dir=os.path.join(record_dir, "fleetmon_snap"),
+            eval_window_s=float(config.get("fleetmon_eval_s", 2.0)),
+            telemetry_=tm)
+        fh, fp = fleetmon_srv.start()
+        metrics_addr = f"{fh}:{fp}"
+
     base_kv = dict(config)
     for drop in ("lease_dir", "record_dir", "run_id", "center_addr",
-                 "rule", "n_workers"):
+                 "rule", "n_workers", "fleetmon", "fleetmon_rules",
+                 "fleetmon_heartbeat_s", "fleetmon_step_p99_s",
+                 "fleetmon_step_window_s", "fleetmon_eval_s"):
         base_kv.pop(drop, None)
 
     def cmd_for(wid: int, attempt: int) -> List[str]:
@@ -1233,12 +1318,15 @@ def run_elastic(rule: str, modelfile: str, modelclass: str,
                   steps=steps, host_devices=host_devices, run_id=run_id)
         if record_dir:
             kv["record_dir"] = record_dir
+        if metrics_addr:
+            kv["metrics_addr"] = metrics_addr
         return [sys.executable, "-m", "theanompi_tpu.parallel.membership",
                 rule, modelfile, modelclass] + \
             [f"{k}={v}" for k, v in sorted(kv.items())]
 
     kw = dict(record_dir=record_dir, telemetry_=tm,
-              reactors=(CenterReactor(center_handle),), verbose=verbose)
+              reactors=(CenterReactor(center_handle),), verbose=verbose,
+              fleetmon=fleetmon_srv)
     kw.update(center_kw)
     kw.update(supervisor_kw or {})
     sup = ElasticSupervisor(cmd_for, list(range(1, n_workers + 1)),
@@ -1310,6 +1398,8 @@ def run_elastic(rule: str, modelfile: str, modelclass: str,
                 pass
         if srv is not None:
             srv.stop()
+        if fleetmon_srv is not None:
+            fleetmon_srv.stop()
         if tm.enabled:
             tm.event("elastic_end", rc=rc,
                      status=sup.controller.status())

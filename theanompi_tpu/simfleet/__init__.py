@@ -31,4 +31,5 @@ fidelity cross-check) and :class:`simfleet.fleet.FleetSim`.
 from .clock import VirtualClock                              # noqa: F401
 from .events import EventLog, EventQueue                     # noqa: F401
 from .fleet import FleetSim                                  # noqa: F401
+from .health import HealthPlane                              # noqa: F401
 from .invariants import check_invariants                     # noqa: F401

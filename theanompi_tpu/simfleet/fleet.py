@@ -182,7 +182,9 @@ class FleetSim:
                  straggle_ratio: float = 2.0,
                  exch_prob: float = 0.25, n_perms: int = 16,
                  gossip: bool = True, center_outage_s: float = 2.0,
-                 horizon_s: Optional[float] = None):
+                 horizon_s: Optional[float] = None,
+                 fleetmon: bool = False, fleetmon_rules=None,
+                 fleetmon_eval_s: float = 2.0):
         self.n_workers = int(n_workers)
         self.steps_goal = int(steps)
         self.sync_freq = max(1, int(sync_freq))
@@ -275,6 +277,15 @@ class FleetSim:
                                       center=self.center,
                                       latency_s=latency_s,
                                       op_timeout_s=self.op_timeout_s)
+        # fleet health plane rehearsal (round 18, docs/design.md §20):
+        # the REAL FleetCollector + rule engine on the virtual clock —
+        # off by default so the §18 determinism hashes are unchanged;
+        # enabled, its alerts join the canonical event log
+        self.health = None
+        if fleetmon:
+            from .health import HealthPlane
+            self.health = HealthPlane(self, rules=fleetmon_rules,
+                                      eval_window_s=fleetmon_eval_s)
         self.finished: set = set()
         self.failed: set = set()
         self.deaths = 0
@@ -339,6 +350,10 @@ class FleetSim:
         self.lease_table[w.wid] = {"worker": w.wid, "pid": None,
                                    "ts": now, "step": w.steps_done,
                                    "status": status}
+        if self.health is not None:
+            # a lease beat doubles as a metric-snapshot arrival (the
+            # live MetricStreamer cadence) — kills/wedges silence it
+            self.health.on_beat(w.wid, status, w.steps_done)
 
     def _schedule_beats(self, wid: int, gen: int, t_from: float,
                         t_until: float) -> None:
@@ -480,6 +495,8 @@ class FleetSim:
         # (retry stalls, delay windows), so network trouble surfaces in
         # the ranking exactly as it does in the live phase brackets
         self._window_sample(w, now - w.round_t0)
+        if self.health is not None:
+            self.health.on_round(w.wid, now - w.round_t0)
         w.round_t0 = now
         self._beat(w)
         w.steps_done += self.sync_freq
@@ -527,6 +544,10 @@ class FleetSim:
         # real wire backoff, up to the wire retry budget; past it the
         # island skips the exchange (wire.exchange_skipped semantics)
         w.retry_attempts[shard] = attempt + 1
+        if self.health is not None:
+            # the live wire.retry counter tick — the wire_degraded rate
+            # rule's raw signal
+            self.health.on_wire_retry(wid)
         if attempt + 1 > self.wire_max_retries:
             self.skips += 1
             self.log.append(self._now(), "exchange_skipped", worker=wid,
@@ -717,6 +738,8 @@ class FleetSim:
             self.queue.push(f.at, lambda fault=f: self._realize(fault))
         self.queue.push(self.poll_s, self._poll)
         self.queue.push(self.straggle_poll_s, self._straggle_check)
+        if self.health is not None:
+            self.health.install()
         if self.gossip_on:
             self.queue.push(self.sync_freq * self.step_time_s,
                             self._gossip_round)
@@ -747,5 +770,7 @@ class FleetSim:
             "stragglers": self.stragglers,
             "stopped": self.stopped_reason,
         }
+        if self.health is not None:
+            self.summary["fleetmon"] = self.health.summary()
         self.log.append(now, "summary", **self.summary)
         return self.summary

@@ -98,6 +98,12 @@ TRACE_ROW_COLUMNS = (
     "device_compute_secs",
     "device_comm_secs",
     "device_mfu",
+    # per-lane idle share between compute intervals inside the dispatch
+    # window (ROADMAP item 2's pipeline-schedule acceptance metric):
+    # span-weighted over compute lanes, 1 − busy/span per lane.  Exposed
+    # same-lane collectives count as bubble deliberately — from the
+    # compute pipeline's perspective a stall is a stall.
+    "bubble_fraction",
 )
 
 # The bench-row columns BENCH_BUCKET_BYTES adds (the bucketed-wire rows,
@@ -343,6 +349,24 @@ def attribute(events: Iterable[dict]) -> Dict[str, Any]:
         return comm_us, comp_us, exposed_us
 
     comm_us, comp_us, exposed_us = _breakdown(comm_ev, comp_iv)
+    # bubble fraction: per compute lane, the dispatch window is that
+    # lane's first-compute-start → last-compute-end; everything inside
+    # it with NO compute running on the lane is bubble (pipeline
+    # fill/drain gaps, microbatch waits, exposed same-lane collectives).
+    # Span-weighted across lanes so a short-lived lane can't swamp the
+    # verdict; None when the trace carries no compute.
+    bubble_span_us = bubble_idle_us = 0.0
+    for lane, ivs in comp_iv.items():
+        u = _union(ivs)
+        if not u:
+            continue
+        span = u[-1][1] - u[0][0]
+        if span <= 0:
+            continue
+        bubble_span_us += span
+        bubble_idle_us += span - _measure(u)
+    bubble_fraction = round(bubble_idle_us / bubble_span_us, 4) \
+        if bubble_span_us > 0 else None
     modules: Dict[str, dict] = {}
     for mod, m in per_module.items():
         mc, mp, mx = _breakdown(m["comm"], m["compute"])
@@ -364,6 +388,7 @@ def attribute(events: Iterable[dict]) -> Dict[str, Any]:
         "exposed_comm_secs": round(exposed, 6),
         "overlap_ratio": (round(1.0 - exposed / comm_secs, 4)
                           if comm_secs > 0 else None),
+        "bubble_fraction": bubble_fraction,
         "lanes": len(set(comm_ev) | set(comp_iv)),
         # lanes that actually carry compute — the denominator for
         # per-device compute-busy time (a dedicated async collective
@@ -505,6 +530,7 @@ def profile_row_fields(profile: Dict[str, Any],
         "device_compute_secs": profile.get("compute_secs"),
         "device_comm_secs": profile.get("comm_secs"),
         "device_mfu": mfu,
+        "bubble_fraction": profile.get("bubble_fraction"),
     }
 
 

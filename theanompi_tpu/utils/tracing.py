@@ -85,7 +85,7 @@ COMPONENTS = ("compute", "stage", "wire", "queue", "apply")
 STATUSZ_FIELDS = ("ok", "role", "id", "pid", "uptime_s", "run",
                   "spans", "current_span")
 
-STATUSZ_OPS = ("health", "events")
+STATUSZ_OPS = ("health", "events", "flight")
 
 
 def new_id(nbytes: int = 8) -> str:
@@ -399,6 +399,18 @@ class StatuszServer:
                             w.send_msg(self.request,
                                        {"ok": True,
                                         "events": outer._tm().tail(n)})
+                        elif op == "flight":
+                            # fleet-wide flight dump (§20): a fleet-scoped
+                            # alert asks every process for its ring — the
+                            # what-was-everyone-doing trail, on demand
+                            tm = outer._tm()
+                            path = None
+                            if tm.enabled:
+                                path = tm.dump_flight(
+                                    reason=str(header.get(
+                                        "reason", "statusz flight op")))
+                            w.send_msg(self.request,
+                                       {"ok": True, "path": path})
                         else:
                             w.send_msg(self.request,
                                        {"ok": False,

@@ -190,6 +190,20 @@ class Worker(MeshProcess):
                 run_dir=config["record_dir"], telemetry_=telem,
                 tracer_=self.tracing)
             statusz.start()
+        # fleet health plane (utils/fleetmon, docs/design.md §20): a
+        # low-rate daemon thread streaming metric snapshots (phase
+        # p50/p99, img/s, HBM headroom, queue depth, wire health) to the
+        # run's FleetCollector.  Never touches this hot loop — it reads
+        # the registry the loop already feeds.
+        streamer = None
+        if telem.enabled and config.get("metrics_addr"):
+            from .utils.fleetmon import MetricStreamer
+            streamer = MetricStreamer(
+                str(config["metrics_addr"]),
+                rank=int(config.get("rank", self.rank)), role="worker",
+                interval_s=float(config.get("metrics_interval_s", 1.0)),
+                telemetry_=telem)
+            streamer.start()
 
         def on_stall(elapsed, label):
             StallWatchdog._default_handler(watchdog, elapsed, label)
@@ -311,6 +325,11 @@ class Worker(MeshProcess):
                 # discovery doc so fleetz lists this worker DOWN
                 import sys as _sys2
                 statusz.stop(deregister=_sys2.exc_info()[0] is None)
+            if streamer is not None:
+                # a clean exit retires this rank at the collector; a
+                # crash leaves the stream silent so heartbeat_age alerts
+                import sys as _sys3
+                streamer.stop(final=_sys3.exc_info()[0] is None)
         if trace_stop_at is not None:   # window outlived training: flush it
             _stop_trace()
         if lease is not None:
