@@ -1,13 +1,19 @@
 #!/usr/bin/env python
 """DEPRECATED shim — the schema drift guard is now a tpulint checker.
 
-The live-object checks (recorder.SECTIONS / print_train_info record
-keys / telemetry phase-event names all deriving from telemetry.PHASES)
-moved to ``theanompi_tpu/analysis/checkers/schema_drift.py`` so
-``scripts/tier1.sh`` has exactly ONE analysis entry point
-(``scripts/lint.py``).  This script execs that CLI restricted to the
-schema-drift checker, preserving the old exit-code contract (0 = in
-sync, nonzero = drift) for anything still invoking it directly.
+The live-object probes live in
+``theanompi_tpu/analysis/checkers/schema_drift.py`` and have grown far
+past the original recorder/telemetry phase sync: device gauges, sentry
+anomaly schema, bench trace columns, membership/center event
+vocabularies, wire counters and version loudness, span/statusz fields,
+fleet-health rules, thread-role coverage, and (round 19) the §21
+protocol cross-check of the extracted center op table against a live
+``RemoteCenter``'s runtime surface.  ``scripts/tier1.sh`` has exactly
+ONE analysis entry point (``scripts/lint.py``); this script execs that
+CLI restricted to the schema-drift checker, preserving the old
+exit-code contract (0 = in sync, nonzero = drift) for anything still
+invoking it directly — ``os.execv`` replaces the process, so the CLI's
+exit code IS this script's exit code, whatever checkers land later.
 """
 
 import os

@@ -3,6 +3,7 @@
 
     python scripts/lint.py                   # repo-wide, human output
     python scripts/lint.py --check-baseline  # tier-1 gate mode
+    python scripts/lint.py --diff HEAD       # only files changed vs a ref
     python scripts/lint.py --update-baseline # regenerate the baseline
     python scripts/lint.py --list-checks
 

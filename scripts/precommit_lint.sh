@@ -4,30 +4,29 @@
 #
 #   ln -s ../../scripts/precommit_lint.sh .git/hooks/pre-commit
 #
-# Staged paths are filtered to the repo lint scope (theanompi_tpu/,
-# scripts/, tests/, bench.py — the same roots the tier-1 gate walks); a
-# commit touching nothing in scope lints nothing and exits 0.  The
-# staged BLOBS are checked out of the index into a temp tree and linted
-# there (`--root`), so the verdict matches what the commit will contain
-# even when the worktree has further unstaged edits — while the repo's
-# baseline and .tpulint_cache/ are passed through, so a re-commit of
-# unchanged staged content is a cache hit.  Exit codes follow
-# scripts/lint.py: 0 clean, 1 findings, 2 usage.
+# The staged BLOBS are checked out of the index into a temp tree and
+# linted there (`--root`), so the verdict matches what the commit will
+# contain even when the worktree has further unstaged edits — while the
+# repo's baseline and .tpulint_cache/ are passed through, so a
+# re-commit of unchanged staged content is a cache hit.  File SELECTION
+# belongs to `--diff CACHED` (round 19): lint.py asks git for the
+# staged-vs-HEAD .py delta itself and applies the ONE lint-scope filter
+# (core.DEFAULT_PATHS), so the hook and CI share one changed-file code
+# path and scope definition — this script checks out every staged .py
+# blob and deliberately does NOT re-implement the filter (a second copy
+# could drift and silently drop files from the verdict).  Exit codes
+# follow scripts/lint.py: 0 clean, 1 findings, 2 usage.
 set -u
 cd "$(dirname "$0")/.."
 repo="$PWD"
 
 staged=()
 while IFS= read -r f; do
-    case "$f" in
-        theanompi_tpu/*.py|scripts/*.py|tests/*.py|bench.py)
-            staged+=("$f")
-            ;;
-    esac
-done < <(git diff --cached --name-only --diff-filter=ACMR -- '*.py')
+    staged+=("$f")
+done < <(git diff --cached --name-only --diff-filter=d -- '*.py')
 
 if [ ${#staged[@]} -eq 0 ]; then
-    echo "precommit-lint: no staged python files in lint scope"
+    echo "precommit-lint: no staged python files"
     exit 0
 fi
 
@@ -38,4 +37,4 @@ git checkout-index --prefix="$tmp/" -- "${staged[@]}" || exit 2
 python scripts/lint.py --root "$tmp" \
     --baseline "$repo/tpulint_baseline.json" \
     --cache-dir "$repo/.tpulint_cache" \
-    "${staged[@]}"
+    --diff CACHED

@@ -12,9 +12,14 @@
 # rank-divergent branches, start/done pairing), PartitionSpec/shard_map
 # schema checks, exchange_body symmetry, the jax_compat shim boundary,
 # the telemetry hot-path enabled-guard contract, the recorder/
-# telemetry schema sync, and the host-concurrency pass (thread-role
+# telemetry schema sync, the host-concurrency pass (thread-role
 # inference; shared-state races, lock-order cycles, signal safety,
-# daemon discipline — design.md §16).  Any finding not covered by
+# daemon discipline — design.md §16), and the distributed-protocol
+# conformance pass (design.md §21: client/server wire op-table diffs,
+# DedupWindow claim dominance on every mutating handler path, §15
+# retry-verdict/close-taxonomy checks, membership state-machine
+# exhaustiveness incl. reactor hooks and the versioned wire-header
+# field vocabulary).  Any finding not covered by
 # tpulint_baseline.json — or a stale baseline entry — fails the gate
 # here, without importing jax, before pytest.  An unchanged tree is a
 # .tpulint_cache/ hit: the gate costs well under a second.

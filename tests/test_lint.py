@@ -2267,3 +2267,768 @@ def test_daemon_discipline_stored_attr_daemonized_after(tmp_path):
         "    def stop(self):\n"
         "        self._t.join(timeout=1)\n")
     assert lint_snippet(tmp_path, "x.py", code, "daemon-discipline") == []
+
+
+# ---------------------------------------------------------------------------
+# protocol conformance (round 19, docs/design.md §21)
+# ---------------------------------------------------------------------------
+
+from theanompi_tpu.analysis import protocol as proto  # noqa: E402
+from theanompi_tpu.analysis.engine import ProgramIndex as _PI  # noqa: E402
+
+CENTER_REL = proto.CENTER_PATH
+MEMBERSHIP_REL = proto.MEMBERSHIP_PATH
+
+
+def _write_at(tmp_path, rel, code):
+    p = tmp_path / rel
+    p.parent.mkdir(parents=True, exist_ok=True)
+    p.write_text(code)
+    return rel
+
+
+def _protocol_lint(tmp_path, only, rels):
+    return core.run_lint(str(tmp_path), paths=list(rels), only=[only])
+
+
+WIRECONTRACT_GOOD = '''
+class CenterServer:
+    def start(self):
+        center = self.center
+        dedup = self.dedup
+
+        class Handler:
+            def _dispatch(self, header, body):
+                op = header.get("op")
+                tok = header.get("tok")
+                if op == "push":
+                    wire.send_msg(self.request, {"ok": True})
+                elif op == "pull":
+                    wire.send_msg(self.request, {"ok": True}, body)
+                else:
+                    wire.send_msg(self.request,
+                                  {"ok": False, "error": "?"})
+
+
+class RemoteCenter:
+    def _roundtrip(self, header, body=b""):
+        return self._wire.request(header, body)
+
+    def push(self, body):
+        self._roundtrip({"op": "push"}, body)
+
+    def pull(self):
+        resp, body = self._roundtrip({"op": "pull"})
+        return body
+'''
+
+WIRECONTRACT_BAD = WIRECONTRACT_GOOD + '''
+
+class Extra(RemoteCenter):
+    def poke(self):
+        self._roundtrip({"op": "poke"})
+'''
+
+
+def test_wire_contract_good_fixture(tmp_path):
+    rel = _write_at(tmp_path, CENTER_REL, WIRECONTRACT_GOOD)
+    assert _protocol_lint(tmp_path, "wire-contract", [rel]) == []
+
+
+def test_wire_contract_client_op_without_handler(tmp_path):
+    rel = _write_at(tmp_path, CENTER_REL, WIRECONTRACT_BAD)
+    found = _protocol_lint(tmp_path, "wire-contract", [rel])
+    # Extra subclasses RemoteCenter, so its sends are NOT in the
+    # declared RemoteCenter scope — move the send in to see it
+    assert found == [], [f.render() for f in found]
+    bad = WIRECONTRACT_GOOD.replace(
+        '    def pull(self):',
+        '    def poke(self):\n'
+        '        self._roundtrip({"op": "poke"})\n\n'
+        '    def pull(self):')
+    rel = _write_at(tmp_path, CENTER_REL, bad)
+    found = _protocol_lint(tmp_path, "wire-contract", [rel])
+    assert len(found) == 1 and "no handler arm" in found[0].message \
+        and "'poke'" in found[0].message, [f.render() for f in found]
+
+
+def test_wire_contract_dead_handler_arm(tmp_path):
+    bad = WIRECONTRACT_GOOD.replace(
+        'elif op == "pull":',
+        'elif op == "purge":\n'
+        '                    wire.send_msg(self.request, {"ok": True})\n'
+        '                elif op == "pull":')
+    rel = _write_at(tmp_path, CENTER_REL, bad)
+    found = _protocol_lint(tmp_path, "wire-contract", [rel])
+    assert len(found) == 1 and "no in-repo client ever sends" in \
+        found[0].message and "'purge'" in found[0].message, \
+        [f.render() for f in found]
+
+
+def test_wire_contract_retry_on_success_is_incoherent(tmp_path):
+    bad = WIRECONTRACT_GOOD.replace(
+        'wire.send_msg(self.request, {"ok": True}, body)',
+        'wire.send_msg(self.request, '
+        '{"ok": True, "retry": True}, body)')
+    rel = _write_at(tmp_path, CENTER_REL, bad)
+    found = _protocol_lint(tmp_path, "wire-contract", [rel])
+    assert len(found) == 1 and "retry=true without ok=false" in \
+        found[0].message, [f.render() for f in found]
+
+
+def test_wire_contract_client_reads_unset_reply_field(tmp_path):
+    bad = WIRECONTRACT_GOOD.replace(
+        "        return body", '        return resp.get("shard")')
+    rel = _write_at(tmp_path, CENTER_REL, bad)
+    found = _protocol_lint(tmp_path, "wire-contract", [rel])
+    assert len(found) == 1 and "reads reply field 'shard'" in \
+        found[0].message, [f.render() for f in found]
+
+
+def test_wire_contract_dynamic_reply_suppresses_read_diff(tmp_path):
+    """A ``**``-splat reply can set anything — the read diff must not
+    guess against it."""
+    bad = WIRECONTRACT_GOOD.replace(
+        "        return body", '        return resp.get("shard")'
+    ).replace(
+        'wire.send_msg(self.request, {"ok": True}, body)',
+        'wire.send_msg(self.request, '
+        '{"ok": True, **center.stats()}, body)')
+    rel = _write_at(tmp_path, CENTER_REL, bad)
+    found = _protocol_lint(tmp_path, "wire-contract", [rel])
+    assert found == [], [f.render() for f in found]
+
+
+STATUSZ_FAMILY = {
+    proto.TRACING_PATH: '''
+class Handler:
+    def handle(self):
+        header, _ = w.recv_msg(self.request)
+        op = header.get("op")
+        if op == "health":
+            w.send_msg(self.request, {"ok": True})
+        elif op == "events":
+            w.send_msg(self.request, {"ok": True, "events": []})
+        elif op == "flight":
+            w.send_msg(self.request, {"ok": True, "path": None})
+
+
+def statusz_query(addr, op="health", n=16):
+    return {}
+''',
+    proto.FLEETMON_PATH: '''
+class Handler:
+    def _dispatch(self, header, body):
+        op = header.get("op")
+        if op == "metrics":
+            wire.send_msg(self.request, {"ok": True})
+        elif op == "alerts":
+            wire.send_msg(self.request, {"ok": True, "alerts": []})
+
+
+class MetricStreamer:
+    def push(self):
+        header = {"op": "metrics"}
+        self.client.request(header, b"")
+''',
+    proto.FLEETZ_PATH: '''
+from theanompi_tpu.utils import tracing
+
+
+def probe(addr):
+    tracing.statusz_query(addr, "health")
+    tracing.statusz_query(addr, "events")
+    tracing.statusz_query(addr, "flight")
+    tracing.statusz_query(addr, "alerts")
+''',
+}
+
+
+def test_wire_contract_statusz_family_pooled(tmp_path):
+    rels = [_write_at(tmp_path, rel, code)
+            for rel, code in STATUSZ_FAMILY.items()]
+    found = core.run_lint(str(tmp_path), paths=rels,
+                          only=["wire-contract"])
+    assert found == [], [f.render() for f in found]
+    # an op the dialer sends that NO statusz-compatible endpoint handles
+    bad = STATUSZ_FAMILY[proto.FLEETZ_PATH] + \
+        '\n\ndef bad(addr):\n    tracing.statusz_query(addr, "bogus")\n'
+    _write_at(tmp_path, proto.FLEETZ_PATH, bad)
+    found = core.run_lint(str(tmp_path), paths=rels,
+                          only=["wire-contract"])
+    assert len(found) == 1 and "statusz_query sends op 'bogus'" in \
+        found[0].message, [f.render() for f in found]
+
+
+RETRY_GOOD = '''
+class CenterServer:
+    def start(self):
+        center = self.center
+        dedup = self.dedup
+
+        class Handler:
+            def _dispatch(self, header, body):
+                op = header.get("op")
+                tok = header.get("tok")
+                if op == "push":
+                    dup, cached = dedup.check(tok, op)
+                    if dup:
+                        wire.send_msg(self.request,
+                                      {"ok": True, "dedup": True})
+                        return
+                    try:
+                        center.n_updates += 1
+                        dedup.record(tok, op, {"ok": True})
+                    except Exception:
+                        dedup.release(tok, op)
+                        raise
+                    wire.send_msg(self.request, {"ok": True})
+                elif op == "pull":
+                    wire.send_msg(self.request, {"ok": True}, body)
+'''
+
+RETRY_BAD = RETRY_GOOD.replace(
+    "                    dup, cached = dedup.check(tok, op)\n"
+    "                    if dup:\n"
+    "                        wire.send_msg(self.request,\n"
+    "                                      {\"ok\": True, \"dedup\": True})\n"
+    "                        return\n", "")
+
+
+def test_retry_safety_claimed_mutation_is_clean(tmp_path):
+    rel = _write_at(tmp_path, CENTER_REL, RETRY_GOOD)
+    assert _protocol_lint(tmp_path, "retry-safety", [rel]) == []
+
+
+def test_retry_safety_unclaimed_mutation_is_flagged(tmp_path):
+    rel = _write_at(tmp_path, CENTER_REL, RETRY_BAD)
+    found = _protocol_lint(tmp_path, "retry-safety", [rel])
+    assert len(found) == 1, [f.render() for f in found]
+    assert "writes `center.n_updates`" in found[0].message
+    assert "at-most-once" in found[0].message
+
+
+def test_retry_safety_nonterminating_dup_arm_is_not_a_claim(tmp_path):
+    """A dup arm that falls through to the mutation reapplies it — the
+    claim only dominates when the duplicate path exits."""
+    bad = RETRY_GOOD.replace(
+        "                        wire.send_msg(self.request,\n"
+        "                                      {\"ok\": True, \"dedup\": True})\n"
+        "                        return\n",
+        "                        pass\n")
+    rel = _write_at(tmp_path, CENTER_REL, bad)
+    found = _protocol_lint(tmp_path, "retry-safety", [rel])
+    assert len(found) == 1 and "writes `center.n_updates`" in \
+        found[0].message, [f.render() for f in found]
+
+
+def test_retry_safety_mutating_method_via_lattice(tmp_path):
+    """A handler calling a state-class method that mutates (directly or
+    through a same-class call) is a mutation site — the §21 lattice."""
+    state = '''
+class ElasticCenter:
+    def __init__(self):
+        self.n_updates = 0
+
+    def _bump(self):
+        self.n_updates += 1
+
+    def apply(self, body):
+        self._bump()
+
+    def read(self):
+        return self.n_updates
+'''
+    srv = RETRY_GOOD.replace("center.n_updates += 1",
+                             "center.apply(body)")
+    srv_bad = RETRY_BAD.replace("center.n_updates += 1",
+                                "center.apply(body)")
+    rel_state = _write_at(tmp_path, proto.ASYNC_EASGD_PATH, state)
+    rel = _write_at(tmp_path, CENTER_REL, srv)
+    assert core.run_lint(str(tmp_path), paths=[rel, rel_state],
+                         only=["retry-safety"]) == []
+    rel = _write_at(tmp_path, CENTER_REL, srv_bad)
+    found = core.run_lint(str(tmp_path), paths=[rel, rel_state],
+                          only=["retry-safety"])
+    assert len(found) == 1 and "calls mutating `center.apply`" in \
+        found[0].message, [f.render() for f in found]
+    # read-only calls never flag, claimed or not
+    srv_read = RETRY_BAD.replace("center.n_updates += 1",
+                                 "x = center.read()")
+    rel = _write_at(tmp_path, CENTER_REL, srv_read)
+    assert core.run_lint(str(tmp_path), paths=[rel, rel_state],
+                         only=["retry-safety"]) == []
+
+
+def test_retry_safety_idempotent_op_exempt(tmp_path):
+    """An op declared idempotent-by-algebra (init/demote/readmit) may
+    mutate unclaimed."""
+    srv = RETRY_BAD.replace('if op == "push":', 'if op == "demote":')
+    rel = _write_at(tmp_path, CENTER_REL, srv)
+    assert _protocol_lint(tmp_path, "retry-safety", [rel]) == [], \
+        [f.render() for f in _protocol_lint(tmp_path, "retry-safety",
+                                            [rel])]
+
+
+SM_GOOD = '''
+MEMBERSHIP_EVENTS = ("worker_join", "worker_leave", "worker_demote")
+CENTER_EVENTS = ("center_down", "center_restored")
+
+
+class Reactor:
+    def on_join(self, worker, info):
+        pass
+
+    def on_leave(self, worker, info):
+        pass
+
+    def on_demote(self, worker, info):
+        pass
+
+    def on_readmit(self, worker, info):
+        pass
+
+
+class LogReactor(Reactor):
+    def on_join(self, worker, info):
+        pass
+
+    def on_leave(self, worker, info):
+        pass
+
+    def on_demote(self, worker, info):
+        pass
+
+    def on_readmit(self, worker, info):
+        pass
+
+
+class MembershipController:
+    def _emit(self, event, worker, hook, **info):
+        self.transitions.append((event, worker, info))
+
+    def join(self, worker):
+        st = self.workers[worker]
+        st["status"] = "live"
+        self._emit("worker_join", worker, "on_join")
+
+    def leave(self, worker, reason="exit"):
+        st = self.workers[worker]
+        st["status"] = "left" if reason == "finished" else "dead"
+        self._emit("worker_leave", worker, "on_leave")
+
+    def demote(self, worker):
+        st = self.workers[worker]
+        st["status"] = "demoted"
+        self._emit("worker_demote", worker, "on_demote")
+'''
+
+
+def test_state_machine_good_fixture(tmp_path):
+    rel = _write_at(tmp_path, MEMBERSHIP_REL, SM_GOOD)
+    assert _protocol_lint(tmp_path, "state-machine", [rel]) == []
+
+
+def test_state_machine_transition_without_event(tmp_path):
+    bad = SM_GOOD.replace(
+        '        st["status"] = "demoted"\n'
+        '        self._emit("worker_demote", worker, "on_demote")\n',
+        '        st["status"] = "demoted"\n')
+    rel = _write_at(tmp_path, MEMBERSHIP_REL, bad)
+    found = _protocol_lint(tmp_path, "state-machine", [rel])
+    msgs = [f.message for f in found]
+    assert any("without emitting its declared 'worker_demote'" in m
+               for m in msgs), msgs
+    assert any("'worker_demote' is never emitted" in m for m in msgs), \
+        msgs
+
+
+def test_state_machine_reactor_missing_hook(tmp_path):
+    bad = SM_GOOD.replace(
+        "class LogReactor(Reactor):\n"
+        "    def on_join(self, worker, info):\n"
+        "        pass\n\n"
+        "    def on_leave(self, worker, info):\n"
+        "        pass\n\n"
+        "    def on_demote(self, worker, info):\n"
+        "        pass\n",
+        "class LogReactor(Reactor):\n"
+        "    def on_join(self, worker, info):\n"
+        "        pass\n\n"
+        "    def on_leave(self, worker, info):\n"
+        "        pass\n")
+    rel = _write_at(tmp_path, MEMBERSHIP_REL, bad)
+    found = _protocol_lint(tmp_path, "state-machine", [rel])
+    assert len(found) == 1 and "neither handles nor explicitly " \
+        "ignores `on_demote`" in found[0].message, \
+        [f.render() for f in found]
+
+
+def test_state_machine_event_outside_vocabulary(tmp_path):
+    bad = SM_GOOD.replace('self._emit("worker_demote", worker',
+                          'self._emit("worker_demotedz", worker')
+    rel = _write_at(tmp_path, MEMBERSHIP_REL, bad)
+    found = _protocol_lint(tmp_path, "state-machine", [rel])
+    msgs = [f.message for f in found]
+    assert any("outside the declared MEMBERSHIP_EVENTS" in m
+               for m in msgs), msgs
+
+
+def test_state_machine_header_version_guard(tmp_path):
+    good = '''
+class Handler:
+    def _dispatch(self, header, body):
+        op = header.get("op")
+        trc = header.get("trace")
+        island = header["island"]
+'''
+    rel = _write_at(tmp_path, CENTER_REL, good)
+    assert _protocol_lint(tmp_path, "state-machine", [rel]) == []
+    bad = good.replace('header.get("trace")', 'header["trace"]') \
+              .replace('header["island"]', 'header.get("shard")')
+    rel = _write_at(tmp_path, CENTER_REL, bad)
+    found = _protocol_lint(tmp_path, "state-machine", [rel])
+    msgs = sorted(f.message for f in found)
+    assert len(found) == 2, msgs
+    assert any("undeclared wire-header field 'shard'" in m
+               for m in msgs), msgs
+    assert any("subscript-reads v2-optional header field 'trace'" in m
+               for m in msgs), msgs
+
+
+# -- op-table extraction units on a synthetic pair ---------------------------
+
+SYN_SERVER = '''
+OP_C = "c"
+
+
+class Srv:
+    def handle(self, header, body):
+        op = header.get("op")
+        if op == "a":
+            pass
+        elif op in ("b", "a"):
+            pass
+        elif op == OP_C:
+            pass
+'''
+
+SYN_CLIENT = '''
+class Cli:
+    def send_a(self):
+        self.wire.request({"op": "a"})
+
+    def send_b(self):
+        header = {"op": "b"}
+        self.wire.request(header)
+
+    def send_dynamic(self, op):
+        self.wire.request({"op": op})      # not statically evaluable
+'''
+
+
+def _syn_index(tmp_path):
+    (tmp_path / "srv.py").write_text(SYN_SERVER)
+    (tmp_path / "cli.py").write_text(SYN_CLIENT)
+    files = [core.SourceFile(str(tmp_path), "srv.py"),
+             core.SourceFile(str(tmp_path), "cli.py")]
+    return _PI(files)
+
+
+def test_protocol_op_table_extraction(tmp_path):
+    index = _syn_index(tmp_path)
+    spec = proto.EndpointSpec(
+        name="syn", server_path="srv.py", dispatch="Srv.handle",
+        clients=(proto.ClientSurface("cli.py", "Cli", ("request",)),))
+    table = proto.server_op_table(index, spec)
+    assert set(table) == {"a", "b", "c"}        # eq, membership, const
+    ctab = proto.client_op_table(index, spec)
+    assert set(ctab) == {"a", "b"}              # inline + local header
+    assert all(s.path == "cli.py" for sites in ctab.values()
+               for s in sites)
+
+
+def test_protocol_dispatch_missing_is_reported(tmp_path):
+    """Renaming the dispatch function must fail loudly, not blind the
+    checker."""
+    rel = _write_at(tmp_path, CENTER_REL,
+                    WIRECONTRACT_GOOD.replace("_dispatch", "_route"))
+    found = _protocol_lint(tmp_path, "wire-contract", [rel])
+    assert len(found) == 1 and "protocol model" in found[0].message \
+        and "out of date" in found[0].message, \
+        [f.render() for f in found]
+
+
+def test_protocol_mutation_lattice(tmp_path):
+    (tmp_path / "state.py").write_text('''
+class State:
+    def __init__(self):
+        self.n = 0
+        self.items = {}
+
+    def read(self):
+        return self.n
+
+    def peek(self, k):
+        return self.items.get(k)
+
+    def bump(self):
+        self.n += 1
+
+    def bump_twice(self):
+        self.bump()
+
+    def stash(self, k, v):
+        self.items[k] = v
+
+    def retire(self, k):
+        self.items.pop(k)
+''')
+    index = _PI([core.SourceFile(str(tmp_path), "state.py")])
+    mut = proto.mutating_methods(index, ("state.State",))
+    assert mut == {"__init__", "bump", "bump_twice", "stash", "retire"}
+
+
+def test_protocol_fold_op_test(tmp_path):
+    import ast as _ast
+    (tmp_path / "m.py").write_text("X = 'c'\n")
+    sf = core.SourceFile(str(tmp_path), "m.py")
+    index = _PI([sf])
+
+    def fold(src, value):
+        test = _ast.parse(src, mode="eval").body
+        return proto.fold_op_test(test, {"op"}, value, sf, index)
+
+    assert fold('op == "a"', "a") is True
+    assert fold('op == "a"', "b") is False
+    assert fold('op in ("a", "b")', "b") is True
+    assert fold('op not in ("a", "b")', "b") is False
+    assert fold('op == "a" and leaves is None', "b") is False
+    assert fold('op == "a" and leaves is None', "a") is None
+    assert fold('op == X', "c") is True
+    assert fold('other == "a"', "a") is None
+
+
+# -- the three live injections (ISSUE 15 acceptance) -------------------------
+
+def _check_baseline_cli(root, *paths):
+    return subprocess.run(
+        [sys.executable, LINT, "--root", str(root), "--check-baseline",
+         *paths], capture_output=True, text=True, timeout=300)
+
+
+def test_injection_removed_center_handler_arm(tmp_path):
+    rel = _inject(tmp_path, CENTER_REL,
+                  'elif op == "readmit":', 'elif op == "readmitz":')
+    r = _check_baseline_cli(tmp_path, rel)
+    assert r.returncode == 1, r.stdout + r.stderr
+    assert "no handler arm" in r.stdout and "'readmit'" in r.stdout
+    assert "no in-repo client ever sends" in r.stdout     # the dead twin
+
+
+def test_injection_unclaimed_mutating_handler_path(tmp_path):
+    import shutil
+    # the mutation lattice needs the state class in scope, exactly as
+    # the repo-wide gate has it
+    dst = tmp_path / proto.ASYNC_EASGD_PATH
+    dst.parent.mkdir(parents=True, exist_ok=True)
+    shutil.copy(os.path.join(REPO, proto.ASYNC_EASGD_PATH), dst)
+    rel = _inject(tmp_path, CENTER_REL,
+                  "dup, cached = dedup.check(tok, op)",
+                  "dup, cached = False, None")
+    r = _check_baseline_cli(tmp_path, "theanompi_tpu")
+    assert r.returncode == 1, r.stdout + r.stderr
+    assert "retry-safety" in r.stdout
+    assert "without a dominating DedupWindow claim check" in r.stdout
+    assert "push_delta_leaves" in r.stdout
+    assert "push_pull_leaves" in r.stdout
+
+
+def test_injection_transition_without_event(tmp_path):
+    rel = _inject(
+        tmp_path, MEMBERSHIP_REL,
+        '        self._emit("worker_demote", worker, "on_demote",\n'
+        '                   reason=reason, **info)\n',
+        '')
+    r = _check_baseline_cli(tmp_path, rel)
+    assert r.returncode == 1, r.stdout + r.stderr
+    assert "state-machine" in r.stdout
+    assert "without emitting its declared 'worker_demote'" in r.stdout
+
+
+# -- cache-key sensitivity + json fingerprints for the new checkers ----------
+
+def test_protocol_findings_cache_and_fingerprints(tmp_path):
+    """Protocol findings are engine-scoped: cached at tree level,
+    reproduced bit-identically on a warm hit, invalidated by a
+    server-file edit, and fingerprinted in --format json."""
+    bad = WIRECONTRACT_GOOD.replace(
+        '    def pull(self):',
+        '    def poke(self):\n'
+        '        self._roundtrip({"op": "poke"})\n\n'
+        '    def pull(self):')
+    rel = _write_at(tmp_path, CENTER_REL, bad)
+    cold = _lint_cli(tmp_path, rel, "--only", "wire-contract",
+                     "--format", "json")
+    c = json.loads(cold.stdout)
+    assert c["cache"] == "miss" and cold.returncode == 1
+    assert len(c["findings"]) == 1
+    fp = c["findings"][0]["fingerprint"]
+    assert len(fp) == 12 and int(fp, 16) >= 0
+    warm = _lint_cli(tmp_path, rel, "--only", "wire-contract",
+                     "--format", "json")
+    w = json.loads(warm.stdout)
+    assert w["cache"] == "hit" and w["findings"] == c["findings"]
+    # fixing the server invalidates the tree entry
+    _write_at(tmp_path, CENTER_REL, WIRECONTRACT_GOOD)
+    fixed = _lint_cli(tmp_path, rel, "--only", "wire-contract",
+                      "--format", "json")
+    f = json.loads(fixed.stdout)
+    assert f["cache"] == "miss" and f["findings"] == []
+    # checker selection keys the cache: a different --only over the
+    # same tree is its own entry, not a stale hit of the first
+    other = _lint_cli(tmp_path, rel, "--only", "retry-safety",
+                      "--format", "json")
+    assert json.loads(other.stdout)["cache"] == "miss"
+
+
+def test_protocol_group_alias():
+    r = subprocess.run(
+        [sys.executable, LINT, "--only", "protocol", "--check-baseline"],
+        cwd=REPO, capture_output=True, text=True, timeout=300)
+    assert r.returncode == 0, r.stdout + r.stderr
+
+
+# -- --diff mode -------------------------------------------------------------
+
+def _git(cwd, *args):
+    return subprocess.run(
+        ["git", "-c", "user.email=t@t", "-c", "user.name=t", *args],
+        cwd=str(cwd), capture_output=True, text=True, timeout=60)
+
+
+def test_diff_mode(tmp_path):
+    assert _git(tmp_path, "init", "-q").returncode == 0
+    d = tmp_path / "theanompi_tpu"
+    d.mkdir()
+    (d / "x.py").write_text("x = 1\n")
+    (tmp_path / "outside.py").write_text("import time\n")
+    _git(tmp_path, "add", "-A")
+    assert _git(tmp_path, "commit", "-qm", "init").returncode == 0
+
+    # nothing changed: exits 0 without linting anything
+    r = _lint_cli(tmp_path, "--diff", "HEAD")
+    assert r.returncode == 0 and "no changed python files" in r.stdout
+
+    # a worktree edit introducing a finding is seen
+    (d / "x.py").write_text(RNG_BAD)
+    (tmp_path / "outside.py").write_text("import os\n")   # out of scope
+    r = _lint_cli(tmp_path, "--diff", "HEAD", "--format", "json")
+    out = json.loads(r.stdout)
+    assert r.returncode == 1
+    assert {f["path"] for f in out["findings"]} == \
+        {"theanompi_tpu/x.py"}
+
+    # CACHED = the staged index vs HEAD
+    r = _lint_cli(tmp_path, "--diff", "CACHED")
+    assert r.returncode == 0 and "no changed python files" in r.stdout
+    _git(tmp_path, "add", "-A")
+    r = _lint_cli(tmp_path, "--diff", "CACHED")
+    assert r.returncode == 1
+
+    # guard rails
+    r = _lint_cli(tmp_path, "--diff", "HEAD", "theanompi_tpu/x.py")
+    assert r.returncode == 2 and "mutually exclusive" in r.stderr
+    r = _lint_cli(tmp_path, "--diff", "NOSUCHREF")
+    assert r.returncode == 2
+    r = _lint_cli(tmp_path, "--diff", "HEAD", "--update-baseline")
+    assert r.returncode == 2 and "--diff" in r.stderr
+    # ...and the refusal must hold on an EMPTY changeset too — the
+    # early exit 0 must not read as "baseline updated" to automation
+    _git(tmp_path, "add", "-A")
+    _git(tmp_path, "commit", "-qm", "sync")
+    r = _lint_cli(tmp_path, "--diff", "HEAD", "--update-baseline")
+    assert r.returncode == 2 and "--diff" in r.stderr
+
+
+def test_retry_safety_direct_self_attr_mutation(tmp_path):
+    """A mutation spelled through the server attr itself
+    (``self.center.x`` / ``outer.center.x``) is the same mutation as
+    through a closure alias."""
+    src = '''
+class CenterServer:
+    def start(self):
+        dedup = self.dedup
+        outer = self
+
+        class Handler:
+            def _dispatch(self, header, body):
+                op = header.get("op")
+                tok = header.get("tok")
+                if op == "push":
+                    outer.center.n_updates += 1
+                    dedup.record(tok, op, {"ok": True})
+'''
+    rel = _write_at(tmp_path, CENTER_REL, src)
+    found = _protocol_lint(tmp_path, "retry-safety", [rel])
+    assert len(found) == 1 and \
+        "writes `outer.center.n_updates`" in found[0].message, \
+        [f.render() for f in found]
+
+
+def test_retry_safety_renamed_self_capture_still_seen(tmp_path):
+    """The self-capture alias is DERIVED, not hardcoded: renaming
+    ``outer = self`` must not blind the direct-write detection
+    (review finding, round 19)."""
+    src = '''
+class CenterServer:
+    def start(self):
+        dedup = self.dedup
+        srv = self
+
+        class Handler:
+            def _dispatch(self, header, body):
+                op = header.get("op")
+                tok = header.get("tok")
+                if op == "push":
+                    srv.center.n_updates += 1
+                    dedup.record(tok, op, {"ok": True})
+'''
+    rel = _write_at(tmp_path, CENTER_REL, src)
+    found = _protocol_lint(tmp_path, "retry-safety", [rel])
+    assert len(found) == 1 and \
+        "writes `srv.center.n_updates`" in found[0].message, \
+        [f.render() for f in found]
+
+
+def test_wire_contract_unrelated_dict_does_not_mask_read_diff(tmp_path):
+    """A constant-key store into a dict that never reaches a reply must
+    not launder its key into the emitted set (review finding: the
+    unset-reply-field diff would be silently masked)."""
+    bad = WIRECONTRACT_GOOD.replace(
+        "        return body", '        return resp.get("shard")'
+    ).replace(
+        'wire.send_msg(self.request, {"ok": True}, body)',
+        'info = {}\n'
+        '                    info["shard"] = 1\n'
+        '                    wire.send_msg(self.request, '
+        '{"ok": True}, body)')
+    rel = _write_at(tmp_path, CENTER_REL, bad)
+    found = _protocol_lint(tmp_path, "wire-contract", [rel])
+    assert len(found) == 1 and "reads reply field 'shard'" in \
+        found[0].message, [f.render() for f in found]
+
+
+def test_schema_drift_probes_stay_jax_free():
+    """The live probes — including the §21 probe that drives a real
+    RemoteCenter against a stubbed wire — must never drag jax into the
+    lint process.  Pinned with the cache OFF: on a warm tree hit the
+    probes never run, so the cached variant of this contract
+    (test_cli_runs_clean_without_jax) can mask a probe regression —
+    exactly how the round-19 `import jax`-before-roundtrip bug in
+    RemoteCenter.pull slipped through a green gate."""
+    env = dict(os.environ, TPULINT_ASSERT_NO_JAX="1")
+    proc = subprocess.run(
+        [sys.executable, LINT, "--only", "schema-drift", "--no-cache"],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, proc.stdout + proc.stderr

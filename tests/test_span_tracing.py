@@ -161,6 +161,13 @@ def test_chaos_dup_yields_one_applied_span_and_tagged_twin(traced_stream):
                      trace=rnd.ctx())
         rnd.end(outcome="exchanged")
         assert srv.center.n_updates == 1          # applied exactly once
+        # the proxy forwards the duplicated frame concurrently with the
+        # original's reply — the client can return before the twin has
+        # been SERVED; wait (bounded) for it to land before judging the
+        # dedup bookkeeping and the twin's span below
+        deadline = time.time() + 10.0
+        while srv.dedup.hits < 1 and time.time() < deadline:
+            time.sleep(0.02)
         assert srv.dedup.hits >= 1
         c.close()
     finally:

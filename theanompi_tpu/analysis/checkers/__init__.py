@@ -5,9 +5,11 @@ The dataflow checkers (trace-purity, rng-discipline, donation-safety,
 collective-discipline, sharding-schema, exchange-symmetry) run on the
 whole-program engine (``analysis/engine.py``); the host-concurrency pass
 (shared-state-race, lock-ordering, signal-safety, daemon-discipline)
-runs on the engine's thread-role inference; compat-boundary and
-telemetry-hot-path stay per-file (their invariants are lexical);
-schema-drift is the live-object project probe.
+runs on the engine's thread-role inference; the protocol pass
+(wire-contract, retry-safety, state-machine) runs on the declared
+endpoint model (``analysis/protocol.py``, docs/design.md §21);
+compat-boundary and telemetry-hot-path stay per-file (their invariants
+are lexical); schema-drift is the live-object project probe.
 """
 
 from . import (  # noqa: F401
@@ -16,6 +18,7 @@ from . import (  # noqa: F401
     donation_safety,
     exchange_symmetry,
     host_concurrency,
+    protocol_conformance,
     rng_discipline,
     schema_drift,
     sharding_schema,
@@ -24,9 +27,11 @@ from . import (  # noqa: F401
 )
 
 #: ``--only``/``--disable`` group aliases: ``--only concurrency`` runs
-#: just the host-concurrency pass (scripts/lint.py expands these before
+#: just the host-concurrency pass, ``--only protocol`` the distributed-
+#: protocol conformance pass (scripts/lint.py expands these before
 #: checker-name validation, so the cache keys on the real names).
 CHECK_GROUPS = {
     "concurrency": ("daemon-discipline", "lock-ordering",
                     "shared-state-race", "signal-safety"),
+    "protocol": ("wire-contract", "retry-safety", "state-machine"),
 }

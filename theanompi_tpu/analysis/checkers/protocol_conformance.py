@@ -1,0 +1,670 @@
+"""Distributed-protocol conformance: the §15/§20 contracts, checked.
+
+Three engine-scoped checkers over the declared endpoint model
+(``analysis/protocol.py``, docs/design.md §21):
+
+* **wire-contract** — diffs every endpoint's server op-dispatch table
+  against its clients' sent-op table: a client op with no handler arm,
+  a handler no in-repo client (or declared external surface) ever
+  sends, a reply verdict the shared wire client inspects that no
+  handler path sets (or emits that the client ignores), a ``retry:
+  true`` reply that is not also ``ok: false``, and the §15
+  close-taxonomy (a ``CorruptPayload`` reply must be retryable, a
+  ``VersionMismatch`` reply must not be).
+* **retry-safety** — per mutating handler op, every path that reaches a
+  state-class mutation (the §21 mutation-summary lattice: direct
+  ``self.X`` stores closed over same-class calls) must be dominated by
+  a ``DedupWindow`` claim check whose duplicate arm exits — otherwise a
+  wire retry applies the op twice (the at-most-once invariant that must
+  hold per-shard when the center splits K ways).  Ops declared
+  idempotent-by-algebra in the endpoint spec are exempt.
+* **state-machine** — the membership machine's exhaustiveness: every
+  controller status write emits exactly its declared MEMBERSHIP event,
+  every emitted event/hook is in the declared vocabulary (and every
+  vocabulary entry is actually emitted), every Reactor subclass handles
+  or explicitly ignores every hook, every fleetmon RULE_ACTION is
+  dispatched by a declared handler, and wire-header reads stay inside
+  the versioned field vocabulary (v2-OPTIONAL fields only via ``.get``).
+
+All three skip what they cannot see: on a partial tree (precommit
+staged blobs) a direction that needs cross-file visibility is skipped,
+never guessed — ``EndpointSpec.requires`` lists the prerequisites.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from .. import protocol as P
+from ..core import Checker, Finding, register
+from ..engine import FuncRecord, ProgramIndex
+
+
+# ---------------------------------------------------------------------------
+# wire-contract
+# ---------------------------------------------------------------------------
+
+@register
+class WireContractChecker(Checker):
+    name = "wire-contract"
+    description = ("client sent-op tables must match server dispatch "
+                   "tables per endpoint; reply verdicts must match the "
+                   "wire client's retry policy (§15 close-taxonomy)")
+    needs_engine = True
+
+    def check_program(self, index: ProgramIndex):
+        findings: List[Finding] = []
+        present = {s.name: s for s in P.ENDPOINTS
+                   if s.server_path in index.by_path}
+        tables: Dict[str, Dict[str, P.OpSite]] = {}
+        for name, spec in sorted(present.items()):
+            t = P.server_op_table(index, spec)
+            if t is None:
+                findings.append(Finding(
+                    self.name, spec.server_path, 1, 0,
+                    f"endpoint '{name}': declared dispatch function "
+                    f"`{spec.dispatch}` not found — the protocol model "
+                    f"(analysis/protocol.py) is out of date"))
+                continue
+            tables[name] = t
+
+        # the statusz-compatible family: ONE dialer speaks to all of
+        # them, so its sent ops pool and diff against the family union
+        statusz_specs = [s for s in P.ENDPOINTS if s.statusz_compat]
+        family_ready = all(s.name in tables for s in statusz_specs)
+        pool = P.statusz_query_ops(index) if family_ready else {}
+        if family_ready and statusz_specs:
+            family_ops: Set[str] = set()
+            for s in statusz_specs:
+                family_ops |= set(tables[s.name])
+            for op, sites in sorted(pool.items()):
+                if op not in family_ops:
+                    findings.append(Finding(
+                        self.name, sites[0].path, sites[0].line,
+                        sites[0].col,
+                        f"statusz_query sends op '{op}' that no "
+                        f"statusz-compatible endpoint "
+                        f"({', '.join(s.name for s in statusz_specs)}) "
+                        f"handles"))
+
+        for name, spec in sorted(present.items()):
+            if name not in tables:
+                continue
+            table = tables[name]
+            client = P.client_op_table(index, spec)
+            for op, sites in sorted(client.items()):
+                if op not in table:
+                    findings.append(Finding(
+                        self.name, sites[0].path, sites[0].line,
+                        sites[0].col,
+                        f"client sends op '{op}' that endpoint "
+                        f"'{name}' has no handler arm for"))
+            # the unsent-handler direction needs the full client
+            # visibility the spec declares
+            if not all(p in index.by_path for p in spec.requires):
+                continue
+            if spec.statusz_compat and not family_ready:
+                continue
+            if not spec.clients and not spec.statusz_compat:
+                continue
+            sent = set(client)
+            if spec.statusz_compat:
+                sent |= set(pool)
+            for op in sorted(table):
+                if op not in sent and op not in spec.external_ops:
+                    site = table[op]
+                    findings.append(Finding(
+                        self.name, site.path, site.line, site.col,
+                        f"endpoint '{name}' handles op '{op}' that no "
+                        f"in-repo client ever sends (declare it in "
+                        f"external_ops if it is a query surface, or "
+                        f"delete the dead arm)"))
+
+        findings.extend(self._verdict_findings(index, present, tables))
+        findings.extend(self._read_findings(index, present, tables))
+        return findings
+
+    # -- reply verdicts vs the shared wire client ---------------------------
+
+    def _verdict_findings(self, index, present, tables):
+        findings: List[Finding] = []
+        wire_specs = [s for s in P.ENDPOINTS if s.wire_verdicts]
+        wire_ready = P.WIRE_PATH in index.by_path and \
+            all(s.name in tables for s in wire_specs)
+        policy = set(P.POLICY_KEYS)
+        union_emitted: Set[str] = set()
+        wc_reads = set(P.reply_reads(index, P.WIRE_CLIENT_READS)) \
+            if wire_ready else set()
+        for spec in wire_specs:
+            if spec.name not in tables:
+                continue
+            sites, extra = P.reply_sites(index, spec)
+            emitted = set(extra)
+            for site in sites:
+                if site.keys is not None:
+                    emitted |= site.keys
+                # a retryable verdict on a successful reply is
+                # incoherent: the client only consults `retry` on
+                # ok=false replies
+                if site.consts.get("retry") is True and \
+                        site.consts.get("ok") is not False:
+                    findings.append(Finding(
+                        self.name, site.path, site.line, 0,
+                        f"endpoint '{spec.name}': reply marks "
+                        f"retry=true without ok=false — the wire "
+                        f"client never consults retry on a success"))
+            union_emitted |= emitted
+            if wire_ready:
+                for k in sorted((emitted & policy) - wc_reads):
+                    anchor = next((s for s in sites
+                                   if s.keys and k in s.keys), None)
+                    findings.append(Finding(
+                        self.name, spec.server_path,
+                        anchor.line if anchor else 1, 0,
+                        f"endpoint '{spec.name}' emits reply verdict "
+                        f"'{k}' the wire client never inspects — a "
+                        f"dead signal (retryability drift)"))
+            # §15 close-taxonomy: exception handlers' replies
+            for exc, verdict in sorted(P.EXCEPTION_VERDICTS.items()):
+                for site in P.exception_reply_sites(index, spec, exc):
+                    has_retry = site.consts.get("retry") is True
+                    if verdict == "retryable" and not has_retry:
+                        findings.append(Finding(
+                            self.name, site.path, site.line, 0,
+                            f"endpoint '{spec.name}': the {exc} reply "
+                            f"must carry retry=true — a corrupt frame "
+                            f"left the stream aligned, the client may "
+                            f"retry the same token"))
+                    elif verdict == "terminal" and has_retry:
+                        findings.append(Finding(
+                            self.name, site.path, site.line, 0,
+                            f"endpoint '{spec.name}': the {exc} reply "
+                            f"must NOT be retryable — a version "
+                            f"mismatch is terminal by contract"))
+        if wire_ready:
+            for k in sorted((wc_reads & policy) - union_emitted):
+                findings.append(Finding(
+                    self.name, P.WIRE_PATH, 1, 0,
+                    f"the wire client inspects reply verdict '{k}' "
+                    f"that no handler path of any wire endpoint sets"))
+        return findings
+
+    # -- client reads vs literal reply fields -------------------------------
+
+    def _read_findings(self, index, present, tables):
+        findings: List[Finding] = []
+        for name, spec in sorted(present.items()):
+            if name not in tables or not spec.reads:
+                continue
+            sites, extra = P.reply_sites(index, spec)
+            if any(s.keys is None for s in sites):
+                continue        # a dynamic reply can set anything
+            emitted = set(extra)
+            for s in sites:
+                emitted |= s.keys
+            reads: Dict[str, P.OpSite] = {}
+            for surf in spec.reads:
+                for k, site in P.reply_reads(index, surf).items():
+                    reads.setdefault(k, site)
+            for k, site in sorted(reads.items()):
+                if k not in emitted and k not in P.REPLY_VERDICT_KEYS:
+                    findings.append(Finding(
+                        self.name, site.path, site.line, site.col,
+                        f"client reads reply field '{k}' that no "
+                        f"handler path of endpoint '{name}' sets"))
+        return findings
+
+
+# ---------------------------------------------------------------------------
+# retry-safety
+# ---------------------------------------------------------------------------
+
+@register
+class RetrySafetyChecker(Checker):
+    name = "retry-safety"
+    description = ("every mutating handler path must be dominated by a "
+                   "DedupWindow claim check — at-most-once application "
+                   "under wire retries (§15)")
+    needs_engine = True
+
+    def check_program(self, index: ProgramIndex):
+        findings: List[Finding] = []
+        for spec in P.ENDPOINTS:
+            if not spec.state_attrs or \
+                    spec.server_path not in index.by_path:
+                continue
+            rec = P.dispatch_record(index, spec)
+            if rec is None:
+                continue              # wire-contract reports the drift
+            table = P.server_op_table(index, spec) or {}
+            mut = P.mutating_methods(index, spec.state_classes)
+            aliases = P.state_aliases(index, spec, spec.state_attrs)
+            dedup_aliases = P.state_aliases(index, spec,
+                                            spec.dedup_attrs)
+            selves = P.self_aliases(index, spec)
+            opvars = P.op_var_names(rec.node)
+            for op in sorted(table):
+                if op in spec.idempotent_ops:
+                    continue
+                walker = _ClaimWalker(self.name, index, spec, rec,
+                                      opvars, op, aliases,
+                                      dedup_aliases, selves, mut,
+                                      findings)
+                walker.walk(list(rec.node.body), claimed=False)
+        return findings
+
+
+class _ClaimWalker:
+    """Walk one op's handler slice of a dispatch function, tracking
+    whether execution is past a DedupWindow claim whose duplicate arm
+    exits.  Dispatch ``if`` tests that are pure functions of the op
+    variable are folded to the slice for this op; everything else is
+    walked both ways."""
+
+    def __init__(self, check, index, spec, rec, opvars, op, aliases,
+                 dedup_aliases, selves, mut, findings):
+        self.check = check
+        self.index = index
+        self.spec = spec
+        self.rec = rec
+        self.opvars = opvars
+        self.op = op
+        self.aliases = aliases
+        self.dedup_aliases = dedup_aliases
+        self.selves = selves
+        self.mut = mut
+        self.findings = findings
+        self.claim_vars: Set[str] = set()
+        self._reported: Set[Tuple[int, str]] = set()
+
+    # -- statement walk -----------------------------------------------------
+
+    def walk(self, stmts: Sequence[ast.stmt], claimed: bool) -> bool:
+        for st in stmts:
+            if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef,
+                               ast.ClassDef)):
+                continue              # runs when called, not here
+            if isinstance(st, ast.If):
+                claimed = self._walk_if(st, claimed)
+                continue
+            if isinstance(st, ast.Try):
+                claimed_body = self.walk(st.body, claimed)
+                for h in st.handlers:
+                    # a handler may run from any point in the body —
+                    # only claims made BEFORE the try are certain
+                    self.walk(h.body, claimed)
+                claimed_body = self.walk(st.orelse, claimed_body)
+                claimed_body = self.walk(st.finalbody, claimed_body)
+                claimed = claimed_body
+                continue
+            if isinstance(st, (ast.For, ast.While, ast.With)):
+                for expr in self._stmt_exprs(st):
+                    self._scan_expr(expr, claimed)
+                claimed = self.walk(st.body, claimed)
+                claimed = self.walk(getattr(st, "orelse", []), claimed)
+                continue
+            if self._claim_assign(st):
+                continue              # the claim call itself
+            # the statement NODE itself is part of the scan: a direct
+            # `center.x += 1` is the Assign/AugAssign at statement level
+            self._scan_expr(st, claimed)
+        return claimed
+
+    def _walk_if(self, st: ast.If, claimed: bool) -> bool:
+        fold = P.fold_op_test(st.test, self.opvars, self.op,
+                              self.rec.sf, self.index)
+        if fold is True:
+            self._scan_expr(st.test, claimed)
+            return self.walk(st.body, claimed)
+        if fold is False:
+            self._scan_expr(st.test, claimed)
+            return self.walk(st.orelse, claimed)
+        # the duplicate gate: `if dup:` after a claim assignment whose
+        # body exits — everything after runs exactly-once
+        if isinstance(st.test, ast.Name) and \
+                st.test.id in self.claim_vars:
+            self.walk(st.body, True)      # the dedup/replay path
+            self.walk(st.orelse, claimed)
+            if P.block_terminates(st.body):
+                return True
+            return claimed
+        self._scan_expr(st.test, claimed)
+        cb = self.walk(st.body, claimed)
+        co = self.walk(st.orelse, claimed)
+        body_exits = P.block_terminates(st.body)
+        orelse_exits = P.block_terminates(st.orelse)
+        # after the if: claimed on every surviving path
+        return claimed or ((cb or body_exits) and (co or orelse_exits))
+
+    @staticmethod
+    def _stmt_exprs(st: ast.stmt):
+        if isinstance(st, ast.For):
+            return [st.iter]
+        if isinstance(st, ast.While):
+            return [st.test]
+        if isinstance(st, ast.With):
+            return [i.context_expr for i in st.items]
+        return []
+
+    # -- claims -------------------------------------------------------------
+
+    def _claim_assign(self, st: ast.stmt) -> bool:
+        """``dup, cached = <dedup>.check(...)`` — record the claim
+        variable."""
+        if not isinstance(st, ast.Assign) or \
+                not isinstance(st.value, ast.Call):
+            return False
+        root, chain = P._attr_root(st.value.func)
+        is_claim = (root in self.dedup_aliases and chain == ["check"]) \
+            or (root in self.selves and len(chain) == 2 and
+                chain[0] in self.spec.dedup_attrs and
+                chain[1] == "check")
+        if not is_claim:
+            return False
+        t = st.targets[0]
+        if isinstance(t, ast.Tuple) and t.elts and \
+                isinstance(t.elts[0], ast.Name):
+            self.claim_vars.add(t.elts[0].id)
+        elif isinstance(t, ast.Name):
+            self.claim_vars.add(t.id)
+        return True
+
+    # -- mutation scan ------------------------------------------------------
+
+    def _state_chain(self, node: ast.AST):
+        """(display root, attr chain BELOW the state object) when the
+        expression is rooted at the server-owned state — through a local
+        alias (``center.x``) or directly through ``self``/any derived
+        self-capture (``self.center.x``, ``outer.center.x``)."""
+        root, chain = P._attr_root(node)
+        if root in self.aliases and chain:
+            return root, chain
+        if root in self.selves and len(chain) >= 2 and \
+                chain[0] in self.spec.state_attrs:
+            return f"{root}.{chain[0]}", chain[1:]
+        return None, []
+
+    def _scan_expr(self, expr: ast.AST, claimed: bool) -> None:
+        if expr is None:
+            return
+        for node in ast.walk(expr):
+            hit = None
+            if isinstance(node, (ast.Assign, ast.AugAssign,
+                                 ast.AnnAssign, ast.Delete)):
+                targets = node.targets if isinstance(
+                    node, (ast.Assign, ast.Delete)) else [node.target]
+                for t in targets:
+                    base = t.value if isinstance(t, ast.Subscript) else t
+                    root, chain = self._state_chain(base)
+                    if root is not None:
+                        hit = f"writes `{root}.{'.'.join(chain)}`"
+            elif isinstance(node, ast.Attribute):
+                root, chain = self._state_chain(node)
+                if root is not None:
+                    if chain[-1] in self.mut:
+                        hit = f"calls mutating `{root}." \
+                              f"{'.'.join(chain)}`"
+                    elif len(chain) >= 2 and \
+                            chain[-1] in P.CONTAINER_MUTATORS:
+                        hit = f"mutates container `{root}." \
+                              f"{'.'.join(chain[:-1])}`"
+            if hit is None or claimed:
+                continue
+            line = getattr(node, "lineno", 1)
+            key = (line, hit)
+            if key in self._reported:
+                continue
+            self._reported.add(key)
+            self.findings.append(Finding(
+                self.check, self.rec.sf.path, line,
+                getattr(node, "col_offset", 0),
+                f"endpoint '{self.spec.name}' op '{self.op}': handler "
+                f"path {hit} without a dominating DedupWindow claim "
+                f"check — a wire retry applies this op twice "
+                f"(at-most-once violation; declare the op in "
+                f"idempotent_ops only if the mutation is idempotent "
+                f"by algebra)"))
+
+
+# ---------------------------------------------------------------------------
+# state-machine
+# ---------------------------------------------------------------------------
+
+@register
+class StateMachineChecker(Checker):
+    name = "state-machine"
+    description = ("membership transitions must emit exactly their "
+                   "declared events, reactors must handle or ignore "
+                   "every hook, alert actions must be dispatched, and "
+                   "wire-header reads must stay in the versioned "
+                   "vocabulary")
+    needs_engine = True
+
+    def check_program(self, index: ProgramIndex):
+        findings: List[Finding] = []
+        self._controller_findings(index, findings)
+        self._reactor_findings(index, findings)
+        self._action_findings(index, findings)
+        self._header_findings(index, findings)
+        return findings
+
+    # -- controller transitions ---------------------------------------------
+
+    def _controller_findings(self, index, findings):
+        module, cls = P.CONTROLLER_CLASS
+        recs = [r for r in index.records.values()
+                if r.class_key == (module, cls)]
+        if not recs:
+            return
+        path = recs[0].sf.path
+        vocab = index.module_constant(P.MEMBERSHIP_VOCAB)
+        center_vocab = index.module_constant(P.CENTER_VOCAB)
+        vocab = vocab if isinstance(vocab, tuple) else None
+        center_vocab = center_vocab if isinstance(center_vocab, tuple) \
+            else ()
+        if vocab is None:
+            findings.append(Finding(
+                self.name, path, 1, 0,
+                "MEMBERSHIP_EVENTS vocabulary tuple not found next to "
+                "MembershipController — the transition contract has no "
+                "declared event set"))
+            return
+        all_emits: Set[str] = set()
+        for rec in sorted(recs, key=lambda r: r.node.lineno):
+            emits = self._emit_literals(rec)
+            events = self._event_literals(rec)
+            all_emits |= emits
+            for status, node in self._status_writes(rec):
+                expected = P.STATUS_EVENTS.get(status)
+                if expected is None:
+                    findings.append(Finding(
+                        self.name, path, node.lineno, node.col_offset,
+                        f"`{rec.name}` writes undeclared worker status "
+                        f"{status!r} — the declared machine knows "
+                        f"{sorted(P.STATUS_EVENTS)}"))
+                elif expected not in emits:
+                    findings.append(Finding(
+                        self.name, path, node.lineno, node.col_offset,
+                        f"`{rec.name}` transitions a worker to "
+                        f"{status!r} without emitting its declared "
+                        f"'{expected}' event — the reactors and the "
+                        f"chaos audit never see this transition"))
+            for ev, hook, node in self._emit_calls(rec):
+                if ev is not None and ev not in vocab:
+                    findings.append(Finding(
+                        self.name, path, node.lineno, node.col_offset,
+                        f"`{rec.name}` emits event {ev!r} outside the "
+                        f"declared MEMBERSHIP_EVENTS vocabulary "
+                        f"{sorted(vocab)}"))
+                if hook is not None and hook not in P.REACTOR_HOOKS:
+                    findings.append(Finding(
+                        self.name, path, node.lineno, node.col_offset,
+                        f"`{rec.name}` fans out through undeclared "
+                        f"reactor hook {hook!r}"))
+                elif ev in P.EVENT_HOOKS and hook is not None and \
+                        hook not in P.EVENT_HOOKS[ev]:
+                    findings.append(Finding(
+                        self.name, path, node.lineno, node.col_offset,
+                        f"event {ev!r} fans out through hook {hook!r} "
+                        f"— declared hooks are "
+                        f"{list(P.EVENT_HOOKS[ev])}"))
+            for ev in events:
+                if ev not in vocab and ev not in center_vocab:
+                    findings.append(Finding(
+                        self.name, path, rec.node.lineno, 0,
+                        f"`{rec.name}` streams telemetry event {ev!r} "
+                        f"outside the declared membership/center "
+                        f"vocabularies"))
+        for ev in vocab:
+            if ev not in all_emits:
+                findings.append(Finding(
+                    self.name, path, 1, 0,
+                    f"declared MEMBERSHIP_EVENTS entry {ev!r} is never "
+                    f"emitted by any MembershipController transition — "
+                    f"dead vocabulary or a dropped emit"))
+
+    @staticmethod
+    def _status_writes(rec: FuncRecord):
+        out = []
+        for sub in ast.walk(rec.node):
+            values: List[ast.AST] = []
+            if isinstance(sub, ast.Assign):
+                for t in sub.targets:
+                    if isinstance(t, ast.Subscript) and \
+                            isinstance(t.slice, ast.Constant) and \
+                            t.slice.value == "status":
+                        values.append(sub.value)
+            elif isinstance(sub, ast.Call) and \
+                    isinstance(sub.func, ast.Attribute) and \
+                    sub.func.attr == "update":
+                for kw in sub.keywords:
+                    if kw.arg == "status":
+                        values.append(kw.value)
+            for v in values:
+                if isinstance(v, ast.IfExp):
+                    for arm in (v.body, v.orelse):
+                        if isinstance(arm, ast.Constant) and \
+                                isinstance(arm.value, str):
+                            out.append((arm.value, arm))
+                elif isinstance(v, ast.Constant) and \
+                        isinstance(v.value, str):
+                    out.append((v.value, v))
+        return out
+
+    @staticmethod
+    def _emit_calls(rec: FuncRecord):
+        """(event literal, hook literal, node) per ``self._emit`` call."""
+        out = []
+        for sub in ast.walk(rec.node):
+            if not isinstance(sub, ast.Call) or \
+                    not isinstance(sub.func, ast.Attribute) or \
+                    sub.func.attr != "_emit":
+                continue
+            ev = hook = None
+            if sub.args and isinstance(sub.args[0], ast.Constant):
+                ev = sub.args[0].value
+            if len(sub.args) > 2 and isinstance(sub.args[2],
+                                                ast.Constant):
+                hook = sub.args[2].value
+            out.append((ev, hook, sub))
+        return out
+
+    def _emit_literals(self, rec: FuncRecord) -> Set[str]:
+        return {ev for ev, _, _ in self._emit_calls(rec)
+                if isinstance(ev, str)}
+
+    @staticmethod
+    def _event_literals(rec: FuncRecord) -> Set[str]:
+        """Literal ``<tm>.event("...")`` names — the transitions that
+        stream without the ``_emit`` fan-out (the center pair)."""
+        out: Set[str] = set()
+        for sub in ast.walk(rec.node):
+            if isinstance(sub, ast.Call) and \
+                    isinstance(sub.func, ast.Attribute) and \
+                    sub.func.attr == "event" and sub.args and \
+                    isinstance(sub.args[0], ast.Constant) and \
+                    isinstance(sub.args[0].value, str):
+                out.add(sub.args[0].value)
+        return out
+
+    # -- reactor exhaustiveness ---------------------------------------------
+
+    def _reactor_findings(self, index, findings):
+        root_key = index._class_keys.get(P.REACTOR_ROOT)
+        if root_key is None:
+            return
+        for key in index.subclasses_of(P.REACTOR_ROOT):
+            if key == root_key:
+                continue
+            module, cls = key
+            sf = next((f for f in index.files
+                       if f.resolver.module == module), None)
+            if sf is None or sf.path.startswith("tests/"):
+                continue
+            node = index.file_index[sf.path].classes.get(cls)
+            line = node.lineno if node is not None else 1
+            for hook in P.REACTOR_HOOKS:
+                if f"{module}.{cls}.{hook}" not in index.by_qualname:
+                    findings.append(Finding(
+                        self.name, sf.path, line, 0,
+                        f"reactor `{cls}` neither handles nor "
+                        f"explicitly ignores `{hook}` — every reactor "
+                        f"must decide every event in the vocabulary "
+                        f"(override with `pass` to ignore)"))
+
+    # -- alert-action dispatch ----------------------------------------------
+
+    def _action_findings(self, index, findings):
+        actions = index.module_constant(P.ACTIONS_VOCAB)
+        if not isinstance(actions, tuple):
+            return
+        handler_recs: List[FuncRecord] = []
+        for path, suffix in P.ACTION_HANDLERS:
+            qn = f"{P.module_of(path)}.{suffix}"
+            handler_recs.extend(r for r in index.by_qualname.get(qn, [])
+                                if r.sf.path == path)
+        if not handler_recs:
+            return
+        for action in actions:
+            handled = False
+            for rec in handler_recs:
+                for sub in ast.walk(rec.node):
+                    if isinstance(sub, ast.Compare) and any(
+                            isinstance(c, ast.Constant) and
+                            c.value == action
+                            for c in sub.comparators):
+                        handled = True
+            if not handled:
+                anchor = handler_recs[0]
+                findings.append(Finding(
+                    self.name, anchor.sf.path, anchor.node.lineno, 0,
+                    f"declared alert action {action!r} "
+                    f"(fleetmon.RULE_ACTIONS) is dispatched by no "
+                    f"declared handler "
+                    f"({', '.join(s for _, s in P.ACTION_HANDLERS)}) — "
+                    f"an alert carrying it would be silently dropped"))
+
+    # -- wire-header field vocabulary ----------------------------------------
+
+    def _header_findings(self, index, findings):
+        for spec in P.ENDPOINTS:
+            if spec.server_path not in index.by_path:
+                continue
+            for read in P.header_reads(index, spec):
+                decl = P.HEADER_FIELDS.get(read.fieldname)
+                if decl is None:
+                    findings.append(Finding(
+                        self.name, read.path, read.line, 0,
+                        f"endpoint '{spec.name}' reads undeclared "
+                        f"wire-header field '{read.fieldname}' — "
+                        f"declare it in protocol.HEADER_FIELDS with "
+                        f"the protocol version that introduces it "
+                        f"(the v1→v2 `trace` precedent)"))
+                elif read.subscript and not decl[1]:
+                    findings.append(Finding(
+                        self.name, read.path, read.line, 0,
+                        f"endpoint '{spec.name}' subscript-reads "
+                        f"v{decl[0]}-optional header field "
+                        f"'{read.fieldname}' — a v1 peer omits it; "
+                        f"read it with .get() (version guard)"))

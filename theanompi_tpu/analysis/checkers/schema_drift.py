@@ -18,6 +18,14 @@ training sentry must emit the ``anomaly`` event with a ``kind`` from
 (``TRACKED_EVENTS``) must cover every emitter — so a new emitter can't
 stream events the report and Perfetto export silently drop.
 
+Round 19 adds the protocol cross-check (docs/design.md §21): the
+center op table the ``analysis/protocol.py`` extraction reads out of
+``center_server.py`` must equal the ops a LIVE ``RemoteCenter``
+actually sends against a stubbed wire — the static view the
+wire-contract/retry-safety checkers rest on is pinned to the runtime
+surface, so an extraction rule going stale fails the gate instead of
+silently blinding the protocol pass.
+
 Unlike the AST checkers this is a PROJECT-level probe against LIVE
 objects (a Recorder driven through one print, a Telemetry instance fed
 one bracket per phase, a sentry pushed into an anomaly), so a
@@ -30,20 +38,22 @@ the lint CLI stays backend-free.
 from __future__ import annotations
 
 import os
-from typing import List
+import sys
+from typing import List, Optional
 
 from ..core import Checker, Finding, register
+# endpoint files have ONE home — the §21 protocol model; re-declaring
+# them here let the probe parse one path while anchoring findings to
+# another if a module ever moved (review finding, round 19)
+from ..protocol import (CENTER_PATH, FLEETMON_PATH, MEMBERSHIP_PATH,
+                        TRACING_PATH, WIRE_PATH)
 
 TELEMETRY_PATH = "theanompi_tpu/utils/telemetry.py"
 RECORDER_PATH = "theanompi_tpu/utils/recorder.py"
 DEVPROF_PATH = "theanompi_tpu/utils/devprof.py"
 SENTRY_PATH = "theanompi_tpu/utils/sentry.py"
 REPORT_PATH = "scripts/telemetry_report.py"
-MEMBERSHIP_PATH = "theanompi_tpu/parallel/membership.py"
 CHAOS_PATH = "theanompi_tpu/utils/chaos.py"
-WIRE_PATH = "theanompi_tpu/parallel/wire.py"
-TRACING_PATH = "theanompi_tpu/utils/tracing.py"
-FLEETMON_PATH = "theanompi_tpu/utils/fleetmon.py"
 
 # one lane, one module: a compute span [0,50]us and a comm span [40,60]us
 # → compute 50us, comm 20us, exposed 10us, overlap 0.5 — a COMPLETE
@@ -740,6 +750,128 @@ def thread_role_coverage_errors(root: Optional[str] = None) -> List[tuple]:
     return errors
 
 
+def center_protocol_errors(center_server, root: Optional[str] = None
+                           ) -> List[tuple]:
+    """Round-19 probe: the §21 protocol model cross-checked against the
+    RUNTIME client surface.  The op table statically extracted from the
+    center dispatch ladder must be exactly (a) the op set the static
+    client table sees RemoteCenter sending AND (b) the ops a LIVE
+    RemoteCenter actually puts on the wire when every public op method
+    is driven against a stubbed wire — so neither the extraction rules
+    nor the client can drift from the runtime surface unnoticed.  The
+    wire stub captures each request header and aborts before any
+    network or jax work (``_leaves`` is stubbed too), keeping the probe
+    socket-free and backend-free."""
+    from .. import protocol
+    from ..core import SourceFile
+    from ..engine import ProgramIndex
+    if root is None:
+        root = os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__)))))
+    if not os.path.exists(os.path.join(root, protocol.CENTER_PATH)):
+        return []
+    try:
+        sf = SourceFile(root, protocol.CENTER_PATH)
+    except (SyntaxError, OSError):
+        return []               # the parse step reports it already
+    index = ProgramIndex([sf])
+    spec = next(s for s in protocol.ENDPOINTS if s.name == "center")
+    table = protocol.server_op_table(index, spec)
+    errors: List[tuple] = []
+    if table is None:
+        errors.append((CENTER_PATH,
+                       "the §21 center op table could not be extracted "
+                       "(dispatch function missing?) — the protocol "
+                       "checkers are blind to this endpoint"))
+        return errors
+    static_server = set(table)
+    static_client = set(protocol.client_op_table(index, spec))
+
+    class _Captured(Exception):
+        pass
+
+    sent: set = set()
+
+    class _WireStub:
+        def request(self, header, body=b"", trace=None):
+            sent.add(header.get("op"))
+            raise _Captured()
+
+        def close(self):
+            pass
+
+    rc = center_server.RemoteCenter("127.0.0.1:9")
+    try:
+        rc._wire.close()
+    except OSError:
+        pass
+    rc._wire = _WireStub()
+    rc._leaves = lambda tree: ([], None)    # instance stub: no jax flatten
+    surface = (("ensure_init", (None,)), ("pull", ()),
+               ("pull_leaves", ()), ("push_delta", (None, 0)),
+               ("push_pull", (None, 0)), ("demote_island", (0,)),
+               ("readmit_island", (0,)), ("stats", ()))
+    for method, args in surface:
+        try:
+            getattr(rc, method)(*args)
+        except _Captured:
+            continue
+        except Exception as e:
+            errors.append((CENTER_PATH,
+                           f"RemoteCenter.{method} failed before "
+                           f"reaching the wire ({e!r}) — the runtime "
+                           "surface probe cannot see its op"))
+    if sent != static_server:
+        errors.append((CENTER_PATH,
+                       f"a live RemoteCenter sends ops {sorted(sent)} "
+                       f"!= the extracted center dispatch table "
+                       f"{sorted(static_server)} — static protocol "
+                       "view drifted from the runtime surface (or this "
+                       "probe's own hardcoded `surface` method list in "
+                       "center_protocol_errors is stale: extend it "
+                       "when adding an op)"))
+    if static_client != static_server:
+        errors.append((CENTER_PATH,
+                       f"the static client op table "
+                       f"{sorted(static_client)} != the extracted "
+                       f"dispatch table {sorted(static_server)} — the "
+                       "wire-contract checker should have caught this; "
+                       "its extraction rules drifted"))
+    return errors
+
+
+def _load_parallel(name: str):
+    """A ``theanompi_tpu.parallel`` submodule imported WITHOUT executing
+    the jax-importing package ``__init__``: when the real package is not
+    already loaded, a synthetic parent (the scripts/lint.py bootstrap
+    pattern) is registered so the submodule's relative imports
+    (``from . import wire``) resolve jax-free.  None when absent or
+    broken (the probe skips its cross-checks)."""
+    import importlib
+    import importlib.machinery
+    import types
+    full = f"theanompi_tpu.parallel.{name}"
+    if full in sys.modules:
+        return sys.modules[full]
+    if "theanompi_tpu.parallel" not in sys.modules:
+        root = os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__)))))
+        pkg_dir = os.path.join(root, "theanompi_tpu", "parallel")
+        if not os.path.isdir(pkg_dir):
+            return None
+        pkg = types.ModuleType("theanompi_tpu.parallel")
+        pkg.__path__ = [pkg_dir]
+        spec = importlib.machinery.ModuleSpec(
+            "theanompi_tpu.parallel", loader=None, is_package=True)
+        spec.submodule_search_locations = [pkg_dir]
+        pkg.__spec__ = spec
+        sys.modules["theanompi_tpu.parallel"] = pkg
+    try:
+        return importlib.import_module(full)
+    except Exception:
+        return None
+
+
 def _load_by_path(relpath: str, name: str):
     """A probed module loaded by FILE path — for modules that are not
     importable in the lint CLI's jax-free process through the synthetic
@@ -831,6 +963,14 @@ class SchemaDriftChecker(Checker):
             fleetmon_mod = None
         errors += fleetmon_schema_errors(fleetmon_mod, membership,
                                          telemetry, report)
+        # round 19: the §21 protocol model cross-checked live — the
+        # extracted center op table must equal the ops a real
+        # RemoteCenter sends (static view vs runtime surface; the
+        # parallel package parent is synthesized so the submodule
+        # imports jax-free)
+        center_server = _load_parallel("center_server")
+        if center_server is not None:
+            errors += center_protocol_errors(center_server)
         # round 15: the thread-role map must see and resolve every
         # Thread/Timer spawn in the thread-heaviest runtime modules
         errors += thread_role_coverage_errors()

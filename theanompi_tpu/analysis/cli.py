@@ -14,6 +14,13 @@ Modes:
   ``fingerprint`` (schema in docs/design.md §12).
 * ``--only`` / ``--disable``: comma-separated checker names;
   ``--list-checks`` prints the registry.
+* ``--diff <ref>``: lint only the ``.py`` files changed vs a git ref
+  (``CACHED`` = the staged index vs HEAD — the precommit hook's mode),
+  filtered to the repo lint scope.  Partial-run semantics (stale
+  baseline entries are not judged, ``--update-baseline`` refuses) and
+  the per-file result cache apply, so CI and precommit runs on big
+  trees stay sub-second.  Untracked files are invisible to a git diff
+  — a full run still covers them.
 * ``--no-cache``: bypass the ``.tpulint_cache/`` result cache (on by
   default; keyed on content hashes + the analysis-source fingerprint,
   so it can only ever hit on a byte-identical configuration —
@@ -34,9 +41,9 @@ from typing import Dict, List, Optional
 
 from . import cache as cache_mod
 from . import checkers as _checkers  # noqa: F401  (registers the suite)
-from .core import (BASELINE_NAME, CHECKERS, Finding, compare_baseline,
-                   file_scoped_checkers, iter_py_paths, load_baseline,
-                   run_lint, save_baseline)
+from .core import (BASELINE_NAME, CHECKERS, DEFAULT_PATHS, Finding,
+                   compare_baseline, file_scoped_checkers, iter_py_paths,
+                   load_baseline, run_lint, save_baseline)
 
 
 def _repo_root() -> str:
@@ -62,6 +69,43 @@ def _split(value: Optional[str]) -> Optional[List[str]]:
     return out
 
 
+def _lint_scope(path: str) -> bool:
+    """Is a repo-relative path inside the default lint scope?"""
+    for d in DEFAULT_PATHS:
+        if path == d or path.startswith(d.rstrip("/") + "/"):
+            return True
+    return False
+
+
+def _git_changed(root: str, ref: str):
+    """Repo-relative ``.py`` paths changed vs ``ref`` (``CACHED`` = the
+    staged index vs HEAD), deletions excluded.  Git runs in ``root``
+    when it is a repository, else in the cwd — the precommit hook lints
+    a temp checkout of the index (no ``.git``) from the repo root, so
+    the diff is computed against the real repository either way.
+    Returns ``(paths, None)`` or ``(None, error message)``."""
+    import subprocess
+    # .git is a DIRECTORY in a primary checkout but a FILE in worktrees
+    # and submodules — exists() covers all three; a non-repo root (the
+    # precommit hook's temp index checkout) falls back to the cwd
+    git_root = root if os.path.exists(os.path.join(root, ".git")) \
+        else os.getcwd()
+    cmd = ["git", "-C", git_root, "diff", "--name-only",
+           "--diff-filter=d"]
+    cmd.append("--cached" if ref == "CACHED" else ref)
+    cmd += ["--", "*.py"]
+    try:
+        out = subprocess.run(cmd, capture_output=True, text=True,
+                             timeout=60)
+    except (OSError, subprocess.TimeoutExpired) as e:
+        return None, f"git unavailable ({e!r})"
+    if out.returncode != 0:
+        return None, (out.stderr.strip().splitlines() or
+                      [f"git diff exited {out.returncode}"])[-1]
+    return [ln.strip().replace(os.sep, "/")
+            for ln in out.stdout.splitlines() if ln.strip()], None
+
+
 def _cached_run(root, paths, only, disable, cache_dir=None):
     """Run the suite through the result cache.  Returns
     ``(findings, status)`` with status in hit/miss/off (off = the cache
@@ -84,14 +128,16 @@ def _cached_run(root, paths, only, disable, cache_dir=None):
         # full run).  Omitting one (e.g. membership.py for the round-15
         # thread-role coverage probe) would let a stale tree hit mask a
         # drift the probe exists to catch.
-        from .checkers.schema_drift import (CHAOS_PATH, DEVPROF_PATH,
+        from .checkers.schema_drift import (CENTER_PATH, CHAOS_PATH,
+                                            DEVPROF_PATH, FLEETMON_PATH,
                                             MEMBERSHIP_PATH, RECORDER_PATH,
                                             REPORT_PATH, SENTRY_PATH,
                                             TELEMETRY_PATH, TRACING_PATH,
                                             WIRE_PATH)
         for probe in (RECORDER_PATH, TELEMETRY_PATH, DEVPROF_PATH,
                       SENTRY_PATH, REPORT_PATH, MEMBERSHIP_PATH,
-                      CHAOS_PATH, WIRE_PATH, TRACING_PATH):
+                      CHAOS_PATH, WIRE_PATH, TRACING_PATH,
+                      FLEETMON_PATH, CENTER_PATH):
             if probe not in lint_rels and \
                     os.path.exists(os.path.join(root, probe)):
                 rels = list(rels) + [probe]
@@ -146,6 +192,10 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap.add_argument("--disable", default=None,
                     help="comma-separated checker names (or group) "
                          "to skip")
+    ap.add_argument("--diff", default=None, metavar="REF",
+                    help="lint only .py files changed vs the git ref "
+                         "(CACHED = staged index vs HEAD); partial-run "
+                         "semantics")
     ap.add_argument("--baseline", default=None,
                     help=f"baseline file (default: <root>/{BASELINE_NAME})")
     ap.add_argument("--update-baseline", action="store_true")
@@ -174,6 +224,32 @@ def main(argv: Optional[List[str]] = None) -> int:
     as_json = args.as_json or args.fmt == "json"
     root = os.path.abspath(args.root or _repo_root())
     baseline_path = args.baseline or os.path.join(root, BASELINE_NAME)
+    if args.diff:
+        if args.paths:
+            print("lint: --diff and explicit paths are mutually "
+                  "exclusive", file=sys.stderr)
+            return 2
+        if args.update_baseline:
+            # refused HERE, not only at the shared partial-run check
+            # below: an empty changeset's early exit 0 must not read as
+            # "baseline updated" to automation
+            print("lint: --update-baseline requires a full run (no "
+                  "paths/--diff/--only/--disable)", file=sys.stderr)
+            return 2
+        changed, err = _git_changed(root, args.diff)
+        if changed is None:
+            print(f"lint: --diff {args.diff}: {err}", file=sys.stderr)
+            return 2
+        # scope-filter, and drop paths absent from THIS root (a
+        # restricted precommit checkout holds only the staged blobs)
+        args.paths = sorted({
+            p for p in changed
+            if p.endswith(".py") and _lint_scope(p)
+            and os.path.exists(os.path.join(root, p))})
+        if not args.paths:
+            print(f"lint: no changed python files in lint scope vs "
+                  f"{args.diff}")
+            return 0
     # a typo'd explicit path must not read as "linted clean" — the
     # default set is allowed to have absent members (bare roots), an
     # explicitly named one is not
@@ -210,7 +286,7 @@ def main(argv: Optional[List[str]] = None) -> int:
             # a partial run only sees a slice of the findings — writing
             # it out would silently drop every entry outside the slice
             print("lint: --update-baseline requires a full run (no "
-                  "paths/--only/--disable)", file=sys.stderr)
+                  "paths/--diff/--only/--disable)", file=sys.stderr)
             return 2
         saved = save_baseline(baseline_path, findings, entries)
         print(f"tpulint: baseline written to "

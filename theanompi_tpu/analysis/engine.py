@@ -386,14 +386,25 @@ class ProgramIndex:
                 if resolved:
                     bases.append(resolved)
             self.class_bases[key] = bases
-        # module-level string constants (mesh axis names and the like)
+        # module-level constants: strings (mesh axis names and the like)
+        # and tuples of constants (event vocabularies — MEMBERSHIP_EVENTS,
+        # STATUSZ_OPS, RULE_ACTIONS — the protocol checkers read these
+        # statically).  Consumers filter by type, so adding tuples here
+        # cannot change the axis-name evaluation (isinstance(v, str)).
         for st in sf.tree.body:
-            if isinstance(st, ast.Assign) and \
-                    isinstance(st.value, ast.Constant):
-                for t in st.targets:
-                    if isinstance(t, ast.Name):
-                        self._module_constants[f"{module}.{t.id}"] = \
-                            st.value.value
+            if not isinstance(st, ast.Assign):
+                continue
+            value = None
+            if isinstance(st.value, ast.Constant):
+                value = st.value.value
+            elif isinstance(st.value, (ast.Tuple, ast.List)) and \
+                    all(isinstance(e, ast.Constant) for e in st.value.elts):
+                value = tuple(e.value for e in st.value.elts)
+            if value is None:
+                continue
+            for t in st.targets:
+                if isinstance(t, ast.Name):
+                    self._module_constants[f"{module}.{t.id}"] = value
 
     def _compute_subclasses(self) -> Dict[Tuple[str, str],
                                           Set[Tuple[str, str]]]:

@@ -1,0 +1,896 @@
+"""tpulint protocol model: the cross-process contracts as checkable data.
+
+The survivability plane made this repo a multi-endpoint distributed
+system: ``center_server.py``, ``FleetMonServer``, and the statusz
+endpoints all speak the §15 wire contract via string-literal op dispatch
+on both ends.  Until round 19 those string tables were pinned only by
+live socket tests — a deleted handler arm or a drifted retry verdict
+surfaced at run time, on the fleet.  This module is the ENGINE-SCOPED
+model of those contracts (docs/design.md §21) that the three
+``checkers/protocol_conformance.py`` checkers consume:
+
+* :data:`ENDPOINTS` — one :class:`EndpointSpec` per wire endpoint: where
+  the server's dispatch function lives, which client surfaces send to
+  it, which ops are deliberately idempotent (exempt from the dedup-claim
+  requirement), which server attributes own the dedup-guarded state, and
+  which handler ops are an external query surface (served for tooling,
+  legitimately unsent by in-repo clients).
+* **op-table extraction** — :func:`server_op_table` reads the ``op ==
+  "push"`` ladders (equality, tuple membership, module-constant ops like
+  ``METRICS_OP``) out of a dispatch function; :func:`client_op_table`
+  reads the ``{"op": ...}`` literals flowing into the declared request
+  functions; :func:`statusz_query_ops` pools every literal
+  ``tracing.statusz_query(addr, "<op>")`` call site (the fleetz dialer
+  speaks to BOTH statusz-compatible endpoint families).
+* **reply/verdict extraction** — :func:`reply_sites` collects each
+  handler's reply-header dict literals (plus constant-key subscript
+  stores like ``hdr["dedup"] = True``), flagging ``**``-splat/computed
+  replies as dynamic; :data:`REPLY_VERDICT_KEYS`/:data:`POLICY_KEYS` and
+  :data:`EXCEPTION_VERDICTS` are the §15 close-taxonomy as a table.
+* **retry-safety model** — :func:`mutating_methods` computes the
+  mutation-summary lattice over a state class (direct ``self.X``
+  stores/container mutations, closed over same-class calls);
+  :func:`state_aliases` finds the dispatch's local names for the
+  server-owned state; :func:`fold_op_test` decides a dispatch ``if``
+  test for one op value so the checker can walk exactly that op's
+  handler slice.
+* **membership state machine** — :data:`STATUS_EVENTS` maps each status
+  value a controller method may write to the event it must emit,
+  :data:`EVENT_HOOKS`/:data:`REACTOR_HOOKS` pin the reactor fan-out
+  vocabulary, and :data:`HEADER_FIELDS` declares the wire-header field
+  vocabulary per protocol version (the v1→v2 ``trace`` precedent made
+  checkable: a new header field must be declared here with its version,
+  and v2-OPTIONAL fields may only be read with ``.get`` — a subscript
+  read would KeyError against a v1 peer).
+
+Everything here is static (stdlib ``ast`` over the shared
+:class:`~.engine.ProgramIndex`) and jax-free.  Extraction that cannot
+resolve something returns nothing rather than guessing — partial trees
+(precommit staged-blob runs) skip cross-file checks they cannot see,
+never invent findings; :class:`EndpointSpec.requires` lists the files a
+direction needs in scope before it may claim an op is unsent.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Dict, FrozenSet, List, Optional, Sequence, Set, Tuple
+
+from .engine import FuncRecord, ProgramIndex, body_walk
+
+# -- endpoint files (repo-relative; fixtures mirror these paths) -------------
+
+CENTER_PATH = "theanompi_tpu/parallel/center_server.py"
+FLEETMON_PATH = "theanompi_tpu/utils/fleetmon.py"
+TRACING_PATH = "theanompi_tpu/utils/tracing.py"
+WIRE_PATH = "theanompi_tpu/parallel/wire.py"
+MEMBERSHIP_PATH = "theanompi_tpu/parallel/membership.py"
+ASYNC_EASGD_PATH = "theanompi_tpu/parallel/async_easgd.py"
+FLEETZ_PATH = "scripts/fleetz.py"
+
+#: the one generic statusz dialer — its literal op args pool into the
+#: statusz-compatible endpoint family's client table
+STATUSZ_QUERY_FN = "theanompi_tpu.utils.tracing.statusz_query"
+DEFAULT_STATUSZ_OP = "health"      # statusz_query's own default op
+
+
+# -- declarations ------------------------------------------------------------
+
+@dataclass(frozen=True)
+class ClientSurface:
+    """One place requests originate: calls to ``request_fns`` within
+    ``scope`` (a class or function simple name; "" = whole module) of
+    ``path``, whose header dict (positional ``header_arg``) carries the
+    op literal."""
+
+    path: str
+    scope: str
+    request_fns: Tuple[str, ...]
+    header_arg: int = 0
+
+
+@dataclass(frozen=True)
+class ReadSurface:
+    """Where a client stack reads reply headers: names bound from
+    ``request_fns`` call results (first element of a tuple unpack when
+    ``tuple_result``, the whole result otherwise) scanned for
+    ``.get("k")`` / ``["k"]`` reads."""
+
+    path: str
+    scope: str
+    request_fns: Tuple[str, ...]
+    tuple_result: bool = True
+
+
+@dataclass(frozen=True)
+class EndpointSpec:
+    name: str
+    server_path: str
+    dispatch: str                       # dotted suffix: "Handler._dispatch"
+    clients: Tuple[ClientSurface, ...] = ()
+    reads: Tuple[ReadSurface, ...] = ()
+    #: handler ops that are an external query surface (CLI tooling,
+    #: Prometheus scrapes, tests) — legitimately unsent by in-repo
+    #: clients.  Everything else unsent is a dead handler.
+    external_ops: FrozenSet[str] = frozenset()
+    #: mutating ops exempt from the dedup-claim requirement because the
+    #: mutation is idempotent BY ALGEBRA (seed-once init, set-membership
+    #: demote/readmit) — the §21 suppression surface for checker (b).
+    idempotent_ops: FrozenSet[str] = frozenset()
+    #: server attrs holding the dedup-guarded state (``self.center``)
+    state_attrs: Tuple[str, ...] = ()
+    #: dotted classes owning that state — their mutating methods are
+    #: what a handler path must not reach unclaimed
+    state_classes: Tuple[str, ...] = ()
+    #: server attrs holding the DedupWindow (claim machinery, exempt)
+    dedup_attrs: Tuple[str, ...] = ("dedup",)
+    #: member of the statusz-dial family (fleetz speaks to all of them
+    #: with one query function, so their client table is pooled)
+    statusz_compat: bool = False
+    #: served behind WireClient — the shared verdict vocabulary applies
+    wire_verdicts: bool = False
+    #: files that must be in scope before the unsent-handler/verdict
+    #: directions may fire (partial trees skip, never invent)
+    requires: Tuple[str, ...] = ()
+
+
+ENDPOINTS: Tuple[EndpointSpec, ...] = (
+    EndpointSpec(
+        name="center",
+        server_path=CENTER_PATH,
+        dispatch="Handler._dispatch",
+        clients=(ClientSurface(CENTER_PATH, "RemoteCenter",
+                               ("_roundtrip",)),),
+        reads=(ReadSurface(CENTER_PATH, "RemoteCenter", ("_roundtrip",)),),
+        external_ops=frozenset(),
+        # init seeds once (ensure_init_leaves is a no-op when leaves
+        # exist); demote/readmit are set membership — retrying any of
+        # them re-applies the same state
+        idempotent_ops=frozenset({"init", "demote", "readmit"}),
+        state_attrs=("center",),
+        state_classes=("theanompi_tpu.parallel.async_easgd.ElasticCenter",),
+        wire_verdicts=True,
+    ),
+    EndpointSpec(
+        name="fleetmon",
+        server_path=FLEETMON_PATH,
+        dispatch="Handler._dispatch",
+        clients=(ClientSurface(FLEETMON_PATH, "MetricStreamer",
+                               ("request",)),),
+        # series/rollup/exposition are the ops query surface (fleetz
+        # --watch dials health/alerts/events; Prometheus scrapes ride
+        # exposition externally; tests drive series/rollup directly)
+        external_ops=frozenset({"series", "rollup", "exposition"}),
+        idempotent_ops=frozenset(),
+        state_attrs=("collector",),
+        state_classes=("theanompi_tpu.utils.fleetmon.FleetCollector",),
+        statusz_compat=True,
+        wire_verdicts=True,
+        requires=(TRACING_PATH, FLEETZ_PATH),
+    ),
+    EndpointSpec(
+        name="statusz",
+        server_path=TRACING_PATH,
+        dispatch="Handler.handle",
+        statusz_compat=True,
+        requires=(FLEETMON_PATH, FLEETZ_PATH),
+    ),
+)
+
+#: the shared wire-client verdict reads (every wire endpoint's replies
+#: are interpreted here)
+WIRE_CLIENT_READS = ReadSurface(WIRE_PATH, "WireClient",
+                                ("recv_msg", "_request_locked"))
+
+#: reply-header keys that GATE client behavior (§15): ``retry`` = re-send
+#: the same token; ``busy`` = an in-flight twin's retryable non-ack;
+#: ``uninit`` = structured terminal (client re-seeds); ``dedup`` = the
+#: applied-before marker trace assembly reads.
+POLICY_KEYS = ("retry", "busy", "uninit", "dedup")
+#: the full verdict vocabulary a reply header may carry
+REPLY_VERDICT_KEYS = ("ok", "error", "srv") + POLICY_KEYS
+
+#: §15 close-taxonomy, checkable: the reply a server sends from these
+#: exception handlers must be retryable / terminal as declared.
+EXCEPTION_VERDICTS = {
+    "CorruptPayload": "retryable",      # bytes bad, stream aligned
+    "VersionMismatch": "terminal",      # never retried, loud
+}
+
+#: wire-header field vocabulary: field -> (protocol version introduced,
+#: subscript-read allowed).  v2 OPTIONAL fields (absent ⇒ v1 behavior)
+#: must be read with ``.get`` — ``header["trace"]`` would KeyError
+#: against a v1 peer.  An undeclared read fails the gate: a new header
+#: field must land here WITH its version, which is exactly the v1→v2
+#: ``trace`` precedent as a standing rule.
+HEADER_FIELDS = {
+    "op": (1, True), "tok": (1, True), "crc": (1, True), "v": (1, True),
+    "island": (1, True), "rank": (1, True), "role": (1, True),
+    "status": (1, True), "series": (1, True), "n": (1, True),
+    "reason": (1, True),
+    "trace": (2, False), "srv": (2, False),
+}
+
+# -- membership state machine ------------------------------------------------
+
+CONTROLLER_CLASS = ("theanompi_tpu.parallel.membership",
+                    "MembershipController")
+REACTOR_ROOT = "theanompi_tpu.parallel.membership.Reactor"
+MEMBERSHIP_VOCAB = "theanompi_tpu.parallel.membership.MEMBERSHIP_EVENTS"
+CENTER_VOCAB = "theanompi_tpu.parallel.membership.CENTER_EVENTS"
+ACTIONS_VOCAB = "theanompi_tpu.utils.fleetmon.RULE_ACTIONS"
+
+#: status value a controller method writes -> the event that write must
+#: emit (the live⇄demoted→dead/left machine, docs/design.md §14)
+STATUS_EVENTS = {
+    "live": "worker_join",
+    "demoted": "worker_demote",
+    "dead": "worker_leave",
+    "left": "worker_leave",
+}
+#: event -> reactor hooks ``_emit`` may legally fan it out through
+EVENT_HOOKS = {
+    "worker_join": ("on_join", "on_readmit"),
+    "worker_leave": ("on_leave",),
+    "worker_demote": ("on_demote",),
+}
+#: the full reactor hook vocabulary every Reactor subclass must handle
+#: or explicitly ignore (an override with ``pass``)
+REACTOR_HOOKS = ("on_join", "on_leave", "on_demote", "on_readmit")
+
+#: where alert ACTIONS are handled — every fleetmon.RULE_ACTIONS entry
+#: must be dispatched in one of these (module-path, dotted suffix) fns
+ACTION_HANDLERS = (
+    (FLEETMON_PATH, "apply_alert"),
+    (MEMBERSHIP_PATH, "ElasticSupervisor._tick_fleetmon"),
+)
+
+#: container methods that mutate their receiver (the mutation-summary
+#: lattice's leaf rule next to plain ``self.X = ...`` stores)
+CONTAINER_MUTATORS = frozenset({
+    "append", "appendleft", "add", "discard", "remove", "update", "pop",
+    "popitem", "setdefault", "extend", "insert", "clear",
+})
+
+
+# -- small shared helpers ----------------------------------------------------
+
+def module_of(path: str) -> str:
+    return path[:-3].replace("/", ".") if path.endswith(".py") else \
+        path.replace("/", ".")
+
+
+@dataclass
+class OpSite:
+    """One place an op string appears (a dispatch comparison or a client
+    send)."""
+
+    path: str
+    node: ast.AST
+
+    @property
+    def line(self) -> int:
+        return getattr(self.node, "lineno", 1)
+
+    @property
+    def col(self) -> int:
+        return getattr(self.node, "col_offset", 0)
+
+
+def const_str(node: ast.AST, sf, index: ProgramIndex) -> Optional[str]:
+    """A statically-known string: literal, imported module constant, or
+    a constant of the SAME module (``METRICS_OP`` compared in its own
+    file) — None when not evaluable (never guessed)."""
+    if isinstance(node, ast.Constant):
+        return node.value if isinstance(node.value, str) else None
+    if isinstance(node, (ast.Name, ast.Attribute)):
+        resolved = sf.resolver.resolve(node)
+        if resolved is None and isinstance(node, ast.Name):
+            resolved = f"{sf.resolver.module}.{node.id}"
+        if resolved:
+            v = index.module_constant(resolved)
+            if isinstance(v, str):
+                return v
+    return None
+
+
+def dispatch_record(index: ProgramIndex,
+                    spec: EndpointSpec) -> Optional[FuncRecord]:
+    """The server's dispatch FuncRecord, or None when the file is in
+    scope but the declared function is not (model out of date — the
+    wire-contract checker reports that loudly)."""
+    qn = f"{module_of(spec.server_path)}.{spec.dispatch}"
+    recs = [r for r in index.by_qualname.get(qn, [])
+            if r.sf.path == spec.server_path]
+    return recs[0] if recs else None
+
+
+def op_var_names(fn_node: ast.AST) -> Set[str]:
+    """Names assigned from ``<header>.get("op")`` — the dispatch's op
+    variable(s)."""
+    out: Set[str] = set()
+    for sub in ast.walk(fn_node):
+        if isinstance(sub, ast.Assign) and \
+                isinstance(sub.value, ast.Call) and \
+                isinstance(sub.value.func, ast.Attribute) and \
+                sub.value.func.attr == "get" and sub.value.args and \
+                isinstance(sub.value.args[0], ast.Constant) and \
+                sub.value.args[0].value == "op":
+            for t in sub.targets:
+                if isinstance(t, ast.Name):
+                    out.add(t.id)
+    return out
+
+
+def _comparison_ops(test: ast.AST, opvars: Set[str], sf,
+                    index: ProgramIndex) -> List[Tuple[str, ast.AST]]:
+    """(op value, comparison node) for every equality/membership test of
+    an op variable inside ``test``."""
+    out: List[Tuple[str, ast.AST]] = []
+    for sub in ast.walk(test):
+        if not isinstance(sub, ast.Compare) or \
+                not isinstance(sub.left, ast.Name) or \
+                sub.left.id not in opvars:
+            continue
+        for cmp_op, comp in zip(sub.ops, sub.comparators):
+            if isinstance(cmp_op, (ast.Eq, ast.NotEq)):
+                v = const_str(comp, sf, index)
+                if v is not None:
+                    out.append((v, sub))
+            elif isinstance(cmp_op, (ast.In, ast.NotIn)) and \
+                    isinstance(comp, (ast.Tuple, ast.List, ast.Set)):
+                for e in comp.elts:
+                    v = const_str(e, sf, index)
+                    if v is not None:
+                        out.append((v, sub))
+    return out
+
+
+# -- server/client op tables -------------------------------------------------
+
+def server_op_table(index: ProgramIndex, spec: EndpointSpec
+                    ) -> Optional[Dict[str, OpSite]]:
+    """Every op the dispatch function compares its op variable against
+    (first comparison site per op), or None when the dispatch function
+    is missing from an in-scope server file."""
+    rec = dispatch_record(index, spec)
+    if rec is None:
+        return None
+    opvars = op_var_names(rec.node)
+    if not opvars:
+        # handle() styles that take `op` as a parameter
+        opvars = {p for p in rec.params() if p == "op"}
+    table: Dict[str, OpSite] = {}
+    for sub in ast.walk(rec.node):
+        test = None
+        if isinstance(sub, (ast.If, ast.IfExp, ast.While)):
+            test = sub.test
+        elif isinstance(sub, ast.Compare):
+            test = sub
+        if test is None:
+            continue
+        for v, node in _comparison_ops(test, opvars, rec.sf, index):
+            table.setdefault(v, OpSite(rec.sf.path, node))
+    return table
+
+
+def _scope_records(index: ProgramIndex, path: str,
+                   scope: str) -> List[FuncRecord]:
+    sf = index.by_path.get(path)
+    if sf is None:
+        return []
+    module = sf.resolver.module
+    out: List[FuncRecord] = []
+    for rec in index.records.values():
+        if rec.sf.path != path:
+            continue
+        if not scope:
+            out.append(rec)
+        elif rec.class_key == (module, scope) or \
+                rec.qualname == f"{module}.{scope}" or \
+                rec.qualname.startswith(f"{module}.{scope}."):
+            out.append(rec)
+    return out
+
+
+def _local_dict(fn_node: ast.AST, name: str) -> Optional[ast.Dict]:
+    """The dict literal a local name was assigned from (the
+    ``header = {"op": ...}; client.request(header, ...)`` shape)."""
+    for sub in ast.walk(fn_node):
+        if isinstance(sub, ast.Assign) and \
+                isinstance(sub.value, ast.Dict) and \
+                any(isinstance(t, ast.Name) and t.id == name
+                    for t in sub.targets):
+            return sub.value
+    return None
+
+
+def _dict_key_value(d: ast.Dict, key: str) -> Optional[ast.AST]:
+    for k, v in zip(d.keys, d.values):
+        if isinstance(k, ast.Constant) and k.value == key:
+            return v
+    return None
+
+
+def client_op_table(index: ProgramIndex, spec: EndpointSpec
+                    ) -> Dict[str, List[OpSite]]:
+    """Ops the declared client surfaces send: the ``"op"`` values of
+    header dict literals (inline or bound to a local name) passed to the
+    surface's request functions."""
+    out: Dict[str, List[OpSite]] = {}
+    for surf in spec.clients:
+        for rec in _scope_records(index, surf.path, surf.scope):
+            if isinstance(rec.node, ast.Lambda):
+                continue
+            for sub in ast.walk(rec.node):
+                if not isinstance(sub, ast.Call):
+                    continue
+                fn = sub.func
+                fname = fn.id if isinstance(fn, ast.Name) else (
+                    fn.attr if isinstance(fn, ast.Attribute) else None)
+                if fname not in surf.request_fns:
+                    continue
+                hdr = sub.args[surf.header_arg] \
+                    if len(sub.args) > surf.header_arg else None
+                for kw in sub.keywords:
+                    if kw.arg == "header":
+                        hdr = kw.value
+                if isinstance(hdr, ast.Name):
+                    hdr = _local_dict(rec.node, hdr.id)
+                if not isinstance(hdr, ast.Dict):
+                    continue
+                v = _dict_key_value(hdr, "op")
+                op = const_str(v, rec.sf, index) if v is not None else None
+                if op is not None:
+                    out.setdefault(op, []).append(
+                        OpSite(rec.sf.path, sub))
+    return out
+
+
+def statusz_query_ops(index: ProgramIndex) -> Dict[str, List[OpSite]]:
+    """Every literal op sent through ``tracing.statusz_query`` in
+    non-test files (tests deliberately send unknown ops to probe the
+    error path).  A call with the op omitted sends the function's own
+    default (``health``)."""
+    out: Dict[str, List[OpSite]] = {}
+    for sf in index.files:
+        if sf.path.startswith("tests/"):
+            continue
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if sf.resolver.resolve(node.func) != STATUSZ_QUERY_FN:
+                continue
+            arg = node.args[1] if len(node.args) > 1 else None
+            for kw in node.keywords:
+                if kw.arg == "op":
+                    arg = kw.value
+            if arg is None:
+                out.setdefault(DEFAULT_STATUSZ_OP, []).append(
+                    OpSite(sf.path, node))
+                continue
+            v = const_str(arg, sf, index)
+            if v is not None:
+                out.setdefault(v, []).append(OpSite(sf.path, node))
+    return out
+
+
+# -- reply sites -------------------------------------------------------------
+
+@dataclass
+class ReplySite:
+    path: str
+    node: ast.AST
+    keys: Optional[FrozenSet[str]]      # None = dynamic (splat/computed)
+    consts: Dict[str, object] = field(default_factory=dict)
+
+    @property
+    def line(self) -> int:
+        return getattr(self.node, "lineno", 1)
+
+
+def _server_class_records(index: ProgramIndex,
+                          spec: EndpointSpec) -> List[FuncRecord]:
+    """Every method of the dispatch function's class (handle +
+    _dispatch + anything else on the Handler) — the scope reply/verdict
+    extraction covers."""
+    rec = dispatch_record(index, spec)
+    if rec is None:
+        return []
+    if rec.class_key is None:
+        return [rec]
+    return [r for r in index.records.values()
+            if r.class_key == rec.class_key and
+            not isinstance(r.node, ast.Lambda)]
+
+
+def _reply_header_arg(call: ast.Call) -> Optional[ast.AST]:
+    """The header expression of a reply site — a call to the local
+    ``reply(...)`` closure or to ``<x>.send_msg(sock, hdr)`` — or None
+    when the call is neither."""
+    fn = call.func
+    if isinstance(fn, ast.Name) and fn.id == "reply":
+        return call.args[0] if call.args else None
+    if isinstance(fn, ast.Attribute) and fn.attr == "send_msg":
+        return call.args[1] if len(call.args) > 1 else None
+    return None
+
+
+def reply_sites(index: ProgramIndex, spec: EndpointSpec
+                ) -> Tuple[List[ReplySite], Set[str]]:
+    """(reply sites, extra emitted keys).  The extra keys are
+    constant-key subscript stores (``hdr["dedup"] = True``) into names
+    that FLOW INTO a reply somewhere in the handler class — reply
+    headers are sometimes built up before the send, but an unrelated
+    local dict's keys must not launder into the emitted set (they would
+    mask unset-reply-field findings)."""
+    sites: List[ReplySite] = []
+    extra: Set[str] = set()
+    recs = _server_class_records(index, spec)
+    # names that reach a reply header argument (``reply(hdr, ...)``,
+    # ``send_msg(sock, h)``) anywhere in the class, PLUS the reply
+    # closure's own parameter names (``def reply(hdr, ...)`` — its body
+    # builds ``h = dict(hdr)`` and sends h) and names assigned from them
+    header_names: Set[str] = set()
+    for rec in recs:
+        for sub in ast.walk(rec.node):
+            if isinstance(sub, ast.Call):
+                hdr = _reply_header_arg(sub)
+                if isinstance(hdr, ast.Name):
+                    header_names.add(hdr.id)
+            elif isinstance(sub, (ast.FunctionDef,
+                                  ast.AsyncFunctionDef)) and \
+                    sub.name == "reply":
+                header_names.update(a.arg for a in sub.args.args)
+    # one transitive hop: `h = dict(hdr)` / `h = hdr` style rebinds
+    for rec in recs:
+        for sub in ast.walk(rec.node):
+            if isinstance(sub, ast.Assign) and any(
+                    isinstance(n, ast.Name) and n.id in header_names
+                    for n in ast.walk(sub.value)):
+                for t in sub.targets:
+                    if isinstance(t, ast.Name):
+                        header_names.add(t.id)
+    for rec in recs:
+        for sub in ast.walk(rec.node):
+            if isinstance(sub, ast.Assign):
+                for t in sub.targets:
+                    if isinstance(t, ast.Subscript) and \
+                            isinstance(t.value, ast.Name) and \
+                            t.value.id in header_names and \
+                            isinstance(t.slice, ast.Constant) and \
+                            isinstance(t.slice.value, str):
+                        extra.add(t.slice.value)
+                continue
+            if not isinstance(sub, ast.Call):
+                continue
+            hdr = _reply_header_arg(sub)
+            if hdr is None:
+                continue
+            if isinstance(hdr, ast.Dict):
+                keys = frozenset(k.value for k in hdr.keys
+                                 if isinstance(k, ast.Constant))
+                if any(k is None for k in hdr.keys):   # ** splat
+                    keys = None
+                consts = {}
+                for k, v in zip(hdr.keys, hdr.values):
+                    if isinstance(k, ast.Constant) and \
+                            isinstance(v, ast.Constant):
+                        consts[k.value] = v.value
+                sites.append(ReplySite(rec.sf.path, sub, keys, consts))
+            else:
+                sites.append(ReplySite(rec.sf.path, sub, None))
+    return sites, extra
+
+
+def exception_reply_sites(index: ProgramIndex, spec: EndpointSpec,
+                          exc_name: str) -> List[ReplySite]:
+    """Reply sites INSIDE ``except <...>.{exc_name}`` handlers of the
+    server class — the close-taxonomy check's input."""
+    out: List[ReplySite] = []
+    for rec in _server_class_records(index, spec):
+        for sub in ast.walk(rec.node):
+            if not isinstance(sub, ast.ExceptHandler) or sub.type is None:
+                continue
+            types = sub.type.elts if isinstance(sub.type, ast.Tuple) \
+                else [sub.type]
+            match = False
+            for t in types:
+                dotted = None
+                if isinstance(t, ast.Name):
+                    dotted = t.id
+                elif isinstance(t, ast.Attribute):
+                    dotted = t.attr
+                if dotted == exc_name:
+                    match = True
+            if not match:
+                continue
+            handler_mod = ast.Module(body=sub.body, type_ignores=[])
+            for call in ast.walk(handler_mod):
+                if not isinstance(call, ast.Call):
+                    continue
+                hdr = _reply_header_arg(call)
+                if isinstance(hdr, ast.Dict):
+                    keys = frozenset(k.value for k in hdr.keys
+                                     if isinstance(k, ast.Constant))
+                    consts = {k.value: v.value
+                              for k, v in zip(hdr.keys, hdr.values)
+                              if isinstance(k, ast.Constant)
+                              and isinstance(v, ast.Constant)}
+                    out.append(ReplySite(rec.sf.path, call, keys, consts))
+    return out
+
+
+# -- client reply reads ------------------------------------------------------
+
+def reply_reads(index: ProgramIndex,
+                surf: ReadSurface) -> Dict[str, OpSite]:
+    """Reply-header keys the surface reads: names bound from its request
+    functions' results, scanned for ``.get("k")`` / ``["k"]``."""
+    out: Dict[str, OpSite] = {}
+    for rec in _scope_records(index, surf.path, surf.scope):
+        if isinstance(rec.node, ast.Lambda):
+            continue
+        reply_vars: Set[str] = set()
+        for sub in ast.walk(rec.node):
+            if not isinstance(sub, ast.Assign) or \
+                    not isinstance(sub.value, ast.Call):
+                continue
+            fn = sub.value.func
+            fname = fn.id if isinstance(fn, ast.Name) else (
+                fn.attr if isinstance(fn, ast.Attribute) else None)
+            if fname not in surf.request_fns:
+                continue
+            for t in sub.targets:
+                if surf.tuple_result and isinstance(t, ast.Tuple) and \
+                        t.elts and isinstance(t.elts[0], ast.Name):
+                    reply_vars.add(t.elts[0].id)
+                elif not surf.tuple_result and isinstance(t, ast.Name):
+                    reply_vars.add(t.id)
+        if not reply_vars:
+            continue
+        for sub in ast.walk(rec.node):
+            if isinstance(sub, ast.Call) and \
+                    isinstance(sub.func, ast.Attribute) and \
+                    sub.func.attr == "get" and \
+                    isinstance(sub.func.value, ast.Name) and \
+                    sub.func.value.id in reply_vars and sub.args and \
+                    isinstance(sub.args[0], ast.Constant) and \
+                    isinstance(sub.args[0].value, str):
+                out.setdefault(sub.args[0].value,
+                               OpSite(rec.sf.path, sub))
+            elif isinstance(sub, ast.Subscript) and \
+                    isinstance(sub.value, ast.Name) and \
+                    sub.value.id in reply_vars and \
+                    isinstance(sub.ctx, ast.Load) and \
+                    isinstance(sub.slice, ast.Constant) and \
+                    isinstance(sub.slice.value, str):
+                out.setdefault(sub.slice.value,
+                               OpSite(rec.sf.path, sub))
+    return out
+
+
+# -- header-field reads ------------------------------------------------------
+
+@dataclass
+class HeaderRead:
+    path: str
+    node: ast.AST
+    fieldname: str
+    subscript: bool
+
+    @property
+    def line(self) -> int:
+        return getattr(self.node, "lineno", 1)
+
+
+def header_reads(index: ProgramIndex,
+                 spec: EndpointSpec) -> List[HeaderRead]:
+    """Request-header fields the dispatch function reads — through the
+    ``header`` parameter or names unpacked from ``recv_msg``."""
+    rec = dispatch_record(index, spec)
+    if rec is None:
+        return []
+    hdr_vars: Set[str] = {p for p in rec.params() if p == "header"}
+    for sub in ast.walk(rec.node):
+        if isinstance(sub, ast.Assign) and \
+                isinstance(sub.value, ast.Call) and \
+                isinstance(sub.value.func, (ast.Name, ast.Attribute)):
+            fn = sub.value.func
+            fname = fn.id if isinstance(fn, ast.Name) else fn.attr
+            if fname == "recv_msg":
+                for t in sub.targets:
+                    if isinstance(t, ast.Tuple) and t.elts and \
+                            isinstance(t.elts[0], ast.Name):
+                        hdr_vars.add(t.elts[0].id)
+    out: List[HeaderRead] = []
+    for sub in ast.walk(rec.node):
+        if isinstance(sub, ast.Call) and \
+                isinstance(sub.func, ast.Attribute) and \
+                sub.func.attr == "get" and \
+                isinstance(sub.func.value, ast.Name) and \
+                sub.func.value.id in hdr_vars and sub.args and \
+                isinstance(sub.args[0], ast.Constant) and \
+                isinstance(sub.args[0].value, str):
+            out.append(HeaderRead(rec.sf.path, sub, sub.args[0].value,
+                                  False))
+        elif isinstance(sub, ast.Subscript) and \
+                isinstance(sub.value, ast.Name) and \
+                sub.value.id in hdr_vars and \
+                isinstance(sub.ctx, ast.Load) and \
+                isinstance(sub.slice, ast.Constant) and \
+                isinstance(sub.slice.value, str):
+            out.append(HeaderRead(rec.sf.path, sub, sub.slice.value,
+                                  True))
+    return out
+
+
+# -- retry-safety model ------------------------------------------------------
+
+def _attr_root(node: ast.AST) -> Tuple[Optional[str], List[str]]:
+    """(root Name id, attribute chain) of ``name.a.b`` — (None, []) for
+    anything not rooted at a plain Name."""
+    chain: List[str] = []
+    while isinstance(node, ast.Attribute):
+        chain.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        return node.id, list(reversed(chain))
+    return None, []
+
+
+def _direct_self_mutation(rec: FuncRecord) -> bool:
+    """Does this method body store into ``self.X`` (assign/augassign/
+    del/subscript) or call a container mutator on a ``self`` attr?"""
+    for sub in body_walk(rec.node):
+        targets: List[ast.AST] = []
+        if isinstance(sub, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+            targets = sub.targets if isinstance(sub, ast.Assign) \
+                else [sub.target]
+        elif isinstance(sub, ast.Delete):
+            targets = list(sub.targets)
+        for t in targets:
+            if isinstance(t, ast.Subscript):
+                t = t.value
+            root, chain = _attr_root(t)
+            if root == "self" and chain:
+                return True
+        if isinstance(sub, ast.Call):
+            root, chain = _attr_root(sub.func)
+            if root == "self" and len(chain) >= 2 and \
+                    chain[-1] in CONTAINER_MUTATORS:
+                return True
+    return False
+
+
+def mutating_methods(index: ProgramIndex,
+                     dotted_classes: Sequence[str]) -> Set[str]:
+    """Method names of the state classes (and their in-scope subclasses)
+    that mutate ``self`` — directly, or by calling a same-class mutating
+    method (monotone fixpoint: the §21 mutation-summary lattice)."""
+    keys: Set[Tuple[str, str]] = set()
+    for dotted in dotted_classes:
+        key = index._class_keys.get(dotted)
+        if key is None:
+            continue
+        keys.add(key)
+        keys |= index._subclasses.get(key, set())
+    if not keys:
+        return set()
+    recs = [r for r in index.records.values()
+            if r.class_key in keys and not isinstance(r.node, ast.Lambda)]
+    mut = {r.name for r in recs if _direct_self_mutation(r)}
+    changed = True
+    while changed:
+        changed = False
+        for r in recs:
+            if r.name in mut:
+                continue
+            for sub in body_walk(r.node):
+                if isinstance(sub, ast.Call):
+                    root, chain = _attr_root(sub.func)
+                    if root == "self" and len(chain) == 1 and \
+                            chain[0] in mut:
+                        mut.add(r.name)
+                        changed = True
+                        break
+    return mut
+
+
+def self_aliases(index: ProgramIndex, spec: EndpointSpec) -> Set[str]:
+    """Local names the server file binds to a bare ``self`` (the
+    ``outer = self`` closure-capture idiom) — derived, not hardcoded, so
+    renaming the capture cannot silently blind the mutation scan."""
+    out: Set[str] = {"self"}
+    sf = index.by_path.get(spec.server_path)
+    if sf is None:
+        return out
+    for sub in ast.walk(sf.tree):
+        if isinstance(sub, ast.Assign) and \
+                isinstance(sub.value, ast.Name) and \
+                sub.value.id in ("self",):
+            for t in sub.targets:
+                if isinstance(t, ast.Name):
+                    out.add(t.id)
+    return out
+
+
+def state_aliases(index: ProgramIndex, spec: EndpointSpec,
+                  attrs: Sequence[str]) -> Set[str]:
+    """Local names the server file binds to ``<self>.<attr>`` for the
+    declared state attrs, through ``self`` or any of its captures
+    (:func:`self_aliases`) — the dispatch closure's handles on the
+    server-owned state (``center = self.center`` in ``start()``).
+    File-level and deliberately coarse: an extra alias can only widen
+    the mutation scan, never hide one."""
+    sf = index.by_path.get(spec.server_path)
+    if sf is None:
+        return set()
+    selves = self_aliases(index, spec)
+    out: Set[str] = set(attrs)
+    for sub in ast.walk(sf.tree):
+        if not isinstance(sub, ast.Assign):
+            continue
+        root, chain = _attr_root(sub.value)
+        if root in selves and len(chain) == 1 and chain[0] in attrs:
+            for t in sub.targets:
+                if isinstance(t, ast.Name):
+                    out.add(t.id)
+    return out
+
+
+def fold_op_test(test: ast.AST, opvars: Set[str], value: str, sf,
+                 index: ProgramIndex) -> Optional[bool]:
+    """Decide a dispatch ``if`` test for one op value: True/False when
+    the test is a pure function of the op variable, None otherwise
+    (both arms possible)."""
+    if isinstance(test, ast.BoolOp):
+        parts = [fold_op_test(v, opvars, value, sf, index)
+                 for v in test.values]
+        if isinstance(test.op, ast.And):
+            if any(p is False for p in parts):
+                return False
+            if all(p is True for p in parts):
+                return True
+            return None
+        if any(p is True for p in parts):
+            return True
+        if all(p is False for p in parts):
+            return False
+        return None
+    if isinstance(test, ast.UnaryOp) and isinstance(test.op, ast.Not):
+        inner = fold_op_test(test.operand, opvars, value, sf, index)
+        return None if inner is None else not inner
+    if isinstance(test, ast.Compare) and \
+            isinstance(test.left, ast.Name) and \
+            test.left.id in opvars and len(test.ops) == 1:
+        cmp_op, comp = test.ops[0], test.comparators[0]
+        if isinstance(cmp_op, (ast.Eq, ast.NotEq)):
+            v = const_str(comp, sf, index)
+            if v is None:
+                return None
+            eq = (v == value)
+            return eq if isinstance(cmp_op, ast.Eq) else not eq
+        if isinstance(cmp_op, (ast.In, ast.NotIn)) and \
+                isinstance(comp, (ast.Tuple, ast.List, ast.Set)):
+            vals = [const_str(e, sf, index) for e in comp.elts]
+            if any(v is None for v in vals):
+                return None
+            member = value in vals
+            return member if isinstance(cmp_op, ast.In) else not member
+    return None
+
+
+def block_terminates(stmts: Sequence[ast.stmt]) -> bool:
+    """Every path through this block exits it (return/raise/continue/
+    break, or an if whose arms both terminate)."""
+    if not stmts:
+        return False
+    last = stmts[-1]
+    if isinstance(last, (ast.Return, ast.Raise, ast.Continue, ast.Break)):
+        return True
+    if isinstance(last, ast.If):
+        return block_terminates(last.body) and \
+            block_terminates(last.orelse)
+    return False

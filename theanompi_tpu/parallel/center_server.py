@@ -496,8 +496,11 @@ class RemoteCenter:
         self._roundtrip({"op": "init"}, pack_leaves(leaves), trace=trace)
 
     def pull(self, trace: Optional[dict] = None):
-        import jax
+        # jax only AFTER the wire round-trip: the reply is what needs
+        # unflattening, and the jax-free protocol probe (schema-drift
+        # §21) drives this surface against a stubbed wire
         _, body = self._roundtrip({"op": "pull"}, trace=trace)
+        import jax
         leaves = unpack_leaves(body)
         assert self._treedef is not None, "pull before ensure_init"
         return jax.tree.unflatten(self._treedef, leaves)
@@ -514,10 +517,10 @@ class RemoteCenter:
 
     def push_pull(self, delta_mean, island: int,
                   trace: Optional[dict] = None):
-        import jax
         leaves, _ = self._leaves(delta_mean)
         _, body = self._roundtrip({"op": "push_pull", "island": island},
                                   pack_leaves(leaves), trace=trace)
+        import jax
         assert self._treedef is not None, "push_pull before ensure_init"
         return jax.tree.unflatten(self._treedef, unpack_leaves(body))
 
