@@ -240,6 +240,29 @@ def _cfg_matches(cfg: str) -> bool:
         return False
     if want_bucket is not None and want_bucket not in parts:
         return False
+    # pipeline rows (pp / pp_interleave in BENCH_CFG; label tokens ppN /
+    # vN): a pipelined program is never an honest fallback for the dense
+    # row, and an interleaved v=2 schedule is not a fill/drain one — the
+    # schedules run different tick counts on different meshes
+    import re as _re
+    try:
+        bcfg = json.loads(os.environ.get("BENCH_CFG") or "{}")
+    except ValueError:
+        bcfg = {}
+    pp = int(bcfg.get("pp", 1) or 1)
+    want_pp = f"pp{pp}" if pp > 1 else None
+    has_pp = any(_re.fullmatch(r"pp\d+", p) for p in parts)
+    if (want_pp is not None) != has_pp:
+        return False
+    if want_pp is not None and want_pp not in parts:
+        return False
+    v = int(bcfg.get("pp_interleave", 1) or 1)
+    want_v = f"v{v}" if v > 1 else None
+    has_v = any(_re.fullmatch(r"v\d+", p) for p in parts)
+    if (want_v is not None) != has_v:
+        return False
+    if want_v is not None and want_v not in parts:
+        return False
     return True
 
 
@@ -854,6 +877,14 @@ def main() -> int:
             # ROADMAP item 1's bucketed-overlap work is gated on
             from theanompi_tpu.utils import devprof
             tdir = os.environ.get("BENCH_TRACE_DIR")
+            # pipelined rows read the schedule's tick structure out of the
+            # raw hop events (devprof.pipeline_schedule_report), so the
+            # capture dir must outlive the context manager
+            pipe_pp = int(config.get("pp", 1) or 1)
+            own_tdir = None
+            if pipe_pp > 1 and tdir is None:
+                import tempfile
+                tdir = own_tdir = tempfile.mkdtemp(prefix="bench_pipe_")
             try:
                 with devprof.capture(tdir) as cap:
                     for i in range(trace_iters):
@@ -863,9 +894,24 @@ def main() -> int:
                 if trace_profile is None:
                     print("bench: BENCH_TRACE capture produced no usable "
                           "trace", file=sys.stderr)
+                elif pipe_pp > 1:
+                    rep = devprof.pipeline_schedule_report(
+                        devprof.load_dir_events(tdir), pp=pipe_pp,
+                        v=int(config.get("pp_interleave", 1) or 1),
+                        m=int(config.get("pp_microbatches", 1) or 1))
+                    trace_profile["pipeline_bubble_ticks"] = \
+                        rep["bubble_fraction_ticks"]
+                    trace_profile["pipeline_bubble_time"] = \
+                        rep["bubble_fraction"]
+                    trace_profile["pipeline_schedule_verified"] = \
+                        rep["schedule_verified"]
             except Exception as e:
                 print(f"bench: BENCH_TRACE capture failed ({e!r})",
                       file=sys.stderr)
+            finally:
+                if own_tdir is not None:
+                    import shutil
+                    shutil.rmtree(own_tdir, ignore_errors=True)
         return (model, spc, n_images, dt, compiled, timed_load_wait,
                 spc1_flops, step_secs, trace_profile)
 
@@ -978,6 +1024,12 @@ def main() -> int:
             total_flops=(flops_per_dispatch * trace_iters
                          if flops_per_dispatch else None),
             peak_flops=peak or None))
+        # pipelined rows (devprof.PIPELINE_ROW_COLUMNS): the hop-event
+        # schedule measurement — tick-count bubble, wall-time bubble,
+        # and whether the capture's hop count verified the tick structure
+        for col in devprof.PIPELINE_ROW_COLUMNS:
+            if col in trace_profile:
+                out[col] = trace_profile[col]
     if real_data or winload:
         # overlap evidence (SURVEY §2.8 "input pipeline at AlexNet
         # speeds"): the share of the timed window the consumer spent

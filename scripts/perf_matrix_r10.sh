@@ -1,0 +1,77 @@
+#!/usr/bin/env bash
+# Round-10 perf matrix — the interleaved-pipeline round (ISSUE 16
+# tentpole): TransformerLM at depth on a pp=4 'pipe' mesh, fill/drain
+# control vs v∈{2,4} interleaved virtual stages (pp_interleave).  Every
+# row captures a BENCH_TRACE window so the schedule measurement lands in
+# the row JSON (devprof.PIPELINE_ROW_COLUMNS): pipeline_bubble_ticks
+# (exact when pipeline_schedule_verified), pipeline_bubble_time (what
+# the bubble costs in wall time), next to the generic trace columns.
+# The acceptance comparison is one jq away:
+#   jq -r 'select(.result) | [.config, .result.pipeline_bubble_ticks,
+#          .result.pipeline_bubble_time,
+#          .result.pipeline_schedule_verified] | @tsv'
+# and scripts/predict_scaling.py --json joins the measured column against
+# its (pp, v, M, t_chunk, t_hop) bubble model per row.
+#
+# Same discipline as perf_matrix_r9.sh (the PR 3 prewarm machinery):
+#   1. prewarm: every staged r10 row's program — the interleaved rows'
+#      AOT keys carry pp_interleave (utils/compile_cache.key_extra) —
+#      compiles into the executable store BEFORE the window.
+#   2. canary: the fill/drain control must report `cache: hit`, or the
+#      pass aborts loudly instead of burning the window compiling.
+#   3. the scans: rows from scripts/rows.py --round r10 (the manifest
+#      prewarm consumed); rows already measured in the out-file skip.
+#   ./scripts/perf_matrix_r10.sh [out_file]
+set -u -o pipefail
+OUT="${1:-perf_matrix_r10.jsonl}"
+cd "$(dirname "$0")/.."
+. scripts/_bench_row.sh
+
+CACHE="${BENCH_COMPILE_CACHE:-/tmp/jax_bench_cache}"
+PIPE_CFG='{"d_model":512,"n_head":8,"n_layer":16,"seq_len":512,"vocab":32768,"synthetic_train":512,"pp":4,"pp_microbatches":8}'
+
+# 1. prewarm (idempotent: cached rows skip in ~ms); live backend venue
+# first, topology venue fallback when the tunnel can't answer
+echo "== prewarm -> $CACHE" >&2
+timeout -s KILL 3000 python -u scripts/prewarm_cache.py --rows r10 \
+    --cache "$CACHE" --platform tpu >&2 \
+  || timeout -s KILL 3000 python -u scripts/prewarm_cache.py --rows r10 \
+    --cache "$CACHE" --platform topology:v5e:2x2x1 >&2 \
+  || echo "== prewarm failed (rows will compile on the clock)" >&2
+
+# 2. canary: the fill/drain control program must hit the executable
+# cache — a miss means the pipeline key composition (pp/pp_microbatches/
+# pp_interleave in key_extra) drifted from what prewarm stored
+echo "== canary: transformer_lm-b16-pp4 must report cache: hit" >&2
+canary=$(env BENCH_SKIP_PROBE="${BENCH_SKIP_PROBE:-1}" \
+             BENCH_MODEL=transformer_lm BENCH_BATCH=16 \
+             BENCH_CFG="$PIPE_CFG" \
+             BENCH_ITERS=5 \
+             BENCH_COMPILE_CACHE="$CACHE" python bench.py 2>>"${OUT%.jsonl}.err" | tail -1)
+echo "$canary" | python -c '
+import json, sys
+row = json.loads(sys.stdin.read())
+cache = row.get("cache")
+assert cache == "hit", (
+    f"canary row is cache: {cache!r}, not \"hit\" — the pipelined "
+    f"program key does not match what prewarm stored (row: {row}); "
+    f"aborting before the heavy rows burn the window on compiles")
+print("== canary hit (compile %ss)" % (row.get("compile_secs"),),
+      file=sys.stderr)
+' || exit 1
+echo "{\"config\": \"transformer_lm-b16-pp4-canary\", \"result\": $canary}" >> "$OUT"
+
+# 3. the staged rows (fill/drain control + v=2 + v=4, every one tracing)
+while read -r line; do
+  eval "run $line"
+done < <(python scripts/rows.py --round r10 --sh)
+
+python scripts/merge_matrix.py "$OUT"
+cat "$OUT"
+
+# 4. closing gate: fresh rows within BENCH_REGRESS_PCT (default 10%) of
+# each label's best fresh committed reading — the window self-judges
+python scripts/bench_regress.py "$OUT" \
+    --threshold "${BENCH_REGRESS_PCT:-10}" \
+    --json "${OUT%.jsonl}_regress.json" \
+  || { echo "== bench_regress: throughput regression gate FAILED" >&2; exit 7; }

@@ -124,6 +124,48 @@ def bucketed_exchange(wire_b: float, payload_b: float, t_step: float,
                                    if t_comm > 0 else None),
             "eff": round(t_step / (t_step + exposed), 4)}
 
+def pipeline_bubble(pp: int, v: int, m: int, t_chunk: float = 1.0,
+                    t_hop: float = 0.0) -> dict:
+    """Pipeline-schedule bubble model (round 10, ISSUE 16).
+
+    The fill/drain GPipe scan idles ``pp−1`` warm-up/drain ticks of an
+    ``m + pp − 1``-tick schedule; interleaving ``v`` virtual stages per
+    device (``parallel/pipeline.py`` schedule table) keeps each device's
+    useful work at ``v·m`` chunk-ticks but each tick is a ``1/v``-depth
+    chunk, so the same ``pp−1`` idle ticks sit in a ``v·m + pp − 1``-tick
+    schedule — the bubble shrinks by ~``v``.  With per-tick costs:
+
+        busy  = v·m·t_chunk                (useful compute per device)
+        span  = (v·m + pp − 1)·(t_chunk + t_hop)
+        bubble_fraction = 1 − busy/span
+
+    ``t_hop`` is the per-tick activation-hop cost the schedule pays
+    ``v·m + pp − 1`` times instead of ``m + pp − 1`` — the price of
+    interleaving, zero when the async hop fully overlaps chunk compute
+    (jax_compat.ppermute_start/done under the fused scan).  At
+    ``t_hop = 0`` this reduces to the classic ``(pp−1)/(v·m + pp−1)``,
+    which is exactly what the measured ``pipeline_bubble_ticks`` column
+    (devprof.pipeline_schedule_report) reports when the capture's hop
+    count verifies the tick structure."""
+    ticks = v * m + pp - 1
+    busy = v * m * t_chunk
+    span = ticks * (t_chunk + t_hop)
+    return {"pp": pp, "v": v, "m": m, "ticks": ticks,
+            "warmup_ticks": pp - 1,
+            "bubble_fraction": round(1.0 - busy / span, 4)}
+
+
+# staged r10 pipeline rows (scripts/rows.py) -> (matrix label, pp, v, M);
+# t_chunk/t_hop default to the uniform-tick model — the measured join
+# below reports both the tick-count and wall-time measured bubbles next
+# to the prediction
+PIPELINE_CONFIGS = [
+    ("transformer_lm-b16-pp4-trace",    4, 1, 8),
+    ("transformer_lm-b16-pp4-v2-trace", 4, 2, 8),
+    ("transformer_lm-b16-pp4-v4-trace", 4, 4, 8),
+]
+
+
 # staged configs (BASELINE.json) -> (matrix row, strategy model, params key)
 CONFIGS = [
     ("alexnet-b128",      "allreduce", 4, "alexnet", 128),
@@ -323,6 +365,33 @@ def main() -> int:
         out["rows"].append(row)
         print(f"{cfg:24} {ips:>9.0f} {t_step * 1e3:>9.2f} {cells}",
               file=sys.stderr)
+    # pipeline-schedule rows (round 10): predicted bubble vs the measured
+    # devprof columns of the r10 matrix rows — same predicted-vs-measured
+    # join the r9 bucket rows get above
+    out["pipeline_rows"] = []
+    print(f"\n{'pipeline row':34} {'pred bubble':>11} {'meas ticks':>10} "
+          f"{'meas time':>9} {'verified':>8}", file=sys.stderr)
+    for label, pp, v, m in PIPELINE_CONFIGS:
+        pred = pipeline_bubble(pp, v, m)
+        prow = {"config": label, "predicted": pred, "measured": None}
+        res = measured.get(label)
+        if res and res.get("pipeline_bubble_ticks") is not None:
+            prow["measured"] = {
+                k: res.get(k)
+                for k in ("pipeline_bubble_ticks", "pipeline_bubble_time",
+                          "pipeline_schedule_verified", "bubble_fraction")}
+            mt = res["pipeline_bubble_ticks"]
+            pb = pred["bubble_fraction"]
+            prow["rel_err_ticks"] = (round(abs(mt - pb) / pb, 4)
+                                     if pb else None)
+            print(f"{label:34} {pb:>11.4f} {mt:>10.4f} "
+                  f"{res.get('pipeline_bubble_time') or float('nan'):>9.4f} "
+                  f"{str(res.get('pipeline_schedule_verified')):>8}",
+                  file=sys.stderr)
+        else:
+            print(f"{label:34} {pred['bubble_fraction']:>11.4f} "
+                  f"{'--':>10}  (no measured r10 row yet)", file=sys.stderr)
+        out["pipeline_rows"].append(prow)
     print(json.dumps(out, indent=1))
     return 0
 

@@ -39,6 +39,15 @@ def main(argv=None):
     ap.add_argument("--json", default=None, metavar="OUT",
                     help="write the machine-readable profile here "
                          "('-' for stdout)")
+    ap.add_argument("--cfg", default=None, metavar="JSON",
+                    help="JSON config overrides (bench.py BENCH_CFG "
+                         "conventions — transformer dims, pp/tp/sp, "
+                         "pp_interleave...); tp/pp/sp shape the mesh")
+    ap.add_argument("--schedule", action="store_true",
+                    help="print the per-lane schedule occupancy report "
+                         "(devprof.schedule_occupancy) from the capture; "
+                         "with pp>1 in --cfg, also the hop-event pipeline "
+                         "schedule measurement")
     args = ap.parse_args(argv)
     model_name = args.model
     trace_dir = os.environ.get("PROFILE_DIR",
@@ -56,10 +65,13 @@ def main(argv=None):
     from theanompi_tpu.utils import devprof
 
     jax.config.update("jax_default_prng_impl", "rbg")
-    mesh = worker_mesh()
+    overrides = json.loads(args.cfg) if args.cfg else {}
+    mesh = worker_mesh(tp=int(overrides.get("tp", 1)),
+                       pp=int(overrides.get("pp", 1)),
+                       sp=int(overrides.get("sp", 1)))
     modelfile, modelclass, extra = MODELS[model_name]
     config = {"mesh": mesh, "size": mesh.shape[WORKER_AXIS], "rank": 0,
-              "verbose": False, **extra}
+              "verbose": False, **extra, **overrides}
     if args.batch:
         config["batch_size"] = args.batch
     if args.spc > 1:
@@ -104,6 +116,24 @@ def main(argv=None):
           f"{f' spc={spc}' if spc > 1 else ''}: {args.iters} traced "
           f"dispatch(es) on {jax.devices()[0].platform} ==")
     print(devprof.format_profile(prof, top=25))
+    if args.schedule:
+        # per-lane tick-level occupancy (compute / hop / other-comm /
+        # idle strips) — a schedule regression is diagnosable per lane,
+        # not just a worse scalar
+        events = devprof.load_dir_events(trace_dir)
+        print()
+        print(devprof.format_schedule(devprof.schedule_occupancy(events)))
+        pp = int(config.get("pp", 1) or 1)
+        if pp > 1:
+            rep = devprof.pipeline_schedule_report(
+                events, pp=pp,
+                v=int(config.get("pp_interleave", 1) or 1),
+                m=int(config.get("pp_microbatches", 1) or 1))
+            print(f"pipeline schedule: ticks/pass={rep['ticks_per_pass']} "
+                  f"measured_ticks={rep['measured_ticks']} "
+                  f"verified={rep['schedule_verified']} "
+                  f"bubble_ticks={rep['bubble_fraction_ticks']} "
+                  f"bubble_time={rep['bubble_fraction']}")
     if args.json:
         doc = {"model": model_name, "batch_size": int(model.batch_size),
                "rule": args.rule, "spc": spc, "iters": args.iters,

@@ -115,6 +115,28 @@ ROWS: List[Row] = [
     _r("alexnet-b128-easgd-spc8-bucket4m-trace", "r9 heavy",
        BENCH_MODEL="alexnet", BENCH_RULE="easgd", BENCH_SPC=8,
        BENCH_SYNTH_BATCHES=8, BENCH_BUCKET_BYTES=4194304, BENCH_TRACE=1),
+    # -- round-10 interleaved-pipeline rows (ISSUE 16): TransformerLM at
+    #    depth on a pp=4 'pipe' mesh — fill/drain control vs v∈{2,4}
+    #    interleaved virtual stages (pp_interleave), each row tracing so
+    #    devprof's bubble_fraction lands in the row JSON next to
+    #    predict_scaling's modeled bubble.  n_layer=16 divides pp·v for
+    #    every staged v; M=8 microbatches (pp | M, the interleaved
+    #    grouping requirement) ------------------------------------------
+    _r("transformer_lm-b16-pp4-trace", "r10 heavy",
+       BENCH_MODEL="transformer_lm", BENCH_BATCH=16, BENCH_TRACE=1,
+       BENCH_CFG='{"d_model":512,"n_head":8,"n_layer":16,"seq_len":512,'
+                 '"vocab":32768,"synthetic_train":512,"pp":4,'
+                 '"pp_microbatches":8}'),       # fill/drain control
+    _r("transformer_lm-b16-pp4-v2-trace", "r10 heavy",
+       BENCH_MODEL="transformer_lm", BENCH_BATCH=16, BENCH_TRACE=1,
+       BENCH_CFG='{"d_model":512,"n_head":8,"n_layer":16,"seq_len":512,'
+                 '"vocab":32768,"synthetic_train":512,"pp":4,'
+                 '"pp_microbatches":8,"pp_interleave":2}'),
+    _r("transformer_lm-b16-pp4-v4-trace", "r10 heavy",
+       BENCH_MODEL="transformer_lm", BENCH_BATCH=16, BENCH_TRACE=1,
+       BENCH_CFG='{"d_model":512,"n_head":8,"n_layer":16,"seq_len":512,'
+                 '"vocab":32768,"synthetic_train":512,"pp":4,'
+                 '"pp_microbatches":8,"pp_interleave":4}'),
 ]
 
 

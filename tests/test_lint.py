@@ -1222,6 +1222,70 @@ def test_injection_dropped_done_in_onebit_strategy(tmp_path):
         [f.render() for f in found]
 
 
+def test_collective_discipline_dead_ticket(tmp_path):
+    """The round-10 dead-ticket probe: a ticket assigned from a start
+    and never read is flagged even when the scope's counts balance
+    (a typo'd done consuming the wrong ticket twice)."""
+    code = (
+        "from jax import lax\n"
+        "def hop(a, b):\n"
+        "    t1 = lax.ppermute_start(a, 'pipe', [(0, 1)])\n"
+        "    t2 = lax.ppermute_start(b, 'pipe', [(0, 1)])\n"   # dead!
+        "    x = lax.ppermute_done(t1)\n"
+        "    y = lax.ppermute_done(t1)\n"                # counts balance
+        "    return x + y\n")
+    found = lint_snippet(tmp_path, "x.py", code, "collective-discipline")
+    assert len(found) == 1 and found[0].line == 4
+    assert "dropped hop ticket" in found[0].message
+    assert "`t2`" in found[0].message
+
+
+def test_collective_discipline_consumed_ticket_ok(tmp_path):
+    """The healthy per-slot hop shape (pipeline.py scan body): ticket
+    started and awaited — clean."""
+    code = (
+        "from theanompi_tpu.jax_compat import ppermute_start, "
+        "ppermute_done\n"
+        "def hop(x, perm):\n"
+        "    ticket = ppermute_start(x, 'pipe', perm)\n"
+        "    return ppermute_done(ticket)\n")
+    assert lint_snippet(tmp_path, "x.py", code,
+                        "collective-discipline") == []
+
+
+def test_injection_stripped_hop_done_in_pipeline(tmp_path):
+    """Live injection (the ISSUE 16 schedule-slot gate): strip the ONE
+    ppermute_done from the real interleaved scan body — the per-slot
+    hop ticket is started and never awaited — and the checker must
+    fire; the unmodified file is clean."""
+    clean = core.run_lint(REPO,
+                          paths=["theanompi_tpu/parallel/pipeline.py"],
+                          only=["collective-discipline"])
+    assert clean == [], [f.render() for f in clean]
+    rel = _inject(tmp_path, "theanompi_tpu/parallel/pipeline.py",
+                  "            state = jc.ppermute_done(ticket)",
+                  "            state = out")
+    found = core.run_lint(str(tmp_path), paths=[rel],
+                          only=["collective-discipline"])
+    assert any("unbalanced async collective pair" in f.message
+               and "ppermute_start" in f.message for f in found), \
+        [f.render() for f in found]
+
+
+def test_injection_wrong_ticket_in_pipeline(tmp_path):
+    """The harder schedule-slot failure: the done consumes the WRONG
+    value so start/done counts still balance — only the dead-ticket
+    probe sees the leaked per-slot hop."""
+    rel = _inject(tmp_path, "theanompi_tpu/parallel/pipeline.py",
+                  "            state = jc.ppermute_done(ticket)",
+                  "            state = jc.ppermute_done(out)")
+    found = core.run_lint(str(tmp_path), paths=[rel],
+                          only=["collective-discipline"])
+    assert any("dropped hop ticket" in f.message
+               and "`ticket`" in f.message for f in found), \
+        [f.render() for f in found]
+
+
 # ---------------------------------------------------------------------------
 # sharding-schema
 # ---------------------------------------------------------------------------

@@ -186,6 +186,7 @@ class CollectiveDisciplineChecker(Checker):
         # from pairing — each shim half is one-sided by construction.
         check_pairs = sf.resolver.module != _SHIM_MODULE
         pairs: Dict[str, Dict[str, List[ast.Call]]] = {}
+        ticket_assigns: List[Tuple[str, ast.Call, str]] = []
         for st in stmts:
             for expr in self._stmt_exprs(st):
                 for call in self._calls(expr):
@@ -206,6 +207,14 @@ class CollectiveDisciplineChecker(Checker):
                     if ap is not None and check_pairs:
                         pairs.setdefault(ap[0], {}).setdefault(
                             ap[1], []).append(call)
+                        if ap[1] == "start" and \
+                                isinstance(st, ast.Assign) and \
+                                len(st.targets) == 1 and \
+                                isinstance(st.targets[0], ast.Name) and \
+                                st.value is call:
+                            # candidate for the dead-ticket probe below
+                            ticket_assigns.append(
+                                (st.targets[0].id, call, ap[0]))
                         if ap[1] == "start" and isinstance(st, ast.Expr) \
                                 and st.value is call:
                             # ticket discarded on the floor: even with the
@@ -218,16 +227,43 @@ class CollectiveDisciplineChecker(Checker):
                                 f"`{ap[0]}_start` ticket is discarded "
                                 f"(bare expression statement) — it can "
                                 f"never reach `{ap[0]}_done`"))
+        unbalanced: Set[str] = set()
         for prefix, sides in sorted(pairs.items()):
             starts = sides.get("start", [])
             dones = sides.get("done", [])
             if len(starts) != len(dones):
+                unbalanced.add(prefix)
                 anchor = (starts or dones)[0]
                 findings.append(Finding(
                     self.name, sf.path, anchor.lineno, anchor.col_offset,
                     f"unbalanced async collective pair: "
                     f"{len(starts)}x `{prefix}_start` vs {len(dones)}x "
                     f"`{prefix}_done` in the same scope"))
+
+        # 3b: dead-ticket probe (round 10, the per-schedule-slot hop of
+        # the interleaved pipeline scan body): a name assigned from a
+        # `<x>_start` and never read again cannot reach its done even
+        # when the scope's start/done COUNTS balance through other pairs
+        # (e.g. a typo'd done consuming the wrong ticket twice) — that
+        # schedule slot's hop is leaked in-flight every tick.  Loads are
+        # collected over the whole scope subtree (nested defs included)
+        # so a ticket consumed by a closure never false-positives; an
+        # unbalanced prefix is already reported above, so the probe only
+        # speaks when the counts LOOK healthy.
+        if check_pairs and ticket_assigns:
+            loaded: Set[str] = set()
+            for sub in ast.walk(scope if scope is not None else sf.tree):
+                if isinstance(sub, ast.Name) and \
+                        isinstance(sub.ctx, ast.Load):
+                    loaded.add(sub.id)
+            for tname, call, prefix in ticket_assigns:
+                if tname not in loaded and prefix not in unbalanced:
+                    findings.append(Finding(
+                        self.name, sf.path, call.lineno, call.col_offset,
+                        f"dropped hop ticket: `{tname}` holds the "
+                        f"`{prefix}_start` in-flight collective but is "
+                        f"never consumed — this slot's hop can never "
+                        f"reach `{prefix}_done`"))
 
         # 2: collectives under rank-derived branches
         for st in stmts:

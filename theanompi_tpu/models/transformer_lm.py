@@ -247,11 +247,14 @@ class TransformerLM(ModelBase):
     pp = 1          # pipeline-parallel degree (mesh gains a 'pipe' axis)
     sp = 1          # sequence-parallel degree (mesh gains a 'seq' axis)
     pp_microbatches = 0   # microbatches streamed per step (0 → 2·pp)
+    pp_interleave = 1     # virtual layer chunks per pipeline stage (v):
+    #   v>1 interleaves non-contiguous chunks so the pipeline bubble drops
+    #   from (pp−1)/(M+pp−1) to (pp−1)/(v·M+pp−1) — parallel/pipeline.py
 
     def build_model(self) -> None:
         cd = self.config.get("compute_dtype", jnp.bfloat16)
         for k in ("vocab", "d_model", "n_head", "n_layer", "seq_len", "tp",
-                  "pp", "sp", "pp_microbatches"):
+                  "pp", "sp", "pp_microbatches", "pp_interleave"):
             if k in self.config:
                 setattr(self, k, int(self.config[k]))
         if self.sp > 1:
@@ -272,6 +275,26 @@ class TransformerLM(ModelBase):
                 f"n_layer={self.n_layer} not divisible by pp={self.pp}")
             if not self.pp_microbatches:
                 self.pp_microbatches = 2 * self.pp
+        if self.pp_interleave > 1:
+            # interleaved virtual stages: v chunks of L/(pp·v) layers per
+            # device — pipeline_apply re-validates at trace time; fail at
+            # build time with the config knobs named
+            if self.pp == 1:
+                raise ValueError(
+                    f"pp_interleave={self.pp_interleave} needs pipeline "
+                    f"parallelism — set the 'pp' config knob > 1 (got "
+                    f"pp={self.pp})")
+            if self.n_layer % (self.pp * self.pp_interleave):
+                raise ValueError(
+                    f"n_layer={self.n_layer} not divisible by "
+                    f"pp*pp_interleave={self.pp * self.pp_interleave} "
+                    f"(config knobs 'n_layer', 'pp', 'pp_interleave')")
+            if self.pp_microbatches % self.pp:
+                raise ValueError(
+                    f"pp_microbatches={self.pp_microbatches} not divisible "
+                    f"by pp={self.pp} — the interleaved schedule streams "
+                    f"microbatches in groups of pp (config knob "
+                    f"'pp_microbatches')")
         if self.tp > 1:
             from ..parallel import tp as tplib
             assert self.mesh.shape.get(tplib.MODEL_AXIS) == self.tp, (
@@ -358,8 +381,15 @@ class TransformerLM(ModelBase):
              "ln_f": self.ln_f.init(ks[2]), "head": self.head.init(ks[3])}
         if self.pp > 1:
             # stack the per-layer params [n_layer, ...] from the SAME keys
-            # the dense layout would use — pp=k and pp=1 are the same model
-            p["blocks"] = jax.vmap(self.blocks[0].init)(ks[4:])
+            # the dense layout would use — pp=k and pp=1 are the same model.
+            # The stack order is the interleaved stage permutation (identity
+            # at pp_interleave=1): device r's contiguous 'pipe' shard rows
+            # ARE its v virtual chunks, so pipeline_apply slices chunks
+            # without any runtime gather
+            from ..parallel import pipeline as pl
+            perm = pl.stage_permutation(self.n_layer, self.pp,
+                                        self.pp_interleave)
+            p["blocks"] = jax.vmap(self.blocks[0].init)(ks[4:][perm])
             return p
         for i, blk in enumerate(self.blocks):
             p[blk.name] = blk.init(ks[4 + i])
@@ -401,7 +431,8 @@ class TransformerLM(ModelBase):
                 return hh
 
             hm = pl.microbatch(h, self.pp_microbatches)
-            hm = pl.pipeline_apply(stage_fn, params["blocks"], hm)
+            hm = pl.pipeline_apply(stage_fn, params["blocks"], hm,
+                                   interleave=self.pp_interleave)
             h = pl.unmicrobatch(hm)
         else:
             remat = train and self.config.get("remat", False)
@@ -542,7 +573,8 @@ class TransformerLM(ModelBase):
             from ..parallel.mesh import worker_mesh
             cfg = {k: v for k, v in self.config.items()
                    if k not in ("mesh", "tp", "pp", "sp", "size", "rank",
-                                "pp_microbatches", "data_dir")}
+                                "pp_microbatches", "pp_interleave",
+                                "data_dir")}
             # the sampler never touches the twin's data object — keep its
             # synthetic stream (and memory) minimal instead of re-opening
             # the corpus or materializing the full synthetic arrays
@@ -563,8 +595,15 @@ class TransformerLM(ModelBase):
         # ARE self.params by reference — popping would corrupt the model
         params = dict(params)
         stacked = params.pop("blocks")
+        # stacked row j holds depth-order layer perm[j] (interleaved layout;
+        # identity at pp_interleave=1) — unstack through the inverse map
+        from ..parallel import pipeline as pl
+        perm = pl.stage_permutation(self.n_layer, self.pp,
+                                    self.pp_interleave)
+        inv = np.argsort(perm)
         for i in range(self.n_layer):
-            params[f"block{i}"] = jax.tree.map(lambda x: x[i], stacked)
+            j = int(inv[i])
+            params[f"block{i}"] = jax.tree.map(lambda x: x[j], stacked)
         return params
 
     def _next_token(self, row, key, temp):
@@ -692,7 +731,8 @@ class MoETransformerLM(TransformerLM):
 
             hm = pl.microbatch(h, self.pp_microbatches)
             hm, aux_sum = pl.pipeline_apply(stage_fn, params["blocks"], hm,
-                                            with_aux=True)
+                                            with_aux=True,
+                                            interleave=self.pp_interleave)
             h = pl.unmicrobatch(hm)
             # KNOWN DEVIATION from the dense layout: this is the mean of
             # per-MICROBATCH load-balance losses, not the aux of the full

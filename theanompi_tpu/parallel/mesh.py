@@ -81,7 +81,12 @@ def worker_mesh(
     ``parallel/pipeline.py``); ``tp`` and ``pp`` COMPOSE on a 3-D
     ``(workers, pipe, model)`` mesh — 'pipe' outer (one activation shift per
     stage per microbatch), 'model' inner (per-layer psums, the most frequent
-    collective, ride adjacent chips).  ``sp > 1`` adds a ``'seq'`` axis
+    collective, ride adjacent chips).  Interleaved virtual stages
+    (``pp_interleave``, round 10) are a SCHEDULE property, not a mesh
+    one: each of the ``pp`` devices on 'pipe' holds ``v`` non-contiguous
+    layer chunks and walks the interleaved schedule table, so the mesh
+    stays exactly this shape for every ``v`` — only the hop pattern
+    changes (full ring instead of the fill/drain partial shift).  ``sp > 1`` adds a ``'seq'`` axis
     (sequence blocks, ``parallel/sp.py``); EVERY tp/pp/sp combination
     composes (round-4), up to the full ``(workers, pipe, model, seq)``
     stack — 'seq' innermost so ring-attention ppermutes (once per block

@@ -1,83 +1,287 @@
-"""Pipeline parallelism: GPipe-style microbatch pipelining over a ``'pipe'``
-mesh axis.
+"""Pipeline parallelism: GPipe-style fill/drain AND interleaved
+virtual-stage microbatch pipelining over a ``'pipe'`` mesh axis.
 
 Beyond-parity capability (the reference — Theano-MPI, SURVEY.md §1 — is pure
 data parallelism): the transformer's homogeneous block stack is SHARDED over
-pipeline stages — each chip holds ``L/pp`` consecutive layers — and
-microbatches stream through the stages with one ``ppermute`` hop per tick.
+pipeline stages and microbatches stream through the stages with one
+``ppermute`` hop per tick.
 
 TPU-first shape: everything is ONE compiled SPMD program.  A ``lax.scan``
-runs ``M + pp − 1`` ticks (M microbatches, pp stages); each tick every stage
-applies its local layers to either the freshly injected microbatch (stage 0)
-or the activation received from its predecessor, then shifts its output one
-stage down the ring.  The bubble (stages idling for ``pp − 1`` ticks) is the
-textbook GPipe cost — amortized by choosing ``M ≫ pp``.  Collected outputs
-live on the last stage and are broadcast with a masked ``psum``.  Gradients
-need nothing special: autodiff transposes the scan + ``ppermute`` (reverse
-hops) and shard_map's varying-axes typing inserts the transpose-psums for
-stage-replicated parameters (embeddings/head), exactly as in
-``parallel/tp.py`` — pinned against the dense model in
-``tests/test_pipeline.py``.
+walks a STATICALLY-BUILT per-tick schedule table (:func:`build_schedule` —
+a pure function of ``(pp, v, M)``, so program shapes and AOT cache keys
+depend only on those ints); each tick every device applies ONE of its local
+layer chunks to either the freshly injected microbatch (global stage 0) or
+the activation received from its ring predecessor, then shifts its output
+one hop down the ring through the async-collective shims
+(``jax_compat.ppermute_start``/``ppermute_done`` — per schedule slot, so a
+jaxlib with a real async surface can overlap each hop with the next chunk's
+compute inside the same fused scan).
+
+**Fill/drain (``interleave=1``, the classic GPipe schedule).**  Each device
+holds ``L/pp`` consecutive layers; ``M + pp − 1`` ticks; bubble
+``(pp−1)/(M+pp−1)``, amortized by ``M ≫ pp``.
+
+**Interleaved virtual stages (``interleave=v > 1``, per the MPMD
+pipeline-parallelism paper — PAPERS.md, 2412.14374 — kept inside one SPMD
+program per the pjit/TPUv4 LM paper, 2204.06514).**  Each device holds ``v``
+NON-contiguous chunks of ``L/(pp·v)`` layers; chunk ``k`` of device ``r`` is
+global stage ``k·pp + r`` (:func:`stage_permutation` maps the stacked layer
+layout).  Microbatches stream in groups of ``pp``: group ``g``'s microbatch
+``m'`` meets stage ``s = k·pp + r`` exactly at tick
+``g·v·pp + k·pp + r + m'`` — consecutive stages are always one ring hop and
+one tick apart (the ``pp−1 → 0`` wrap lands exactly where stage ``k·pp``
+continues on device 0), so a single activation slot per device suffices, no
+buffering.  ``v·M + pp − 1`` ticks of ``1/v``-sized chunks: warm-up shrinks
+from ``pp−1`` to ``(pp−1)/v`` full-stage units and the bubble drops to
+``(pp−1)/(v·M + pp−1)``.  ``v=1`` degenerates to the fill/drain schedule
+EXACTLY (same table values, same partial-shift hop — bit-for-bit outputs,
+pinned in ``tests/test_pipeline.py``).
+
+**Bubble gating.**  Warm-up/drain ticks carry no real microbatch; the tick
+body branches on the schedule's ``real`` mask with ``lax.cond`` so idle
+devices genuinely idle (HLO conditional — the skipped chunk is never
+computed) instead of burning the tick on masked garbage.  This is what
+makes the schedule's bubble OBSERVABLE: devprof's ``bubble_fraction``
+column reads fill/drain gaps straight off the trace, and the interleaved
+schedule's smaller bubble is a measured win, not a modeled one
+(``scripts/predict_scaling.py`` carries the matching analytic model).
+
+Collected outputs live on the last global stage and are broadcast with a
+masked ``psum``.  Gradients need nothing special: autodiff transposes the
+scan + ``ppermute`` (reverse hops) + ``cond`` (same mask) + the chunk
+``dynamic_slice`` (scatter-add into the stack), and shard_map's
+varying-axes typing inserts the transpose-psums for stage-replicated
+parameters, exactly as in ``parallel/tp.py`` — pinned against the dense
+model in ``tests/test_pipeline.py``.
 """
 
 from __future__ import annotations
 
-from typing import Callable
+from typing import Callable, NamedTuple, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 
+from .. import jax_compat as jc
 from .mesh import PIPE_AXIS
 from .steps import _vary as _pvary
 
 
+class Schedule(NamedTuple):
+    """The per-tick schedule table — a pure function of ``(pp, v, m)``
+    (:func:`build_schedule`), host-side numpy, scanned as ``xs``.
+
+    Per-tick/per-device columns (``[T, pp]``): ``chunk`` (which local layer
+    chunk device ``r`` runs), ``real`` (does it carry a real microbatch),
+    ``micro`` (which one — clipped to a valid id on idle ticks).  Per-tick
+    columns (``[T]``): ``inject_idx``/``inject`` (global stage 0's
+    microbatch feed), ``collect_idx``/``collect`` (the last global stage's
+    output slot).  ``perm`` is the static ppermute hop: the partial shift
+    for ``v=1`` (today's schedule, bit-for-bit), the full ring for ``v>1``
+    (the ``pp−1 → 0`` wrap carries chunk ``k``'s output to chunk ``k+1``)."""
+
+    pp: int
+    v: int
+    m: int
+    ticks: int
+    chunk: np.ndarray
+    real: np.ndarray
+    micro: np.ndarray
+    inject_idx: np.ndarray
+    inject: np.ndarray
+    collect_idx: np.ndarray
+    collect: np.ndarray
+    perm: Tuple[Tuple[int, int], ...]
+
+
+def build_schedule(pp: int, v: int, m: int) -> Schedule:
+    """Build the schedule table for ``pp`` devices × ``v`` virtual chunks ×
+    ``m`` microbatches.  Pure ``(pp, v, m) → numpy`` — no jax, no device
+    state — so two calls with equal ints are equal tables and the traced
+    program (and its AOT cache key) is shape-stable."""
+    pp, v, m = int(pp), int(v), int(m)
+    if pp < 1 or v < 1 or m < 1:
+        raise ValueError(f"build_schedule: pp={pp}, v={v}, m={m} must all "
+                         "be >= 1")
+    if v == 1:
+        # the classic fill/drain table — EXACTLY today's closed forms
+        # (inject always on at rank 0, clipped indices), so v=1 is the
+        # current schedule bit-for-bit
+        ticks = m + pp - 1
+        t = np.arange(ticks)
+        u = t[:, None] - np.arange(pp)[None, :]          # microbatch t-rank
+        real = (u >= 0) & (u < m)
+        chunk = np.zeros((ticks, pp), np.int32)
+        micro = np.clip(u, 0, m - 1).astype(np.int32)
+        inject_idx = np.clip(t, 0, m - 1).astype(np.int32)
+        inject = np.ones(ticks, bool)
+        collect_idx = np.clip(t - (pp - 1), 0, m - 1).astype(np.int32)
+        collect = t >= pp - 1
+        perm = tuple((i, i + 1) for i in range(pp - 1))
+    else:
+        if m % pp:
+            raise ValueError(
+                f"build_schedule: interleaved collect needs the microbatch "
+                f"count divisible by pp — n_micro={m} % pp={pp} != 0 "
+                f"(raise/align the 'pp_microbatches' config knob)")
+        groups = m // pp
+        span = v * pp                 # ticks one microbatch group occupies
+        ticks = groups * span + pp - 1
+        u = np.arange(ticks)[:, None] - np.arange(pp)[None, :]
+        real = (u >= 0) & (u < groups * span)
+        q = np.mod(u, span)
+        chunk = np.where(real, q // pp, 0).astype(np.int32)
+        micro = np.where(real, (u // span) * pp + np.mod(u, pp), 0)
+        micro = np.clip(micro, 0, m - 1).astype(np.int32)
+        # global stage 0 = device 0 chunk 0; stage v·pp−1 = last device's
+        # last chunk.  The full-ring wrap from the last device re-enters
+        # device 0 as its next chunk's input — the inject mask replaces it
+        # only on chunk-0 ticks, which is precisely when the wrapped value
+        # is a finished (already-collected) output.
+        inject = real[:, 0] & (q[:, 0] < pp)
+        inject_idx = np.where(inject, micro[:, 0], 0).astype(np.int32)
+        collect = real[:, -1] & (q[:, -1] // pp == v - 1)
+        collect_idx = np.where(collect, micro[:, -1], 0).astype(np.int32)
+        perm = tuple((i, (i + 1) % pp) for i in range(pp))
+    return Schedule(pp, v, m, ticks, chunk, real, micro, inject_idx, inject,
+                    collect_idx, collect, perm)
+
+
+def stage_permutation(n_layer: int, pp: int, v: int) -> np.ndarray:
+    """Stacked-row → global-layer map for the interleaved layout.
+
+    The stacked ``blocks`` leaves stay ``'pipe'``-sharded on their leading
+    layer dim, so device ``r`` owns stacked rows ``[r·L/pp, (r+1)·L/pp)``;
+    for those rows to BE its ``v`` virtual chunks (chunk ``k`` = global
+    stage ``k·pp + r`` = depth-order layers ``[(k·pp+r)·c, (k·pp+r+1)·c)``,
+    ``c = L/(pp·v)``), the stack stores layers in device-major/chunk-minor
+    order: ``perm[j]`` is the depth-order layer held at stacked row ``j``.
+    Identity when ``v == 1`` — the interleaved layout degenerates to the
+    contiguous one."""
+    n_layer, pp, v = int(n_layer), int(pp), int(v)
+    if n_layer % (pp * v):
+        raise ValueError(
+            f"stage_permutation: n_layer={n_layer} not divisible by "
+            f"pp*v={pp * v} (config knobs 'n_layer', 'pp', 'pp_interleave')")
+    c = n_layer // (pp * v)
+    return np.asarray([(k * pp + r) * c + i
+                       for r in range(pp) for k in range(v)
+                       for i in range(c)], dtype=np.int64)
+
+
+def _validate(pp: int, v: int, m: int, local_layers: int) -> None:
+    """Loud trace-time errors for degenerate schedules — each message names
+    the config knob that fixes it (a silently-clipped schedule trains on
+    garbage masks)."""
+    if m < pp:
+        raise ValueError(
+            f"pipeline_apply: n_micro={m} < pp={pp} — the schedule is all "
+            f"warm-up/drain bubble and some stages never see a real "
+            f"microbatch; raise the 'pp_microbatches' config knob to at "
+            f"least pp (default 2*pp)")
+    if v > 1:
+        if m % pp:
+            raise ValueError(
+                f"pipeline_apply: interleaved collect streams microbatches "
+                f"in groups of pp — n_micro={m} is not divisible by "
+                f"pp={pp}; align the 'pp_microbatches' config knob")
+        if local_layers % v:
+            raise ValueError(
+                f"pipeline_apply: {local_layers} local layers do not split "
+                f"into pp_interleave={v} chunks — n_layer must be "
+                f"divisible by pp*pp_interleave (config knobs 'n_layer', "
+                f"'pp_interleave')")
+
+
 def pipeline_apply(stage_fn: Callable, stage_params, x_micro,
                    axis: str = PIPE_AXIS, remat: bool = True,
-                   with_aux: bool = False):
+                   with_aux: bool = False, interleave: int = 1):
     """Stream microbatches through pipeline stages (inside ``shard_map``).
 
-    ``stage_fn(stage_params, x) -> y`` applies THIS stage's local layers to
-    one microbatch (same shape in and out — transformer blocks).
+    ``stage_fn(stage_params, x) -> y`` applies a contiguous run of local
+    layers to one microbatch (same shape in and out — transformer blocks).
     ``stage_params``: pytree whose leaves carry a leading LOCAL layer dim
-    (the ``'pipe'``-sharded slice of the stacked layer stack).
+    (the ``'pipe'``-sharded slice of the stacked layer stack — for
+    ``interleave > 1`` in :func:`stage_permutation` order, so local rows
+    ``[k·c, (k+1)·c)`` are virtual chunk ``k``).
     ``x_micro``: ``[M, mb, ...]`` microbatches, replicated over ``axis``.
     Returns ``[M, mb, ...]`` outputs, replicated over ``axis``.
 
-    ``remat``: rematerialize each stage application on the backward pass —
+    ``remat``: rematerialize each chunk application on the backward pass —
     the standard GPipe memory trade (activations for the whole scan would
     otherwise be saved per tick).
 
     ``with_aux``: ``stage_fn`` returns ``(y, aux_scalar)`` (MoE stacks ride
     their load-balance loss through the pipeline); the return becomes
-    ``(outputs, aux_total)`` where ``aux_total`` sums every stage's aux over
-    the REAL microbatch ticks only — warm-up/drain bubble ticks process
-    zeros/garbage and are masked out — then ``psum``s over the stages.
-    """
-    pp = lax.psum(1, axis)
+    ``(outputs, aux_total)`` where ``aux_total`` sums every stage's aux
+    over the REAL schedule slots only — warm-up/drain bubble ticks are
+    cond-gated out entirely — then ``psum``s over the stages.
+
+    ``interleave``: virtual chunks per device (``v``); see the module
+    docstring.  ``interleave=1`` is today's fill/drain schedule
+    bit-for-bit."""
+    pp = lax.psum(1, axis)          # static: psum of a literal = axis size
     rank = lax.axis_index(axis)
     m = x_micro.shape[0]
-    raw = stage_fn if with_aux \
-        else (lambda p, x: (stage_fn(p, x), jnp.zeros((), jnp.float32)))
+    v = int(interleave)
+    if pp == 1:
+        v = 1                       # one device: no ring, no chunks to split
+    local_layers = int(jax.tree.leaves(stage_params)[0].shape[0])
+    _validate(pp, v, m, local_layers)
+    chunk_layers = local_layers // v
+
+    def raw(p, x):
+        if with_aux:
+            return stage_fn(p, x)
+        # zero scalar derived from ONE element of x so BOTH lax.cond
+        # branches below return an aux with x's full set of varying mesh
+        # axes (a fresh jnp.zeros(()) would be device-invariant and
+        # mismatch the skip branch's type)
+        return stage_fn(p, x), x.reshape(-1)[0].astype(jnp.float32) * 0
+
     fn = jax.checkpoint(raw) if remat else raw
 
-    shift = [(i, i + 1) for i in range(pp - 1)] if pp > 1 else []
+    sched = build_schedule(pp, v, m)
+    last = pp - 1
 
-    def tick(carry, t):
+    def tick(carry, xs):
         state, outputs, aux_acc = carry
-        inject = jnp.take(x_micro, jnp.clip(t, 0, m - 1), axis=0)
-        inp = jnp.where(rank == 0, inject, state)
-        out, aux = fn(stage_params, inp)
-        # this stage processed microbatch t-rank this tick iff in [0, M)
-        real = (t >= rank) & (t - rank < m)
+        (inj_idx, inj, chunk_row, real_row, col_idx, col) = xs
+        inject = jnp.take(x_micro, inj_idx, axis=0)
+        inp = jnp.where((rank == 0) & inj, inject, state)
+        if v == 1:
+            params_k = stage_params
+        else:
+            k = jnp.take(chunk_row, rank)
+            params_k = jax.tree.map(
+                lambda l: lax.dynamic_slice_in_dim(
+                    l, k * chunk_layers, chunk_layers, axis=0),
+                stage_params)
+        real = jnp.take(real_row, rank)
+        # bubble gating: idle slots skip the chunk entirely (HLO
+        # conditional) — fill/drain gaps are real device idle on the
+        # trace, and the ring just carries the slot's input through
+        out, aux = lax.cond(
+            real,
+            lambda px: fn(*px),
+            lambda px: (px[1], px[1].reshape(-1)[0].astype(jnp.float32) * 0),
+            (params_k, inp))
         aux_acc = aux_acc + jnp.where(real, aux, 0.0)
-        # the last stage finished microbatch t-(pp-1) this tick
-        j = jnp.clip(t - (pp - 1), 0, m - 1)
-        collect = (rank == pp - 1) & (t >= pp - 1)
-        cur = jnp.take(outputs, j, axis=0)
+        # the last device's last chunk is the final global stage
+        collect = (rank == last) & col
+        cur = jnp.take(outputs, col_idx, axis=0)
         outputs = lax.dynamic_update_index_in_dim(
-            outputs, jnp.where(collect, out, cur), j, axis=0)
-        state = lax.ppermute(out, axis, shift) if shift else out
+            outputs, jnp.where(collect, out, cur), col_idx, axis=0)
+        if sched.perm:
+            # one hop per schedule slot through the async shims: a jaxlib
+            # with a real async surface overlaps the hop with the next
+            # chunk's compute; the sync fallback is today's ppermute
+            ticket = jc.ppermute_start(out, axis, list(sched.perm))
+            state = jc.ppermute_done(ticket)
+        else:
+            state = out
         return (state, outputs, aux_acc), None
 
     state0 = _pvary(jnp.zeros_like(x_micro[0]), axis)
@@ -86,8 +290,10 @@ def pipeline_apply(stage_fn: Callable, stage_params, x_micro,
     # x_micro's full set of varying mesh axes (e.g. 'workers') on top of the
     # pipe axis, without a full-tensor reduce
     aux0 = _pvary(x_micro.reshape(-1)[0].astype(jnp.float32) * 0, axis)
-    ticks = _pvary(jnp.arange(m + pp - 1), axis)
-    (_, outputs, aux_acc), _ = lax.scan(tick, (state0, out0, aux0), ticks)
+    xs = tuple(_pvary(jnp.asarray(a), axis) for a in
+               (sched.inject_idx, sched.inject, sched.chunk, sched.real,
+                sched.collect_idx, sched.collect))
+    (_, outputs, aux_acc), _ = lax.scan(tick, (state0, out0, aux0), xs)
     # only the last stage wrote non-zero outputs — masked psum broadcasts
     outputs = lax.psum(outputs, axis)
     if with_aux:

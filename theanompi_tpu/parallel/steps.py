@@ -325,6 +325,19 @@ def build_train_step(mesh: Mesh, model, exchanger, n_steps: int = 1) -> Callable
     between-steps Python hook is skipped (``exchanger.fused``); BSP grads
     mode has no post-step hook to begin with.  ``count`` is the index of
     the LAST step in the call.
+
+    Pipelined models compose for free (round 10, ISSUE 16): the model's
+    loss calls ``pipeline_apply`` whose whole schedule — fill/drain or
+    interleaved virtual stages (``pp_interleave``), ``v·M + pp − 1``
+    ticks of chunk compute, per-slot ``ppermute_start/done`` hops,
+    inject/collect masks — is ONE inner ``lax.scan`` inside the loss.
+    Under ``n_steps > 1`` that scan nests inside this function's step
+    scan, so the host still dispatches once per k-step window even with
+    pipelining on: a whole pipeline round (forward schedule + its scan
+    transpose) per scanned step, zero host round-trips between ticks.
+    The schedule table is static (a pure function of ``(pp, v, M)``
+    baked at trace time), so fusing changes no cache key beyond the
+    ``pp_interleave`` extra ``utils/compile_cache.key_extra`` stamps.
     """
     axis = WORKER_AXIS
     n = mesh.shape[axis]

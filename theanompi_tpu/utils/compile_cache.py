@@ -205,6 +205,14 @@ def key_extra(fn: str, model=None, exchanger=None,
     if model is not None:
         extra["model"] = type(model).__name__
         extra["n_subb"] = int(getattr(model, "n_subb", 1))
+        v = int(getattr(model, "pp_interleave", 1) or 1)
+        if v > 1:
+            # the interleaved pipeline schedule reshapes the whole scan
+            # (chunked layers, ring hops, v·M+pp−1 ticks) — interleaved and
+            # fill/drain builds of the same row must never share an entry.
+            # Stamped only when v > 1 so every pre-existing key (and every
+            # prewarmed fill/drain entry) stays byte-stable.
+            extra["pp_interleave"] = v
     if spc is not None:
         extra["spc"] = int(spc)
     if exchanger is not None:

@@ -118,6 +118,18 @@ BUCKET_ROW_COLUMNS = (
     "n_buckets",
 )
 
+# The bench-row columns pipelined rows (pp > 1 in BENCH_CFG) add — the
+# :func:`pipeline_schedule_report` measurement: the tick-count bubble read
+# off the hop events (exact when the capture verifies), the wall-time
+# weighted bubble, and the verification bit itself.  Same jax-free schema
+# -home discipline as BUCKET_ROW_COLUMNS; disjointness from the other two
+# vocabularies is pinned in tests/test_pipeline_schedule.py.
+PIPELINE_ROW_COLUMNS = (
+    "pipeline_bubble_ticks",
+    "pipeline_bubble_time",
+    "pipeline_schedule_verified",
+)
+
 # HLO opcodes whose device time is collective/communication time.  Async
 # pairs (`<op>-start` / `<op>-done`) share the prefix and match too.
 COMM_OP_PREFIXES = (
@@ -402,15 +414,13 @@ def attribute(events: Iterable[dict]) -> Dict[str, Any]:
     }
 
 
-def profile_dir(trace_dir: str) -> Optional[Dict[str, Any]]:
-    """Parse the newest capture session under ``trace_dir`` into one
-    attribution dict (events merged across per-host files).  None when no
-    capture is found."""
-    paths = find_trace_files(trace_dir)
-    if not paths:
-        return None
+def load_dir_events(trace_dir: str) -> List[dict]:
+    """Raw trace events of the newest capture session under ``trace_dir``,
+    merged across per-host files and ``_src``-tagged per file (the lane
+    disambiguator ``attribute()``/``schedule_occupancy()`` expect).  Empty
+    when no capture is found."""
     events: List[dict] = []
-    for src, p in enumerate(paths):
+    for src, p in enumerate(find_trace_files(trace_dir)):
         try:
             file_events = load_trace_events(p)
         except (OSError, ValueError):
@@ -418,11 +428,257 @@ def profile_dir(trace_dir: str) -> Optional[Dict[str, Any]]:
         for ev in file_events:
             ev["_src"] = src  # lane disambiguator (see attribute())
         events.extend(file_events)
+    return events
+
+
+def profile_dir(trace_dir: str) -> Optional[Dict[str, Any]]:
+    """Parse the newest capture session under ``trace_dir`` into one
+    attribution dict (events merged across per-host files).  None when no
+    capture is found."""
+    paths = find_trace_files(trace_dir)
+    events = load_dir_events(trace_dir)
     if not events:
         return None
     prof = attribute(events)
     prof["trace_files"] = [os.path.basename(p) for p in paths]
     return prof
+
+
+# -- schedule occupancy ------------------------------------------------------
+
+
+def schedule_occupancy(events: Iterable[dict], min_gap_us: float = 1.0,
+                       strip_width: int = 96) -> Dict[str, Any]:
+    """Per-lane schedule occupancy: classify each compute lane's dispatch
+    window into compute / hop / other-comm / idle time, per schedule slot.
+
+    The pipeline scan runs one chunk of layers per tick, so a lane's
+    merged compute intervals ARE its schedule slots — their count
+    estimates the tick count, and the gaps between them are the
+    schedule's bubble (warm-up/drain ticks a device spends cond-gated
+    out, plus exposed hop waits).  ``hop`` time is ``collective-permute``
+    device time (the stage-boundary activation shift); other collectives
+    (psums etc.) classify as ``comm``.  Each lane also gets a ``strip``:
+    ``strip_width`` equal time bins over the lane span, each rendered as
+    the class owning the most time in the bin (``C`` compute, ``H`` hop,
+    ``c`` other comm, ``·`` idle) — a schedule regression is a SHAPE you
+    can read, not just a worse scalar.
+
+    Gaps shorter than ``min_gap_us`` merge into the neighboring busy time
+    (sub-microsecond runtime jitter is not schedule structure)."""
+    comp_iv: Dict[Tuple, List[Tuple[float, float]]] = {}
+    hop_iv: Dict[Tuple, List[Tuple[float, float]]] = {}
+    comm_iv: Dict[Tuple, List[Tuple[float, float]]] = {}
+    for ev in events:
+        if ev.get("ph") != "X":
+            continue
+        args = ev.get("args")
+        if not isinstance(args, dict) or "hlo_op" not in args:
+            continue
+        try:
+            ts = float(ev.get("ts", 0.0))
+            dur = float(ev.get("dur", 0.0))
+        except (TypeError, ValueError):
+            continue
+        if dur < 0:
+            continue
+        lane = (ev.get("_src"), ev.get("pid"), ev.get("tid"))
+        cls = op_class(ev.get("name", ""))
+        if cls.startswith("collective-permute"):
+            hop_iv.setdefault(lane, []).append((ts, ts + dur))
+        elif is_comm_op(cls):
+            comm_iv.setdefault(lane, []).append((ts, ts + dur))
+        else:
+            comp_iv.setdefault(lane, []).append((ts, ts + dur))
+
+    def _clip(ivs, lo, hi):
+        return [(max(s, lo), min(e, hi)) for s, e in ivs
+                if min(e, hi) > max(s, lo)]
+
+    def _measure_in(ivs, lo, hi):
+        return _measure(_clip(ivs, lo, hi))
+
+    lanes = []
+    for lane in sorted(comp_iv, key=str):
+        cu = _union(comp_iv[lane])
+        # merge sub-min_gap_us jitter between compute slots
+        merged: List[Tuple[float, float]] = []
+        for s, e in cu:
+            if merged and s - merged[-1][1] <= min_gap_us:
+                merged[-1] = (merged[-1][0], e)
+            else:
+                merged.append((s, e))
+        lo, hi = merged[0][0], merged[-1][1]
+        span = hi - lo
+        if span <= 0:
+            continue
+        hu = _union(hop_iv.get(lane, []))
+        mu = _union(comm_iv.get(lane, []))
+        comp_us = _measure(merged)
+        # busy precedence compute > hop > comm: overlapped (hidden) hop
+        # time is not a stall, so it must not double-count against idle
+        hop_us = _measure_in(hu, lo, hi)
+        comm_us = _measure_in(mu, lo, hi)
+        busy = _union(_clip(merged + hu + mu, lo, hi))
+        idle_us = span - _measure(busy)
+        strip_chars = []
+        for b in range(strip_width):
+            blo = lo + span * b / strip_width
+            bhi = lo + span * (b + 1) / strip_width
+            shares = (("C", _measure_in(merged, blo, bhi)),
+                      ("H", _measure_in(hu, blo, bhi)),
+                      ("c", _measure_in(mu, blo, bhi)))
+            best, best_us = "·", 0.0
+            covered = 0.0
+            for ch, us in shares:
+                covered += us
+                if us > best_us:
+                    best, best_us = ch, us
+            if (bhi - blo) - covered > best_us:
+                best = "·"
+            strip_chars.append(best)
+        lanes.append({
+            "lane": f"{lane[0]}:{lane[1]}/{lane[2]}",
+            "span_secs": round(span / 1e6, 6),
+            "compute_secs": round(comp_us / 1e6, 6),
+            "hop_secs": round(hop_us / 1e6, 6),
+            "comm_secs": round(comm_us / 1e6, 6),
+            "idle_secs": round(idle_us / 1e6, 6),
+            "bubble_fraction": round(idle_us / span, 4),
+            "n_slots": len(merged),
+            "strip": "".join(strip_chars),
+        })
+    spans = sum(l["span_secs"] for l in lanes)
+    idles = sum(l["idle_secs"] for l in lanes)
+    return {
+        "lanes": lanes,
+        "n_lanes": len(lanes),
+        # span-weighted like attribute()'s bubble_fraction, but gap-merged
+        # at min_gap_us — the schedule-structure view of the same metric
+        "bubble_fraction": round(idles / spans, 4) if spans > 0 else None,
+    }
+
+
+def _schedule_busy_counts(pp: int, v: int, m: int) -> List[int]:
+    """Busy-device count per pipeline tick — the ``real`` column sums of
+    ``parallel.pipeline.build_schedule`` (device ``r`` is busy at tick ``t``
+    iff ``0 <= t - r < v·m``), replicated here in pure python so this
+    module stays stdlib-only (pinned equal to the jax-side table in
+    ``tests/test_pipeline_schedule.py``)."""
+    pp, v, m = int(pp), int(v), int(m)
+    total = v * m
+    ticks = total + pp - 1
+    return [sum(1 for r in range(pp) if 0 <= t - r < total)
+            for t in range(ticks)]
+
+
+def pipeline_schedule_report(events: Iterable[dict], pp: int, v: int,
+                             m: int, passes: int = 2) -> Dict[str, Any]:
+    """Measured pipeline-bubble report from a trace capture.
+
+    CPU device lanes are a shared thread pool (one Eigen pool serves every
+    simulated device), so per-lane gaps cannot read the SPMD schedule —
+    but the schedule's tick structure survives in the ``collective-permute``
+    events: every tick each of the ``pp`` devices hops once, so sorted hop
+    timestamps group into ticks by COUNT (exactly ``pp`` per tick,
+    ``T = v·m+pp−1`` ticks per pass).  A traced train step contains a
+    whole number of passes over the schedule — forward plus its scan
+    transpose, with XLA free to add replay passes (remat/recompute); the
+    per-tick idle sequence is a PALINDROME (ramp-up ``pp−1``, plateau,
+    ramp-down), so every consecutive block of ``T`` groups weights
+    identically whichever direction it ran — the report never needs to
+    know the pass structure.  Each group's start-to-start gap is that
+    tick's measured wall time; the schedule table says how many devices
+    idle that tick.  Returns:
+
+    - ``schedule_verified``: the hop-event count divides exactly into
+      whole ``T·pp`` passes — the compiled program demonstrably runs the
+      expected tick count (``v·m+pp−1``, not ``m+pp−1``).
+    - ``bubble_fraction``: duration-weighted measured bubble
+      ``Σ idle_frac(tick)·dur(tick) / Σ dur(tick)`` — what the schedule's
+      idle actually costs in wall time.
+    - ``bubble_fraction_ticks``: the analytic ``1 − v·m/T`` over the
+      VERIFIED tick structure (``passes`` — expected passes per train
+      step, forward + transpose — only scales ``steps_detected``).
+    """
+    pp, v, m = int(pp), int(v), int(m)
+    hops: List[Tuple[float, float]] = []
+    for ev in events:
+        if ev.get("ph") != "X":
+            continue
+        args = ev.get("args")
+        if not isinstance(args, dict) or "hlo_op" not in args:
+            continue
+        cls = op_class(ev.get("name", ""))
+        if not cls.startswith("collective-permute"):
+            continue
+        if cls.endswith("-done"):
+            # async lowering emits start/done PAIRS per hop; count one
+            # event per hop whichever form the backend lowered to
+            continue
+        try:
+            ts = float(ev.get("ts", 0.0))
+            dur = float(ev.get("dur", 0.0))
+        except (TypeError, ValueError):
+            continue
+        hops.append((ts, ts + dur))
+    hops.sort()
+    busy = _schedule_busy_counts(pp, v, m)
+    ticks_pass = len(busy)
+    n_groups = len(hops) // pp
+    report: Dict[str, Any] = {
+        "pp": pp, "v": v, "m": m, "passes": passes,
+        "n_hop_events": len(hops),
+        "ticks_per_pass": ticks_pass,
+        "measured_ticks": n_groups,
+        "schedule_verified": bool(
+            hops and len(hops) % (ticks_pass * pp) == 0),
+    }
+    if not n_groups:
+        report.update(bubble_fraction=None, bubble_fraction_ticks=None,
+                      passes_detected=0, steps_detected=0)
+        return report
+    report["passes_detected"] = round(n_groups / ticks_pass, 3)
+    report["steps_detected"] = round(n_groups / ticks_pass / passes, 3)
+    # idle fraction per tick position within one pass — a palindrome, so
+    # the weighting is direction-agnostic and any replay passes XLA adds
+    # (remat recompute of the forward under grad) align the same way
+    idle_seq = [1.0 - b / pp for b in busy]
+    starts = [hops[g * pp][0] for g in range(n_groups)]
+    durs = [starts[g + 1] - starts[g] for g in range(n_groups - 1)]
+    med = sorted(durs)[len(durs) // 2] if durs else 0.0
+    durs.append(med)      # the capture's last tick has no successor
+    wsum = dsum = 0.0
+    for g, dur in enumerate(durs):
+        # clip inter-pass host/dispatch gaps (a "tick" spanning a step
+        # boundary) to the median so one gap can't swamp the weighting
+        dur = min(dur, 10 * med) if med > 0 else dur
+        wsum += idle_seq[g % ticks_pass] * dur
+        dsum += dur
+    report["bubble_fraction"] = round(wsum / dsum, 4) if dsum > 0 else None
+    report["bubble_fraction_ticks"] = round(
+        1.0 - (v * m) / ticks_pass, 4)
+    return report
+
+
+def format_schedule(occ: Dict[str, Any]) -> str:
+    """Human-readable per-lane occupancy report (the ``--schedule`` view of
+    ``scripts/profile_model.py``)."""
+    lines = ["per-lane schedule occupancy "
+             "(C compute · H hop · c comm · · idle):"]
+    for l in occ.get("lanes", []):
+        lines.append(
+            f"  {l['lane']:<16} slots={l['n_slots']:<4} "
+            f"span={l['span_secs'] * 1e3:8.2f}ms "
+            f"compute={l['compute_secs'] * 1e3:8.2f}ms "
+            f"hop={l['hop_secs'] * 1e3:7.2f}ms "
+            f"idle={l['idle_secs'] * 1e3:7.2f}ms "
+            f"bubble={l['bubble_fraction']:.4f}")
+        lines.append(f"    |{l['strip']}|")
+    bf = occ.get("bubble_fraction")
+    lines.append(f"  span-weighted bubble_fraction: "
+                 f"{bf if bf is not None else 'n/a'}")
+    return "\n".join(lines)
 
 
 # -- programmatic capture ---------------------------------------------------
