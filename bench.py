@@ -27,6 +27,10 @@ collective into ~N-byte async start/done buckets; the row JSON then
 carries ``bucket_bytes`` + ``n_buckets`` — vocabulary pinned as
 ``devprof.BUCKET_ROW_COLUMNS`` — and the ``-bucket<sz>`` label suffix
 keeps bucketed rows from serving as last_good for monolithic ones),
+``BENCH_USHARD`` (=1 enables leaf-wise update-plane sharding,
+``parallel/update_sharding.py``; rows carry the
+``devprof.USHARD_ROW_COLUMNS`` memory columns and the ``-ushard`` label
+token; ``BENCH_USHARD_REPORT=1`` adds the columns to control rows),
 ``BENCH_REAL_DATA`` (=1 drives the
 whole disk→augment→device pipeline; + ``BENCH_DATA_DIR``,
 ``BENCH_WIRE_U8``), ``BENCH_WINLOAD`` (=1, with BENCH_SPC>1: para_load
@@ -262,6 +266,23 @@ def _cfg_matches(cfg: str) -> bool:
     if (want_v is not None) != has_v:
         return False
     if want_v is not None and want_v not in parts:
+        return False
+    # explicit-worker-count rows (n_workers in BENCH_CFG; label token
+    # nN): a 2-worker mesh and a 4-worker mesh run different programs —
+    # neither is an honest fallback for the other (or for the
+    # full-device-count default rows)
+    nw = int(bcfg.get("n_workers", 0) or 0)
+    want_n = f"n{nw}" if nw else None
+    has_n = any(_re.fullmatch(r"n\d+", p) for p in parts)
+    if (want_n is not None) != has_n:
+        return False
+    if want_n is not None and want_n not in parts:
+        return False
+    # update-sharding rows (BENCH_USHARD=1, label token 'ushard'): the
+    # sharded update plane runs a different program (chunked opt state +
+    # allgather rebuild) — never an honest fallback for the replicated
+    # control row or vice versa
+    if ("ushard" in parts) != (os.environ.get("BENCH_USHARD") == "1"):
         return False
     return True
 
@@ -590,6 +611,11 @@ def bench_row_config(environ=None):
         # u8-wire staging: host ships uint8 crops, device casts+subtracts
         # (4× smaller host→device transfers — the real-data lever)
         config["aug_wire_u8"] = True
+    if env.get("BENCH_USHARD") == "1":
+        # leaf-wise update-plane sharding (parallel/update_sharding.py):
+        # optimizer moments + shardable exchanger state chunked over the
+        # data axis, one fused allgather rebuilds full params
+        config["update_sharding"] = True
     flags = {"real_data": env.get("BENCH_REAL_DATA") == "1",
              "winload": env.get("BENCH_WINLOAD") == "1",
              "prng": env.get("BENCH_PRNG", "rbg")}
@@ -965,11 +991,14 @@ def main() -> int:
                  "vs_baseline n/a for sequence models")
     bucket_b = int(config.get("bucket_bytes", 0) or 0)
     bucket_note = f", bucket={_bucket_label(bucket_b)}" if bucket_b else ""
+    ushard_note = (", ushard (sharded update plane)"
+                   if config.get("update_sharding") else "")
     out = {
         "metric": f"{kind}_per_sec_per_chip ({model_name} batch "
                   f"{model.batch_size} {rule.upper()}, {n_chips} chip(s), "
                   f"{jax.devices()[0].platform}, prng={prng or 'default'}"
                   f"{', spc=' + str(spc) if spc > 1 else ''}{bucket_note}"
+                  f"{ushard_note}"
                   f"{', real-data (disk->native augment->device)' if real_data else ''}"
                   f"{', winload (producer-staged spc windows)' if winload else ''}"
                   f"; {base_note})",
@@ -1005,6 +1034,19 @@ def main() -> int:
         except Exception as e:
             print(f"bench: n_buckets unavailable ({e!r})", file=sys.stderr)
             out["n_buckets"] = None
+    if (config.get("update_sharding") or config.get("zero_opt")
+            or os.environ.get("BENCH_USHARD_REPORT") == "1"):
+        # the update-plane memory columns (devprof.USHARD_ROW_COLUMNS):
+        # measured per-chip update-state bytes vs the replicated-equivalent
+        # baseline, so the headline ~N× shrink is read off the row itself.
+        # Control rows set BENCH_USHARD_REPORT=1 to carry the columns too
+        # (shrink ~1.0) so the matrix join never compares against absence.
+        from theanompi_tpu.utils import devprof
+        try:
+            out.update(devprof.update_state_report(model))
+        except Exception as e:
+            print(f"bench: update_state_report unavailable ({e!r})",
+                  file=sys.stderr)
     if trace_profile is not None:
         # trace-derived columns (utils/devprof, BENCH_TRACE=1): device
         # compute/comm/EXPOSED-comm time over the traced window and the
@@ -1078,7 +1120,7 @@ def _apply_flagship_defaults() -> None:
     shaping = ("BENCH_MODEL", "BENCH_RULE", "BENCH_BATCH", "BENCH_STRATEGY",
                "BENCH_CFG", "BENCH_SPC", "BENCH_SYNTH_BATCHES",
                "BENCH_BN_DTYPE", "BENCH_REAL_DATA", "BENCH_WIRE_U8",
-               "BENCH_WINLOAD", "BENCH_BUCKET_BYTES")
+               "BENCH_WINLOAD", "BENCH_BUCKET_BYTES", "BENCH_USHARD")
     if any(k in os.environ for k in shaping):
         return
     if os.environ.get("PALLAS_AXON_REMOTE_COMPILE") == "0":

@@ -1,0 +1,78 @@
+#!/usr/bin/env bash
+# Round-11 perf matrix — the update-plane-sharding round (ISSUE 17
+# tentpole): TransformerLM on pure data meshes at N∈{2,4}, replicated
+# control vs leaf-wise sharded update plane (BENCH_USHARD,
+# parallel/update_sharding.py).  Every row carries the update-plane
+# memory report (devprof.USHARD_ROW_COLUMNS: update_state_bytes_per_chip
+# / _replicated / update_state_shrink — controls via
+# BENCH_USHARD_REPORT=1, shrink ~1.0) so the headline per-chip ~N×
+# shrink is read row-vs-row at fixed model/batch/N:
+#   jq -r 'select(.result) | [.config, .result.update_state_bytes_per_chip,
+#          .result.update_state_shrink, .result.value] | @tsv'
+# and scripts/predict_scaling.py --json joins the measured column against
+# its replicated/N model per row (out["update_state_rows"]).
+#
+# Same discipline as perf_matrix_r10.sh (the PR 3 prewarm machinery):
+#   1. prewarm: every staged r11 row's program — the ushard rows' AOT
+#      keys carry the `ushard` stamp (utils/compile_cache.key_extra) —
+#      compiles into the executable store BEFORE the window.
+#   2. canary: the replicated n2 control must report `cache: hit`, or
+#      the pass aborts loudly instead of burning the window compiling.
+#   3. the scans: rows from scripts/rows.py --round r11 (the manifest
+#      prewarm consumed); rows already measured in the out-file skip.
+#   ./scripts/perf_matrix_r11.sh [out_file]
+set -u -o pipefail
+OUT="${1:-perf_matrix_r11.jsonl}"
+cd "$(dirname "$0")/.."
+. scripts/_bench_row.sh
+
+CACHE="${BENCH_COMPILE_CACHE:-/tmp/jax_bench_cache}"
+LM_CFG='{"d_model":256,"n_head":8,"n_layer":4,"seq_len":128,"vocab":8192,"synthetic_train":64,"n_workers":2}'
+
+# 1. prewarm (idempotent: cached rows skip in ~ms); live backend venue
+# first, topology venue fallback when the tunnel can't answer
+echo "== prewarm -> $CACHE" >&2
+timeout -s KILL 3000 python -u scripts/prewarm_cache.py --rows r11 \
+    --cache "$CACHE" --platform tpu >&2 \
+  || timeout -s KILL 3000 python -u scripts/prewarm_cache.py --rows r11 \
+    --cache "$CACHE" --platform topology:v5e:2x2x1 >&2 \
+  || echo "== prewarm failed (rows will compile on the clock)" >&2
+
+# 2. canary: the replicated n2 control program must hit the executable
+# cache — a miss means the key composition (n_workers mesh shaping, the
+# conditional `ushard` stamp in key_extra) drifted from what prewarm
+# stored
+echo "== canary: transformer_lm-b8-n2 must report cache: hit" >&2
+canary=$(env BENCH_SKIP_PROBE="${BENCH_SKIP_PROBE:-1}" \
+             BENCH_MODEL=transformer_lm BENCH_BATCH=8 \
+             BENCH_CFG="$LM_CFG" \
+             BENCH_USHARD_REPORT=1 \
+             BENCH_ITERS=5 \
+             BENCH_COMPILE_CACHE="$CACHE" python bench.py 2>>"${OUT%.jsonl}.err" | tail -1)
+echo "$canary" | python -c '
+import json, sys
+row = json.loads(sys.stdin.read())
+cache = row.get("cache")
+assert cache == "hit", (
+    f"canary row is cache: {cache!r}, not \"hit\" — the update-sharding "
+    f"program key does not match what prewarm stored (row: {row}); "
+    f"aborting before the staged rows burn the window on compiles")
+print("== canary hit (compile %ss)" % (row.get("compile_secs"),),
+      file=sys.stderr)
+' || exit 1
+echo "{\"config\": \"transformer_lm-b8-n2-canary\", \"result\": $canary}" >> "$OUT"
+
+# 3. the staged rows (replicated control + ushard, at N=2 and N=4)
+while read -r line; do
+  eval "run $line"
+done < <(python scripts/rows.py --round r11 --sh)
+
+python scripts/merge_matrix.py "$OUT"
+cat "$OUT"
+
+# 4. closing gate: fresh rows within BENCH_REGRESS_PCT (default 10%) of
+# each label's best fresh committed reading — the window self-judges
+python scripts/bench_regress.py "$OUT" \
+    --threshold "${BENCH_REGRESS_PCT:-10}" \
+    --json "${OUT%.jsonl}_regress.json" \
+  || { echo "== bench_regress: throughput regression gate FAILED" >&2; exit 7; }

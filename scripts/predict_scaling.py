@@ -166,6 +166,27 @@ PIPELINE_CONFIGS = [
 ]
 
 
+def update_state_bytes_per_chip(replicated_bytes: float, n: int) -> float:
+    """Update-plane-sharding memory model (round 11, ISSUE 17): the
+    leaf-wise wrapper (``parallel/update_sharding.py``) chunks every
+    planned leaf to ``ceil(L/N)`` elements per chip, so per-chip
+    update-state bytes are ``replicated/N`` to first order.  The measured
+    ``update_state_bytes_per_chip`` (devprof.USHARD_ROW_COLUMNS) sits
+    slightly ABOVE this: ceil rounding pads each ragged leaf by at most
+    ``N−1`` elements, and sub-threshold leaves (< ``ushard_min_bytes`` or
+    < N elements) stay fully replicated per chip."""
+    return replicated_bytes / n
+
+
+# staged r11 update-sharding rows (scripts/rows.py): sharded row joined
+# against its replicated control (which carries the same report columns
+# via BENCH_USHARD_REPORT=1) -> (ushard label, control label, N)
+USHARD_CONFIGS = [
+    ("transformer_lm-b8-n2-ushard", "transformer_lm-b8-n2", 2),
+    ("transformer_lm-b8-n4-ushard", "transformer_lm-b8-n4", 4),
+]
+
+
 # staged configs (BASELINE.json) -> (matrix row, strategy model, params key)
 CONFIGS = [
     ("alexnet-b128",      "allreduce", 4, "alexnet", 128),
@@ -392,6 +413,46 @@ def main() -> int:
             print(f"{label:34} {pred['bubble_fraction']:>11.4f} "
                   f"{'--':>10}  (no measured r10 row yet)", file=sys.stderr)
         out["pipeline_rows"].append(prow)
+    # update-plane-sharding rows (round 11): predicted per-chip update
+    # -state bytes (replicated/N, model above) vs the measured devprof
+    # columns of the r11 matrix rows — the control row prices the
+    # replicated baseline, the ushard row the sharded layout
+    out["update_state_rows"] = []
+    print(f"\n{'update-sharding row':30} {'pred B/chip':>11} "
+          f"{'meas B/chip':>11} {'shrink':>7} {'rel err':>8}",
+          file=sys.stderr)
+    for label, control, n in USHARD_CONFIGS:
+        res, ctl = measured.get(label), measured.get(control)
+        urow = {"config": label, "control": control, "n_workers": n,
+                "measured": None}
+        repl = (res or {}).get("update_state_bytes_replicated") \
+            or (ctl or {}).get("update_state_bytes_replicated")
+        if repl:
+            urow["predicted_bytes_per_chip"] = int(
+                update_state_bytes_per_chip(repl, n))
+            urow["predicted_shrink"] = float(n)
+        if res and res.get("update_state_bytes_per_chip") is not None:
+            meas = res["update_state_bytes_per_chip"]
+            urow["measured"] = {
+                k: res.get(k)
+                for k in ("update_state_bytes_per_chip",
+                          "update_state_bytes_replicated",
+                          "update_state_shrink")}
+            if ctl and ctl.get("update_state_bytes_per_chip") is not None:
+                urow["control_bytes_per_chip"] = \
+                    ctl["update_state_bytes_per_chip"]
+            if repl:
+                pred = urow["predicted_bytes_per_chip"]
+                urow["rel_err"] = (round(abs(meas - pred) / pred, 4)
+                                   if pred else None)
+                print(f"{label:30} {pred:>11} {meas:>11} "
+                      f"{res.get('update_state_shrink') or 0:>7.2f} "
+                      f"{urow['rel_err']:>8.4f}", file=sys.stderr)
+        else:
+            print(f"{label:30} "
+                  f"{urow.get('predicted_bytes_per_chip', '--'):>11} "
+                  f"{'--':>11}  (no measured r11 row yet)", file=sys.stderr)
+        out["update_state_rows"].append(urow)
     print(json.dumps(out, indent=1))
     return 0
 

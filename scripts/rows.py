@@ -137,6 +137,30 @@ ROWS: List[Row] = [
        BENCH_CFG='{"d_model":512,"n_head":8,"n_layer":16,"seq_len":512,'
                  '"vocab":32768,"synthetic_train":512,"pp":4,'
                  '"pp_microbatches":8,"pp_interleave":4}'),
+    # -- round-11 update-plane-sharding rows (ISSUE 17): TransformerLM on
+    #    pure data meshes at N∈{2,4} — replicated control vs leaf-wise
+    #    sharded update plane (BENCH_USHARD).  Every row carries the
+    #    devprof.USHARD_ROW_COLUMNS memory report (controls via
+    #    BENCH_USHARD_REPORT=1, shrink ~1.0) so the headline per-chip
+    #    ~N× shrink is read row-vs-row at fixed model/batch/N, and
+    #    scripts/predict_scaling.py --json joins the measured
+    #    update_state_bytes_per_chip against its analytic model ---------
+    _r("transformer_lm-b8-n2", "r11",
+       BENCH_MODEL="transformer_lm", BENCH_BATCH=8, BENCH_USHARD_REPORT=1,
+       BENCH_CFG='{"d_model":256,"n_head":8,"n_layer":4,"seq_len":128,'
+                 '"vocab":8192,"synthetic_train":64,"n_workers":2}'),
+    _r("transformer_lm-b8-n2-ushard", "r11",
+       BENCH_MODEL="transformer_lm", BENCH_BATCH=8, BENCH_USHARD=1,
+       BENCH_CFG='{"d_model":256,"n_head":8,"n_layer":4,"seq_len":128,'
+                 '"vocab":8192,"synthetic_train":64,"n_workers":2}'),
+    _r("transformer_lm-b8-n4", "r11",
+       BENCH_MODEL="transformer_lm", BENCH_BATCH=8, BENCH_USHARD_REPORT=1,
+       BENCH_CFG='{"d_model":256,"n_head":8,"n_layer":4,"seq_len":128,'
+                 '"vocab":8192,"synthetic_train":64,"n_workers":4}'),
+    _r("transformer_lm-b8-n4-ushard", "r11",
+       BENCH_MODEL="transformer_lm", BENCH_BATCH=8, BENCH_USHARD=1,
+       BENCH_CFG='{"d_model":256,"n_head":8,"n_layer":4,"seq_len":128,'
+                 '"vocab":8192,"synthetic_train":64,"n_workers":4}'),
 ]
 
 

@@ -329,6 +329,82 @@ def test_donation_safety_unresolvable_spec_is_skipped(tmp_path):
 
 
 # ---------------------------------------------------------------------------
+# shard-rebuild-dominance
+# ---------------------------------------------------------------------------
+
+USHARD_BAD = """
+from theanompi_tpu.parallel.update_sharding import slice_chunk
+
+def step(flat, rank, chunk, lr, grads):
+    my_p = slice_chunk(flat, rank, chunk)
+    new_p = my_p - lr * grads
+    return new_p
+"""
+
+USHARD_GOOD = """
+from theanompi_tpu.parallel.update_sharding import (all_gather_chunks,
+                                                    slice_chunk)
+
+def step(flat, rank, chunk, lr, grads):
+    my_p = slice_chunk(flat, rank, chunk)
+    new_p = my_p - lr * grads
+    full = all_gather_chunks(new_p, "workers")
+    return full
+"""
+
+USHARD_BRANCH_BAD = """
+from theanompi_tpu.parallel.update_sharding import (all_gather_chunks,
+                                                    slice_chunk)
+
+def step(flat, rank, chunk, gather):
+    my_p = slice_chunk(flat, rank, chunk)
+    if gather:
+        my_p = all_gather_chunks(my_p, "workers")
+    return my_p
+"""
+
+USHARD_EXEMPT_GOOD = """
+from theanompi_tpu.parallel.update_sharding import shard_tree
+
+def reshard_extra(extra, plan, rank):
+    # a named producer helper: returning chunks is its JOB
+    return shard_tree(extra, plan, rank)
+"""
+
+
+def test_shard_rebuild_bad_fixture(tmp_path):
+    """A chunk laundered through arithmetic and returned without its
+    rebuild: under donate_argnums the caller's full buffer silently
+    becomes a 1/N local shard."""
+    found = lint_snippet(tmp_path, "bad.py", USHARD_BAD,
+                         "shard-rebuild-dominance")
+    assert len(found) == 1
+    assert "`new_p` holds a worker-local shard" in found[0].message
+    assert "allgather rebuild" in found[0].message
+
+
+def test_shard_rebuild_good_fixture(tmp_path):
+    assert lint_snippet(tmp_path, "good.py", USHARD_GOOD,
+                        "shard-rebuild-dominance") == []
+
+
+def test_shard_rebuild_branch_does_not_dominate(tmp_path):
+    """A rebuild INSIDE one arm of an `if` does not dominate the return
+    — the no-gather path still escapes the shard."""
+    found = lint_snippet(tmp_path, "x.py", USHARD_BRANCH_BAD,
+                         "shard-rebuild-dominance")
+    assert len(found) == 1
+    assert "`my_p`" in found[0].message
+
+
+def test_shard_rebuild_exempts_named_producers(tmp_path):
+    """The schema's own producer helpers (shard_*/reshard_*/slice_*/
+    chunk_*) return chunks by design — never flagged."""
+    assert lint_snippet(tmp_path, "x.py", USHARD_EXEMPT_GOOD,
+                        "shard-rebuild-dominance") == []
+
+
+# ---------------------------------------------------------------------------
 # compat-boundary
 # ---------------------------------------------------------------------------
 

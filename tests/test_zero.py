@@ -30,6 +30,24 @@ def _make_tiny(zero, mesh, **kw):
     return TinyModel(cfg), cfg
 
 
+def test_zero1_ragged_chunking_is_explicit():
+    """P=10, N=4 (the ragged case): chunk ceil(10/4)=3, padded length 12.
+    Callers pad to ``padded_size`` EXPLICITLY before slicing — a ragged
+    flat must never rely on a downstream implicit zero-fill (dynamic_slice
+    would silently clamp an 11th-element read)."""
+    from theanompi_tpu.parallel import zero as zero_lib
+    assert zero_lib.chunk_size(10, 4) == 3
+    assert zero_lib.padded_size(10, 4) == 12
+    # and the boxed re-partition round-trips the ragged layout exactly
+    flat = np.arange(10, dtype=np.float32)
+    boxed4 = np.pad(flat, (0, 2)).reshape(4, 3)
+    boxed2 = zero_lib.rechunk_boxed(boxed4, 2, 1, 10)
+    assert boxed2.shape == (2, 5)
+    np.testing.assert_array_equal(boxed2.reshape(-1)[:10], flat)
+    back = zero_lib.rechunk_boxed(boxed2, 4, 1, 10)
+    np.testing.assert_array_equal(back, boxed4)
+
+
 def test_zero1_bit_equal_to_replicated(mesh4):
     """Same data, same seed: the ZeRO-sharded optimizer must trace the
     replicated optimizer's params EXACTLY (elementwise math on disjoint

@@ -1,4 +1,5 @@
-"""donation-safety: a donated buffer must not be read after the call.
+"""donation-safety: a donated buffer must not be read after the call —
+plus shard-rebuild-dominance, the update-sharding escape gate.
 
 The invariant (docs/design.md §12, guarding the PR-3 AOT-cache rules):
 ``jax.jit(..., donate_argnums=...)`` hands the argument's HBM to the
@@ -25,12 +26,29 @@ callables are collected REPO-WIDE and resolved through each file's
 import table, so ``from train import step_fn`` — where ``train.py``
 holds ``step_fn = jax.jit(g, donate_argnums=0)`` — flags a
 read-after-donate at the importing call site too.
+
+shard-rebuild-dominance (docs/design.md §23): the update-sharding
+wrapper (``parallel/update_sharding.py``) cuts worker-local chunks out
+of full buffers (``slice_chunk``/``shard_tree``) that are only valid
+shard-wide — under the ``_build_exchange_fn`` ``donate_argnums=(0,)``
+contract, a function that lets such a chunk ESCAPE (return it) without
+its allgather rebuild silently replaces a donated full buffer with a
+1/N-sized local shard.  The checker taints names bound from the named
+producers, propagates through arithmetic/containers (never through
+arbitrary calls — an optimizer update of a chunk is a new value the
+schema owns), clears taint only when a rebuild
+(``all_gather_chunks``/``unshard_tree``/``all_gather``) DOMINATES the
+return — a rebind inside one branch of an ``if`` does not count — and
+exempts the schema's own named producer helpers (``shard_*``,
+``reshard_*``, ``slice_*``, ``chunk_*``), whose very job is returning
+chunks.
 """
 
 from __future__ import annotations
 
 import ast
-from typing import Dict, List, Optional, Set
+import re
+from typing import Dict, List, Optional, Set, Tuple
 
 from ..core import Checker, Finding, ImportResolver, SourceFile, register
 from ..engine import ProgramIndex
@@ -247,4 +265,147 @@ class DonationSafetyChecker(Checker):
                 name = ImportResolver.dotted(sub)
                 if name:
                     yield name
+
+
+# ---------------------------------------------------------------------------
+# shard-rebuild-dominance
+# ---------------------------------------------------------------------------
+
+#: functions that CUT a worker-local chunk out of a full buffer — their
+#: results are only valid shard-wide (matched on the dotted name's last
+#: segment so ``update_sharding.slice_chunk`` and a bare import both hit)
+_SHARD_PRODUCERS = {"slice_chunk", "shard_tree"}
+#: functions that REBUILD the full buffer from every worker's chunk —
+#: binding through one of these cleanses the result
+_SHARD_REBUILDS = {"all_gather_chunks", "unshard_tree", "all_gather"}
+#: the schema's own producer helpers: returning a chunk is their JOB
+_EXEMPT_FN = re.compile(r"^(shard|reshard|slice|chunk)_")
+
+
+def _last_segment(func: ast.AST) -> Optional[str]:
+    name = ImportResolver.dotted(func)
+    return name.rsplit(".", 1)[-1] if name else None
+
+
+@register
+class ShardRebuildDominanceChecker(Checker):
+    name = "shard-rebuild-dominance"
+    description = ("a worker-local shard (slice_chunk/shard_tree result) "
+                   "escaping a function without its allgather rebuild "
+                   "dominating the return")
+    needs_engine = False
+
+    def check_file(self, sf: SourceFile):
+        findings: List[Finding] = []
+        for fn in ast.walk(sf.tree):
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if _EXEMPT_FN.match(fn.name):
+                continue
+            self._scan(sf, fn, fn.body, {}, findings, top=True)
+        return findings
+
+    def _scan(self, sf, fn, stmts, tainted: Dict[str, int],
+              findings: List[Finding], top: bool) -> None:
+        """Linear scan; ``tainted`` maps name → producer line.  Nested
+        control-flow bodies scan with ``top=False``: taint they ADD is
+        real (it may reach the return), but a rebuild there does NOT
+        clear — it doesn't dominate the paths that skip the branch."""
+        for st in stmts:
+            if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef,
+                               ast.ClassDef)):
+                continue        # nested defs are scanned as their own fns
+            if isinstance(st, ast.Return) and st.value is not None:
+                hit = self._expr_taint(st.value, tainted)
+                if hit is not None:
+                    name, line = hit
+                    findings.append(Finding(
+                        self.name, sf.path, st.lineno, st.col_offset,
+                        f"`{name}` holds a worker-local shard (produced "
+                        f"on line {line}) escaping `{fn.name}` without "
+                        "its allgather rebuild (all_gather_chunks/"
+                        "unshard_tree must dominate the return)"))
+                continue
+            if isinstance(st, (ast.If, ast.For, ast.AsyncFor, ast.While,
+                               ast.Try, ast.With, ast.AsyncWith)):
+                for fieldname in ("body", "orelse", "finalbody"):
+                    sub = getattr(st, fieldname, None)
+                    if sub:
+                        self._scan(sf, fn, sub, tainted, findings,
+                                   top=False)
+                for h in getattr(st, "handlers", []):
+                    self._scan(sf, fn, h.body, tainted, findings,
+                               top=False)
+                continue
+            if isinstance(st, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+                value = st.value
+                if value is None:
+                    continue
+                hit = self._expr_taint(value, tainted)
+                targets = st.targets if isinstance(st, ast.Assign) \
+                    else [st.target]
+                names = [n for t in targets
+                         for n in self._target_names(t)]
+                if hit is not None:
+                    for n in names:
+                        tainted[n] = hit[1]
+                elif top:
+                    # a clean rebind cleanses — but only here at the
+                    # function's top level, where it dominates the return
+                    for n in names:
+                        tainted.pop(n, None)
+
+    def _expr_taint(self, node, tainted: Dict[str, int]
+                    ) -> Optional[Tuple[str, int]]:
+        """(name, producer line) when the expression carries a shard:
+        a producer call, a tainted name, or either propagated through
+        arithmetic/containers/subscripts.  Arbitrary calls STOP taint —
+        their result is a new value (the inner optimizer's elementwise
+        update of a chunk is the schema's own business)."""
+        if isinstance(node, ast.Call):
+            last = _last_segment(node.func)
+            if last in _SHARD_REBUILDS:
+                return None
+            if last in _SHARD_PRODUCERS:
+                return (f"{last}(...)", node.lineno)
+            return None
+        if isinstance(node, (ast.Name, ast.Attribute)):
+            name = ImportResolver.dotted(node)
+            if name in tainted:
+                return (name, tainted[name])
+            return None
+        if isinstance(node, ast.BinOp):
+            return (self._expr_taint(node.left, tainted)
+                    or self._expr_taint(node.right, tainted))
+        if isinstance(node, ast.UnaryOp):
+            return self._expr_taint(node.operand, tainted)
+        if isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+            for e in node.elts:
+                hit = self._expr_taint(e, tainted)
+                if hit:
+                    return hit
+            return None
+        if isinstance(node, ast.Dict):
+            for v in node.values:
+                if v is not None:
+                    hit = self._expr_taint(v, tainted)
+                    if hit:
+                        return hit
+            return None
+        if isinstance(node, (ast.Subscript, ast.Starred)):
+            return self._expr_taint(node.value, tainted)
+        if isinstance(node, ast.IfExp):
+            return (self._expr_taint(node.body, tainted)
+                    or self._expr_taint(node.orelse, tainted))
+        return None
+
+    @staticmethod
+    def _target_names(t) -> List[str]:
+        if isinstance(t, (ast.Tuple, ast.List)):
+            return [n for e in t.elts
+                    for n in ShardRebuildDominanceChecker._target_names(e)]
+        if isinstance(t, ast.Starred):
+            return ShardRebuildDominanceChecker._target_names(t.value)
+        name = ImportResolver.dotted(t)
+        return [name] if name else []
 

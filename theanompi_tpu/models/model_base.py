@@ -110,6 +110,11 @@ class ModelBase:
             # and the full shadow is assembled only at read time.
             from ..utils.opt import ema_wrap
             self.opt = ema_wrap(self.opt, float(self.config["ema_decay"]))
+        # the replicated-layout optimizer, BEFORE any chunking wrapper:
+        # devprof.update_state_report eval_shapes it to price the
+        # replicated-equivalent update plane (the EMA shadow, when on, is
+        # honestly part of that plane, so the capture sits after ema_wrap)
+        self._replicated_opt = self.opt
         self._zero_layout = None
         if self.config.get("zero_opt", False):
             # ZeRO-1 (parallel/zero.py): optimizer state sharded over the
@@ -142,6 +147,51 @@ class ModelBase:
             self._zero_layout = {
                 "n": self.mesh.shape[WORKER_AXIS], "shards": shards,
                 "local_total": helper_funcs.tree_size(template)}
+
+        self._ushard_plan = None
+        if self.config.get("update_sharding", False) and \
+                str(self.config.get("rule", "bsp")).lower() == "bsp":
+            # Leaf-wise update-plane sharding (parallel/update_sharding.py,
+            # docs/design.md §23): optimizer moments chunk per leaf over
+            # the workers axis, one fused allgather rebuilds full params
+            # inside the step.  Wrapped HERE (not at compile time) so the
+            # prewarm venue's `_state_avals` → `self.opt.init` sees the
+            # chunked shapes and every venue requests byte-identical
+            # programs.  Under a non-BSP `rule` only the exchanger's
+            # shardable extra (EASGD/ASGD centers) shards — async rules'
+            # moments diverge per worker and must stay local.
+            assert not self.config.get("zero_opt", False), (
+                "update_sharding IS the generalization of zero_opt "
+                "(leaf-wise chunks vs one flat chunk) — enable one, not "
+                "both")
+            assert not self.config.get("fsdp", False), (
+                "fsdp=true already holds optimizer state on the parameter "
+                "chunk — drop update_sharding")
+            assert not self.config.get("ema_decay"), (
+                "update_sharding does not yet carry the EMA shadow's "
+                "chunked read path (zero_opt does) — use zero_opt with "
+                "ema_decay, or drop one")
+            assert not getattr(self, "gates_opt_state_by_path", False), (
+                "update_sharding chunks optimizer-state leaves — models "
+                "that gate optimizer-state subtrees by path (the GANs' "
+                "n_critic>1 cadence) cannot compose with it")
+            assert self.param_specs() is None and all(
+                self.mesh.shape[a] == 1 for a in self.mesh.axis_names
+                if a != WORKER_AXIS), (
+                "update_sharding currently supports pure data-parallel "
+                "layouts — tensor/pipeline models use zero_opt (the flat "
+                "configuration carries model_shards/pspecs)")
+            n_w = self.mesh.shape[WORKER_AXIS]
+            if n_w > 1:
+                from ..parallel import update_sharding
+                plan = update_sharding.plan_tree(
+                    self.params, n_w,
+                    min_bytes=int(self.config.get(
+                        "ushard_min_bytes",
+                        update_sharding.DEFAULT_MIN_BYTES)))
+                if plan.any_sharded:
+                    self._ushard_plan = plan
+                    self.opt = update_sharding.shard_opt(self.opt, plan)
 
         self._fsdp = None
         if self.config.get("fsdp", False):
@@ -307,15 +357,22 @@ class ModelBase:
             assert int(self.config.get("bucket_bytes", 0) or 0) == 0, (
                 "fsdp=true has no exchanger wire to bucket (grads arrive "
                 "via the all_gather transpose) — drop bucket_bytes")
-        if self.config.get("zero_opt", False) or self.config.get("ema_decay"):
-            # ZeRO-1 assumes every worker sees the SAME reduced gradient and
-            # holds identical params — true only under BSP grads mode with a
-            # real collective; params mode / the 'none' strategy would slice
-            # UN-reduced per-worker grads and train silently wrong (and the
-            # EMA shadow would track per-worker divergent params), and
-            # async rules' workers would never update chunks other ranks own
-            # (their canonical/center validation also never reads a shadow)
-            which = "zero_opt" if self.config.get("zero_opt") else "ema_decay"
+        if self.config.get("zero_opt", False) or self.config.get("ema_decay") \
+                or self._ushard_plan is not None:
+            # ZeRO-1 / leaf-wise update sharding assume every worker sees
+            # the SAME reduced gradient and holds identical params — true
+            # only under BSP grads mode with a real collective; params mode
+            # / the 'none' strategy would slice UN-reduced per-worker grads
+            # and train silently wrong (and the EMA shadow would track
+            # per-worker divergent params), and async rules' workers would
+            # never update chunks other ranks own (their canonical/center
+            # validation also never reads a shadow).  A sharded-opt model
+            # handed a non-BSP exchanger means the config `rule` gate in
+            # __init__ disagrees with the exchanger actually compiled —
+            # set config['rule'] to the rule in use.
+            which = "zero_opt" if self.config.get("zero_opt") else (
+                "ema_decay" if self.config.get("ema_decay")
+                else "update_sharding")
             assert (isinstance(self.exchanger, BSP_Exchanger)
                     and self.exchanger.mode == "grads"
                     and self.exchanger.strategy.name != "none"), (
@@ -350,6 +407,13 @@ class ModelBase:
         if self._fsdp is not None:
             self.step_state["params"] = steps.place_boxed(
                 self._fsdp.chunk_host(self.params), self.mesh)
+        if getattr(self.exchanger, "update_plan", lambda: None)() is not None:
+            # plan-sharded extra (EASGD/ASGD centers under update_sharding):
+            # each worker's init chunk is a DIFFERENT window of the center —
+            # replicate_tree above broadcast the zero template; overwrite
+            # with the genuinely partitioned rows
+            self.step_state["extra"] = steps.place_boxed(
+                self.exchanger.extra_host_boxed(n), self.mesh)
         spc = int(self.steps_per_call)
         # multi-step dispatch fuses the exchange cadence INTO the scanned
         # step for every rule with a post-step collective (EASGD/ASGD/

@@ -55,7 +55,7 @@ import numpy as np
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
-from . import buckets, steps, topology
+from . import buckets, steps, topology, update_sharding
 from ..jax_compat import shard_map
 from ..utils import devprof, telemetry, tracing
 from .mesh import WORKER_AXIS
@@ -113,6 +113,11 @@ class Exchanger:
         self.mesh: Optional[Mesh] = None
         self.model = None
         self._exchange_fn = None
+        # leaf-wise update-plane sharding (parallel/update_sharding.py,
+        # config update_sharding=true): the active plan over this rule's
+        # shardable extra keys, built in prepare() once model+mesh exist
+        self._ushard_plan = None
+        self._ushard_keys: tuple = ()
         # bucketed overlap-scheduled wire (parallel/buckets.py): split the
         # exchange payload into ~bucket_bytes collectives issued as async
         # start/done pairs so XLA's latency-hiding scheduler can overlap
@@ -137,6 +142,7 @@ class Exchanger:
         self.mesh = mesh
         self.model = model
         self.size = mesh.shape[WORKER_AXIS]
+        self._build_update_plan()
 
     def has_exchange(self) -> bool:
         """True when the rule runs a post-step exchange collective (the
@@ -242,9 +248,113 @@ class Exchanger:
                        out_specs=state_spec)
         self._exchange_fn = jax.jit(sm, donate_argnums=(0,))
 
-    def extra_state_template(self) -> Dict[str, Any]:
-        """Unboxed per-worker persistent state (error feedback, center, α...)."""
+    # -- leaf-wise update-plane sharding (docs/design.md §23) ---------------
+
+    def shardable_extra(self) -> tuple:
+        """Extra-state keys whose leaves are bit-identical replicas across
+        workers — the only state update-plane sharding may chunk (EASGD/
+        ASGD center copies).  Per-worker DIVERGENT state must stay off this
+        list: error-feedback buffers and gossip α differ per worker by
+        construction — each chip already holds only its own copy, so there
+        is no redundancy to shard away (the schema still classifies them:
+        their plan entry is 'local', i.e. absent)."""
+        return ()
+
+    def _build_update_plan(self) -> None:
+        """Stamp the leaf-wise plan over the shardable extra keys (config
+        ``update_sharding=true``).  Inactive (plan None) when the rule has
+        nothing shardable, the mesh has one worker, or no leaf clears the
+        ``ushard_min_bytes`` threshold — active sharding under model
+        parallelism is not supported and fails loudly."""
+        self._ushard_plan, self._ushard_keys = None, ()
+        if not self.config.get("update_sharding", False):
+            return
+        keys = tuple(sorted(self.shardable_extra()))
+        if not keys or self.size <= 1:
+            return
+        assert self.model.param_specs() is None and all(
+            self.mesh.shape[a] == 1 for a in self.mesh.axis_names
+            if a != WORKER_AXIS), (
+            "update_sharding currently supports pure data-parallel "
+            "layouts (param_specs() is None, no model/pipe/seq mesh axes)")
+        full = self._extra_full_template()
+        keys = tuple(k for k in keys if k in full)
+        if not keys:
+            return
+        plan = update_sharding.plan_tree(
+            {k: full[k] for k in keys}, self.size,
+            min_bytes=int(self.config.get(
+                "ushard_min_bytes", update_sharding.DEFAULT_MIN_BYTES)))
+        if plan.any_sharded:
+            self._ushard_plan, self._ushard_keys = plan, keys
+
+    def update_plan(self):
+        """The active :class:`update_sharding.UpdatePlan` over this rule's
+        shardable extra keys, or None when sharding is off/inactive."""
+        return self._ushard_plan
+
+    def unshard_extra(self, extra, axis: str = WORKER_AXIS):
+        """Traced rebuild of the plan-sharded extra keys' FULL values from
+        the local chunks (one fused allgather); identity when sharding is
+        off.  Exchange bodies call this, do their unchanged full-tensor
+        algebra, then :meth:`reshard_extra` the results — so the math (and
+        its psum reduction order) is bit-identical to the replicated
+        path."""
+        plan = self.update_plan()
+        if plan is None:
+            return extra
+        full = update_sharding.unshard_tree(
+            {k: extra[k] for k in self._ushard_keys}, plan, axis)
+        return dict(extra, **full)
+
+    def reshard_extra(self, full_sub, axis: str = WORKER_AXIS):
+        """Slice this worker's chunks back out of updated full values —
+        the store-side half of the :meth:`unshard_extra` round trip;
+        identity when sharding is off."""
+        plan = self.update_plan()
+        if plan is None:
+            return full_sub
+        rank = lax.axis_index(axis)
+        return update_sharding.shard_tree(
+            {k: full_sub[k] for k in self._ushard_keys}, plan, rank)
+
+    def extra_host_boxed(self, n: int):
+        """Boxed ``[n, ...]`` host INIT VALUES for the extra part while the
+        plan is active (``model_base`` places them via
+        ``steps.place_boxed``): plan keys are genuinely PARTITIONED rows —
+        each worker's chunk differs, which ``steps.replicate_tree``'s
+        one-template broadcast cannot express — and the rest replicate."""
+        plan = self.update_plan()
+        assert plan is not None, "extra_host_boxed needs an active plan"
+        full = self._extra_full_template()
+        out = update_sharding.shard_host_boxed(
+            {k: full[k] for k in self._ushard_keys}, plan)
+        for k, v in full.items():
+            if k not in self._ushard_keys:
+                out[k] = jax.tree.map(
+                    lambda x: np.broadcast_to(
+                        np.asarray(x)[None], (n,) + np.shape(x)).copy(), v)
+        return out
+
+    def _extra_full_template(self) -> Dict[str, Any]:
+        """Unboxed per-worker persistent state (error feedback, center,
+        α...), FULL shapes — rules override THIS, not
+        :meth:`extra_state_template`."""
         return {}
+
+    def extra_state_template(self) -> Dict[str, Any]:
+        """The extra-state shapes the step machinery carries: the full
+        template, with the plan-sharded keys' leaves chunked to the
+        per-worker ``[chunk]`` windows when update-plane sharding is
+        active — every venue (live compile, ``_state_avals`` prewarm)
+        derives byte-identical programs from the same shapes."""
+        full = self._extra_full_template()
+        plan = self.update_plan()
+        if plan is None:
+            return full
+        sub = update_sharding.chunk_template(
+            {k: full[k] for k in self._ushard_keys}, plan)
+        return dict(full, **sub)
 
     def extra_specs(self, param_specs):
         """Per-leaf PartitionSpecs for :meth:`extra_state_template` when the
@@ -392,8 +502,9 @@ class BSP_Exchanger(Exchanger):
                 and self.strategy.name != "none"):
             return ()
         parts = {"params", "opt_state", "bn_state", "extra"}
-        if self.config.get("zero_opt", False):
-            parts.discard("opt_state")    # the ZeRO partition differs/worker
+        if self.config.get("zero_opt", False) or \
+                self.config.get("update_sharding", False):
+            parts.discard("opt_state")    # the chunk partition differs/worker
         if self.config.get("fsdp", False):
             parts.discard("params")       # FSDP chunks are the partition:
             parts.discard("opt_state")    # genuinely per-worker state
@@ -441,7 +552,11 @@ class BSP_Exchanger(Exchanger):
         super().prepare(mesh, model)
         self._build_exchange_fn()
 
-    def extra_state_template(self) -> Dict[str, Any]:
+    def _extra_full_template(self) -> Dict[str, Any]:
+        # error-feedback state is per-worker DIVERGENT (each worker
+        # compresses its own residual), so none of it is shardable_extra —
+        # under update_sharding only the optimizer moments chunk (the
+        # model wraps its opt; see model_base.__init__)
         if self.strategy.stateful:
             pspecs = self.model.param_specs()
             group = self._group_axes()
@@ -527,6 +642,20 @@ class BSP_Exchanger(Exchanger):
         return jax.tree.map(lambda x: lax.pmean(x, axis), bn_state)
 
 
+def _canonical_center(exch: Exchanger, state):
+    """The center-parameter tree out of BOXED state, for both center rules
+    and both venues — on-device (``begin_val``) and gathered-host
+    (checkpoint save): plain replica read when replicated, the
+    pad-trimming concat of the ``[n, chunk]`` rows when plan-sharded
+    (``update_sharding.unshard_boxed`` is pure array-method algebra, so it
+    runs on numpy and jax arrays alike)."""
+    plan = exch.update_plan()
+    if plan is None:
+        return steps.unbox(state["extra"])["center"]
+    return update_sharding.unshard_boxed(
+        {"center": state["extra"]["center"]}, plan)["center"]
+
+
 class EASGD_Exchanger(Exchanger):
     """Elastic averaging (reference: ``EASGD_Exchanger``, server+worker modes;
     SURVEY.md §3.2).
@@ -548,8 +677,14 @@ class EASGD_Exchanger(Exchanger):
         self.alpha = float(self.config.get("alpha", 0.5))
         self.exchange_freq = int(self.config.get("sync_freq", 4))
 
-    def extra_state_template(self) -> Dict[str, Any]:
+    def _extra_full_template(self) -> Dict[str, Any]:
         return {"center": jax.tree.map(jnp.asarray, self.model.params)}
+
+    def shardable_extra(self) -> tuple:
+        # the center is bit-identical across workers (every worker applies
+        # the same psum'd mean delta) — exactly the redundancy the
+        # update-plane plan shards away
+        return ("center",)
 
     def extra_specs(self, param_specs):
         # the center is a params-shaped tree: same per-leaf layout
@@ -565,7 +700,12 @@ class EASGD_Exchanger(Exchanger):
         axis, alpha = WORKER_AXIS, self.alpha
         params = steps.unbox(state["params"])
         extra = steps.unbox(state["extra"])
-        center = extra["center"]
+        # sharded layout: rebuild the full center from the local chunks
+        # (one fused allgather of values that ARE exact center windows —
+        # bit-identical input to the unchanged algebra below), and slice
+        # the updated center back into chunks at the end.  Identity when
+        # sharding is off.
+        center = self.unshard_extra(extra, axis)["center"]
         delta = jax.tree.map(lambda p, c: p - c, params, center)
         # elastic membership: demoted ranks contribute zero to the center
         # mean and skip the elastic pull (their replica is bit-unchanged),
@@ -589,7 +729,8 @@ class EASGD_Exchanger(Exchanger):
                                   center, mean_delta)
         new_params = jax.tree.map(lambda p, d: p - alpha * pull * d,
                                   params, delta)
-        extra = dict(extra, center=new_center)
+        extra = dict(extra, **self.reshard_extra({"center": new_center},
+                                                 axis))
         return dict(state, params=steps.box(new_params),
                     extra=steps.box(extra))
 
@@ -600,7 +741,7 @@ class EASGD_Exchanger(Exchanger):
     def canonical_params(self, state):
         """Validation/checkpoint read the CENTER (the reference validated
         against the server's center parameters)."""
-        return steps.unbox(state["extra"])["center"]
+        return _canonical_center(self, state)
 
 
 class ASGD_Exchanger(Exchanger):
@@ -618,8 +759,12 @@ class ASGD_Exchanger(Exchanger):
         super().__init__(config)
         self.exchange_freq = int(self.config.get("sync_freq", 1))
 
-    def extra_state_template(self) -> Dict[str, Any]:
+    def _extra_full_template(self) -> Dict[str, Any]:
         return {"center": jax.tree.map(jnp.asarray, self.model.params)}
+
+    def shardable_extra(self) -> tuple:
+        # identical replicas across workers (same psum'd delta sum applied)
+        return ("center",)
 
     def extra_specs(self, param_specs):
         return {"center": param_specs}
@@ -634,7 +779,9 @@ class ASGD_Exchanger(Exchanger):
         axis = WORKER_AXIS
         params = steps.unbox(state["params"])
         extra = steps.unbox(state["extra"])
-        center = extra["center"]
+        # sharded layout: full center from chunks in, chunks of the new
+        # center out (see EASGD_Exchanger.exchange_body) — identity when off
+        center = self.unshard_extra(extra, axis)["center"]
         # elastic membership: the center absorbs only ACTIVE workers'
         # accumulated deltas, and only active workers reset to the fresh
         # center — a demoted worker keeps its local replica bit-unchanged
@@ -658,7 +805,8 @@ class ASGD_Exchanger(Exchanger):
         else:
             new_params = jax.tree.map(
                 lambda c, p: jnp.where(gate > 0, c, p), new_center, params)
-        extra = dict(extra, center=new_center)
+        extra = dict(extra, **self.reshard_extra({"center": new_center},
+                                                 axis))
         return dict(state, params=steps.box(new_params),
                     extra=steps.box(extra))
 
@@ -667,7 +815,7 @@ class ASGD_Exchanger(Exchanger):
         self._build_exchange_fn()
 
     def canonical_params(self, state):
-        return steps.unbox(state["extra"])["center"]
+        return _canonical_center(self, state)
 
 
 class GOSGD_Exchanger(Exchanger):
@@ -724,7 +872,9 @@ class GOSGD_Exchanger(Exchanger):
         self.family_seed = int(self.config.get("gosgd_seed", 0))
         self.exchange_freq = 1
 
-    def extra_state_template(self) -> Dict[str, Any]:
+    def _extra_full_template(self) -> Dict[str, Any]:
+        # α is per-worker divergent (it tracks the gossip mass each replica
+        # carries) — never shardable
         return {"alpha": jnp.ones(())}
 
     def extra_specs(self, param_specs):

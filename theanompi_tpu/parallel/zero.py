@@ -8,37 +8,27 @@ stage 1 (Rajbhandari et al. 2020) shards them: each worker keeps 1/N of the
 flattened optimizer state, updates only ITS parameter chunk, and one
 ``all_gather`` rebuilds the full parameters for the next forward pass.
 
-TPU-native mapping: this drops straight into the existing boxed-state
-machinery as an OPTIMIZER WRAPPER.  The wrapped ``init`` allocates state
-for one ``ceil(P/N)`` chunk (so the boxed ``[n_workers, chunk]`` layout IS
-the ZeRO partition — per-chip optimizer memory shrinks N×), and ``update``
-runs inside the same compiled SPMD step as everything else:
-
-    flat_g   = flatten(reduced grads)           # grads already psum'd (BSP)
-    my_g     = dynamic_slice(flat_g,  rank·C)   # my chunk
-    my_p     = dynamic_slice(flat_p,  rank·C)
-    my_p'    = opt.update(my_g, my_state, my_p) # any wrapped optimizer
-    params'  = unflatten(all_gather(my_p'))     # one allgather, rides ICI
-
-Bit-equivalence with the unsharded optimizer holds exactly (elementwise
-update math on disjoint chunks; no reduction-order change) and is pinned in
-``tests/test_zero.py``.  Config: ``zero_opt=true`` on any BSP session.
+Since the leaf-wise update-plane schema landed
+(``parallel/update_sharding.py``, docs/design.md §23), this module is a
+THIN CONFIGURATION of that wrapper: :func:`zero1` is
+``update_sharding.flat_shard_opt`` — the flat-chunk-everything layout,
+which additionally carries the tensor/pipeline composition
+(``model_shards``/``pspecs``).  Config ``zero_opt=true`` behaves exactly
+as before, cache keys included (``compile_cache.key_extra`` stamps
+nothing new unless ``update_sharding`` is on).  Bit-equivalence with the
+unsharded optimizer holds exactly (elementwise update math on disjoint
+chunks; no reduction-order change) and is pinned in ``tests/test_zero.py``,
+ragged param counts (P=10, N=4 — explicit ``padded_size`` padding)
+included.
 """
 
 from __future__ import annotations
 
-import jax
-import jax.numpy as jnp
-from jax import lax
-
-from ..utils import helper_funcs
 from ..utils.opt import OptPair
 from .mesh import WORKER_AXIS
+from .update_sharding import chunk_size, flat_shard_opt, padded_size
 
-
-def chunk_size(n_total: int, n_workers: int) -> int:
-    """ceil(P/N) — the per-worker chunk length of an N-way flat partition."""
-    return -(-n_total // n_workers)
+__all__ = ["chunk_size", "padded_size", "rechunk_boxed", "zero1"]
 
 
 def rechunk_boxed(arr, n_new: int, shards: int, local_total: int):
@@ -60,8 +50,8 @@ def rechunk_boxed(arr, n_new: int, shards: int, local_total: int):
     per_rank = np.transpose(np.asarray(arr).reshape(n_s, shards, chunk_s),
                             (1, 0, 2)).reshape(shards, -1)[:, :local_total]
     chunk_n = chunk_size(local_total, n_new)
-    per_rank = np.pad(per_rank,
-                      ((0, 0), (0, chunk_n * n_new - local_total)))
+    per_rank = np.pad(per_rank, ((0, 0), (0, padded_size(local_total, n_new)
+                                          - local_total)))
     return np.transpose(per_rank.reshape(shards, n_new, chunk_n),
                         (1, 0, 2)).reshape(n_new, shards * chunk_n)
 
@@ -69,60 +59,9 @@ def rechunk_boxed(arr, n_new: int, shards: int, local_total: int):
 def zero1(opt: OptPair, n_workers: int, params_template,
           axis: str = WORKER_AXIS, model_shards: int = 1,
           pspecs=None, model_axes: tuple = ()) -> OptPair:
-    """Wrap ``opt`` so its state lives sharded over ``axis``.
-
-    ``params_template`` fixes the flat layout (chunk size = ceil(P/N)); the
-    wrapped pair plugs into the standard step machinery unchanged — the
-    boxed ``[n_workers, ...]`` state axis is the ZeRO partition.
-
-    Model parallelism (round-4): under tensor/pipeline param specs the
-    per-device params are already the LOCAL shard, so ``params_template``
-    must be the local template (``steps.local_param_template``) and
-    ``update`` composes unchanged — flatten local, slice my worker chunk,
-    all-gather over workers rebuilds the local flat.  Only ``init`` differs:
-    the HOST state template must be global-shaped, ``model_shards`` × the
-    chunk (one chunk per model-group rank), laid out so the boxed spec
-    ``P(workers, <model axes>)`` hands each device exactly its chunk
-    (``steps.state_partition_specs``).
-    """
-    n_total = helper_funcs.tree_size(params_template)
-    chunk = chunk_size(n_total, n_workers)
-    padded = chunk * n_workers
-
-    def init(params):
-        # per-worker view: state for ONE chunk per model-group rank (boxed
-        # to [n_workers, model_shards·chunk] by the step machinery and
-        # sharded so each chip holds exactly its [chunk] shard)
-        return {"opt": opt.init(
-            jnp.zeros((model_shards * chunk,), jnp.float32))}
-
-    def update(grads, st, params, lr):
-        flat_g = helper_funcs.flatten_tree(grads, pad_to_multiple_of=padded)
-        flat_p = helper_funcs.flatten_tree(params, pad_to_multiple_of=padded)
-        rank = lax.axis_index(axis)
-        my_g = lax.dynamic_slice(flat_g, (rank * chunk,), (chunk,))
-        my_p = lax.dynamic_slice(flat_p, (rank * chunk,), (chunk,))
-        my_p_new, opt_state = opt.update(my_g, st["opt"], my_p, lr)
-        full = lax.all_gather(my_p_new, axis, tiled=True)       # [padded]
-        new_params = helper_funcs.unflatten_like(params, full)
-        if model_axes and pspecs is not None:
-            # the flat concat JOINS every leaf's varying-mesh-axes set, so
-            # leaves replicated over a model axis (LN scales, biases)
-            # come back statically unprovable as invariant even though
-            # their values are (grads of replicated leaves are psum'd over
-            # model in the tp backward).  Re-anchor each leaf bit-exactly
-            # (steps.anchor_invariant) over exactly the model axes its spec
-            # does NOT shard — per axis, so a 3-D mesh leaf sharded over
-            # 'pipe' but replicated over 'model' anchors on 'model' only.
-            from .steps import _is_spec, anchor_invariant, spec_mentions
-
-            def anchor(s, v):
-                axes = tuple(a for a in model_axes
-                             if not spec_mentions(s, (a,)))
-                return anchor_invariant(v, axes)
-
-            new_params = jax.tree.map(anchor, pspecs, new_params,
-                                      is_leaf=_is_spec)
-        return new_params, {"opt": opt_state}
-
-    return OptPair(init, update)
+    """Wrap ``opt`` so its state lives flat-chunked over ``axis`` — the
+    ZeRO-1 special case of the update-sharding wrapper.  See
+    :func:`update_sharding.flat_shard_opt` for the layout contract."""
+    return flat_shard_opt(opt, n_workers, params_template, axis=axis,
+                          model_shards=model_shards, pspecs=pspecs,
+                          model_axes=model_axes)

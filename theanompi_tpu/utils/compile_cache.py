@@ -213,6 +213,16 @@ def key_extra(fn: str, model=None, exchanger=None,
             # Stamped only when v > 1 so every pre-existing key (and every
             # prewarmed fill/drain entry) stays byte-stable.
             extra["pp_interleave"] = v
+        if getattr(model, "config", {}).get("update_sharding", False):
+            # leaf-wise update-plane sharding reshapes the step (chunked
+            # moments, fused allgather) AND its state avals; the threshold
+            # moves leaves between the sharded/replicated layouts, so it
+            # is part of the identity.  Stamped only when the knob is on —
+            # every pre-existing key (zero_opt sessions included) stays
+            # byte-stable.
+            from ..parallel import update_sharding as _us
+            extra["ushard"] = int(model.config.get(
+                "ushard_min_bytes", _us.DEFAULT_MIN_BYTES))
     if spc is not None:
         extra["spc"] = int(spc)
     if exchanger is not None:

@@ -130,6 +130,20 @@ PIPELINE_ROW_COLUMNS = (
     "pipeline_schedule_verified",
 )
 
+# The bench-row columns update-plane-sharding rows add (BENCH_USHARD=1 /
+# BENCH_USHARD_REPORT=1; parallel/update_sharding.py, docs/design.md §23)
+# — the :func:`update_state_report` measurement: per-chip update-plane
+# bytes (optimizer state + exchanger extra, actual live-array bytes over
+# worker count), the replicated-equivalent bytes the same session would
+# hold without sharding, and their ratio (the ~N× headline).  Same
+# jax-free schema-home discipline as the vocabularies above; disjointness
+# is pinned in tests/test_update_sharding.py.
+USHARD_ROW_COLUMNS = (
+    "update_state_bytes_per_chip",
+    "update_state_bytes_replicated",
+    "update_state_shrink",
+)
+
 # HLO opcodes whose device time is collective/communication time.  Async
 # pairs (`<op>-start` / `<op>-done`) share the prefix and match too.
 COMM_OP_PREFIXES = (
@@ -787,6 +801,51 @@ def profile_row_fields(profile: Dict[str, Any],
         "device_comm_secs": profile.get("comm_secs"),
         "device_mfu": mfu,
         "bubble_fraction": profile.get("bubble_fraction"),
+    }
+
+
+def update_state_report(model) -> Dict[str, Any]:
+    """Per-chip update-plane memory (:data:`USHARD_ROW_COLUMNS`): what a
+    chip actually holds for the optimizer state + exchanger extra, against
+    the replicated-equivalent layout.
+
+    Measured, not modeled, on the live boxed state: every boxed leaf is
+    ``[n_workers, ...]`` sharded ``P(workers)``, so per-chip bytes ARE
+    boxed bytes over worker count — for a sharded leaf the rows are the
+    partition (chunk each), for a replicated leaf each row is one full
+    copy.  The replicated-equivalent prices ``model._replicated_opt``
+    (the pre-chunking optimizer, EMA included) eval_shaped on the full
+    params plus the rule's FULL extra template — per-worker divergent
+    state (error feedback) appears identically on both sides, so the
+    shrink ratio isolates exactly the redundancy sharding removes.
+    ``scripts/predict_scaling.py`` joins its analytic model against these
+    columns; bench.py folds them into sharded/control rows."""
+    import jax
+    import numpy as np
+    from ..parallel.mesh import WORKER_AXIS
+
+    def tree_bytes(t) -> int:
+        return int(sum(
+            int(np.prod(np.shape(x)) or 1) * np.dtype(x.dtype).itemsize
+            for x in jax.tree.leaves(t)))
+
+    n = int(model.mesh.shape[WORKER_AXIS])
+    state = model.step_state
+    assert state is not None, "update_state_report needs a compiled model"
+    per_chip = (tree_bytes(state["opt_state"])
+                + tree_bytes(state["extra"])) // n
+    opt = getattr(model, "_replicated_opt", None) or model.opt
+    full_opt = jax.eval_shape(opt.init, model.params)
+    exch = model.exchanger
+    full_extra = exch._extra_full_template() \
+        if hasattr(exch, "_extra_full_template") \
+        else exch.extra_state_template()
+    replicated = tree_bytes(full_opt) + tree_bytes(full_extra)
+    return {
+        "update_state_bytes_per_chip": per_chip,
+        "update_state_bytes_replicated": replicated,
+        "update_state_shrink": (round(replicated / per_chip, 3)
+                                if per_chip else None),
     }
 
 
