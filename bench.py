@@ -284,6 +284,12 @@ def _cfg_matches(cfg: str) -> bool:
     # control row or vice versa
     if ("ushard" in parts) != (os.environ.get("BENCH_USHARD") == "1"):
         return False
+    # fused-compression A/B rows (BENCH_FUSE, label token 'fuse'): fuse
+    # rows run the Pallas kernel pipeline, control rows force the jnp
+    # oracle path (THEANOMPI_TPU_NO_PALLAS=1) — same bit layout, different
+    # programs, so neither is an honest fallback for the other
+    if ("fuse" in parts) != (os.environ.get("BENCH_FUSE") == "1"):
+        return False
     return True
 
 
@@ -616,6 +622,15 @@ def bench_row_config(environ=None):
         # optimizer moments + shardable exchanger state chunked over the
         # data axis, one fused allgather rebuilds full params
         config["update_sharding"] = True
+    if env.get("BENCH_FUSE") == "0":
+        # fused-compression CONTROL rows: force the jnp oracle path for the
+        # compression kernels (ops/_pallas_util dispatch) and drop the
+        # memoized decision so it re-reads the env.  Applied HERE — the one
+        # shared env→config assembly — so prewarm and the measurement agree
+        # on the compile_cache `no_pallas` key stamp.
+        os.environ["THEANOMPI_TPU_NO_PALLAS"] = "1"
+        from theanompi_tpu.ops import _pallas_util
+        _pallas_util.reset_dispatch_cache()
     flags = {"real_data": env.get("BENCH_REAL_DATA") == "1",
              "winload": env.get("BENCH_WINLOAD") == "1",
              "prng": env.get("BENCH_PRNG", "rbg")}
@@ -1047,6 +1062,21 @@ def main() -> int:
         except Exception as e:
             print(f"bench: update_state_report unavailable ({e!r})",
                   file=sys.stderr)
+    strat_cfg = str(config.get("exch_strategy", "") or "")
+    if strat_cfg in ("onebit", "topk") or strat_cfg.startswith("powersgd"):
+        # the compression-traffic columns (devprof.COMPRESS_ROW_COLUMNS):
+        # modeled HBM bytes one exchange moves through the compression
+        # pipeline, unfused op graph vs fused kernel pipeline (docs/
+        # design.md §24) — readable off CPU-sim rows now, joined against
+        # step time when the hardware window reopens
+        from theanompi_tpu.utils import devprof
+        try:
+            _rep = devprof.compress_traffic_report(model)
+            if _rep:
+                out.update(_rep)
+        except Exception as e:
+            print(f"bench: compress_traffic_report unavailable ({e!r})",
+                  file=sys.stderr)
     if trace_profile is not None:
         # trace-derived columns (utils/devprof, BENCH_TRACE=1): device
         # compute/comm/EXPOSED-comm time over the traced window and the
@@ -1120,7 +1150,8 @@ def _apply_flagship_defaults() -> None:
     shaping = ("BENCH_MODEL", "BENCH_RULE", "BENCH_BATCH", "BENCH_STRATEGY",
                "BENCH_CFG", "BENCH_SPC", "BENCH_SYNTH_BATCHES",
                "BENCH_BN_DTYPE", "BENCH_REAL_DATA", "BENCH_WIRE_U8",
-               "BENCH_WINLOAD", "BENCH_BUCKET_BYTES", "BENCH_USHARD")
+               "BENCH_WINLOAD", "BENCH_BUCKET_BYTES", "BENCH_USHARD",
+               "BENCH_FUSE")
     if any(k in os.environ for k in shaping):
         return
     if os.environ.get("PALLAS_AXON_REMOTE_COMPILE") == "0":

@@ -1,0 +1,80 @@
+#!/usr/bin/env bash
+# Round-12 perf matrix — the fused-compression round (ISSUE 18 tentpole):
+# TransformerLM on a 2-worker data mesh, one `fuse` row (Pallas
+# single-pass compression kernels, BENCH_FUSE=1) against a control row
+# (jnp oracle path, BENCH_FUSE=0 → THEANOMPI_TPU_NO_PALLAS=1) per
+# compression strategy (onebit / topk / powersgd2).  Wire bits are
+# identical in both modes (ops/compress.py oracle pairing, docs/design.md
+# §24); the step-time delta is the kernels' HBM-traffic win.  Every
+# compression row also carries the modeled traffic columns
+# (devprof.COMPRESS_ROW_COLUMNS: compress_hbm_bytes_legacy / _fused /
+# compress_hbm_shrink / compress_decode_shrink):
+#   jq -r 'select(.result) | [.config, .result.compress_hbm_shrink,
+#          .result.compress_decode_shrink, .result.value] | @tsv'
+# and scripts/predict_scaling.py --json joins the measured fuse/control
+# pairs against the model (out["compress_rows"]).
+#
+# Same discipline as perf_matrix_r11.sh (the PR 3 prewarm machinery):
+#   1. prewarm: every staged r12 row's program — the control rows' AOT
+#      keys carry the `no_pallas` stamp (utils/compile_cache.key_extra) —
+#      compiles into the executable store BEFORE the window.
+#   2. canary: the onebit control row must report `cache: hit`, or the
+#      pass aborts loudly instead of burning the window compiling.
+#   3. the scans: rows from scripts/rows.py --round r12 (the manifest
+#      prewarm consumed); rows already measured in the out-file skip.
+#   ./scripts/perf_matrix_r12.sh [out_file]
+set -u -o pipefail
+OUT="${1:-perf_matrix_r12.jsonl}"
+cd "$(dirname "$0")/.."
+. scripts/_bench_row.sh
+
+CACHE="${BENCH_COMPILE_CACHE:-/tmp/jax_bench_cache}"
+LM_CFG='{"d_model":256,"n_head":8,"n_layer":4,"seq_len":128,"vocab":8192,"synthetic_train":64,"n_workers":2}'
+
+# 1. prewarm (idempotent: cached rows skip in ~ms); live backend venue
+# first, topology venue fallback when the tunnel can't answer
+echo "== prewarm -> $CACHE" >&2
+timeout -s KILL 3000 python -u scripts/prewarm_cache.py --rows r12 \
+    --cache "$CACHE" --platform tpu >&2 \
+  || timeout -s KILL 3000 python -u scripts/prewarm_cache.py --rows r12 \
+    --cache "$CACHE" --platform topology:v5e:2x2x1 >&2 \
+  || echo "== prewarm failed (rows will compile on the clock)" >&2
+
+# 2. canary: the onebit CONTROL program must hit the executable cache —
+# a miss means the key composition (the conditional `no_pallas` stamp in
+# key_extra, applied through bench_row_config's shared BENCH_FUSE=0
+# handling) drifted from what prewarm stored
+echo "== canary: transformer_lm-b8-onebit-n2 must report cache: hit" >&2
+canary=$(env BENCH_SKIP_PROBE="${BENCH_SKIP_PROBE:-1}" \
+             BENCH_MODEL=transformer_lm BENCH_BATCH=8 \
+             BENCH_STRATEGY=onebit BENCH_FUSE=0 \
+             BENCH_CFG="$LM_CFG" \
+             BENCH_ITERS=5 \
+             BENCH_COMPILE_CACHE="$CACHE" python bench.py 2>>"${OUT%.jsonl}.err" | tail -1)
+echo "$canary" | python -c '
+import json, sys
+row = json.loads(sys.stdin.read())
+cache = row.get("cache")
+assert cache == "hit", (
+    f"canary row is cache: {cache!r}, not \"hit\" — the forced-oracle "
+    f"program key does not match what prewarm stored (row: {row}); "
+    f"aborting before the staged rows burn the window on compiles")
+print("== canary hit (compile %ss)" % (row.get("compile_secs"),),
+      file=sys.stderr)
+' || exit 1
+echo "{\"config\": \"transformer_lm-b8-onebit-n2-canary\", \"result\": $canary}" >> "$OUT"
+
+# 3. the staged rows (fuse + control per compression strategy)
+while read -r line; do
+  eval "run $line"
+done < <(python scripts/rows.py --round r12 --sh)
+
+python scripts/merge_matrix.py "$OUT"
+cat "$OUT"
+
+# 4. closing gate: fresh rows within BENCH_REGRESS_PCT (default 10%) of
+# each label's best fresh committed reading — the window self-judges
+python scripts/bench_regress.py "$OUT" \
+    --threshold "${BENCH_REGRESS_PCT:-10}" \
+    --json "${OUT%.jsonl}_regress.json" \
+  || { echo "== bench_regress: throughput regression gate FAILED" >&2; exit 7; }

@@ -187,6 +187,19 @@ USHARD_CONFIGS = [
 ]
 
 
+# staged r12 fused-compression rows (scripts/rows.py): fuse row (Pallas
+# kernel pipeline) joined against its forced-oracle control ->
+# (fuse label, control label, strategy)
+COMPRESS_CONFIGS = [
+    ("transformer_lm-b8-onebit-n2-fuse",
+     "transformer_lm-b8-onebit-n2", "onebit"),
+    ("transformer_lm-b8-topk-n2-fuse",
+     "transformer_lm-b8-topk-n2", "topk"),
+    ("transformer_lm-b8-powersgd2-n2-fuse",
+     "transformer_lm-b8-powersgd2-n2", "powersgd2"),
+]
+
+
 # staged configs (BASELINE.json) -> (matrix row, strategy model, params key)
 CONFIGS = [
     ("alexnet-b128",      "allreduce", 4, "alexnet", 128),
@@ -453,6 +466,63 @@ def main() -> int:
                   f"{urow.get('predicted_bytes_per_chip', '--'):>11} "
                   f"{'--':>11}  (no measured r11 row yet)", file=sys.stderr)
         out["update_state_rows"].append(urow)
+    # fused-compression rows (round 12): the analytic HBM-traffic model
+    # (devprof.compress_traffic_model — the same model whose columns the
+    # r12 rows carry, evaluated here at a nominal size: the legacy/fused
+    # ratio is a ratio of linear-in-n terms, so it is size-invariant for
+    # onebit/topk and shape-ratio-driven for powersgd) joined against the
+    # measured fuse/control step-time pair.  The modeled shrink bounds the
+    # kernel win; a measured speedup below it means the exchange was not
+    # HBM-bound at this problem size, not that the kernels lost.
+    # Imported lazily AND fail-soft: the r5 watcher rehearsal runs this
+    # script from a bare scratch tree where the package is absent — the
+    # compress join is additive reporting, never a reason to crash the
+    # prediction chain.
+    try:
+        from theanompi_tpu.utils.devprof import compress_traffic_model
+    except ImportError:
+        compress_traffic_model = None
+        print("\n(compress rows skipped: theanompi_tpu not importable)",
+              file=sys.stderr)
+    out["compress_rows"] = []
+    if compress_traffic_model is not None:
+        print(f"\n{'compress row':34} {'pred shrink':>11} {'pred dec':>8} "
+              f"{'row shrink':>10} {'fuse/ctl':>9}", file=sys.stderr)
+    for label, control, strat in COMPRESS_CONFIGS:
+        if compress_traffic_model is None:
+            break
+        pred = compress_traffic_model(
+            strat.rstrip("0123456789"), 1 << 22, 2,
+            leaf_shapes=[(512, 256)] if strat.startswith("powersgd")
+            else None)
+        crow = {"config": label, "control": control, "strategy": strat,
+                "predicted": {k: pred[k] for k in
+                              ("compress_hbm_shrink",
+                               "compress_decode_shrink")} if pred else None,
+                "measured": None}
+        res, ctl = measured.get(label), measured.get(control)
+        rep = next((r for r in (res, ctl)
+                    if r and r.get("compress_hbm_shrink") is not None), None)
+        if rep:
+            crow["measured"] = {
+                k: rep.get(k)
+                for k in ("compress_hbm_bytes_legacy",
+                          "compress_hbm_bytes_fused", "compress_hbm_shrink",
+                          "compress_decode_shrink")}
+        if res and ctl and res.get("value") and ctl.get("value"):
+            crow["step_speedup"] = round(res["value"] / ctl["value"], 3)
+        if crow["measured"] is not None:
+            ps = (pred or {}).get("compress_hbm_shrink") or 0
+            print(f"{label:34} {ps:>11.3f} "
+                  f"{(pred or {}).get('compress_decode_shrink') or 0:>8.3f} "
+                  f"{crow['measured']['compress_hbm_shrink'] or 0:>10.3f} "
+                  f"{crow.get('step_speedup') or float('nan'):>9.3f}",
+                  file=sys.stderr)
+        else:
+            print(f"{label:34} "
+                  f"{(pred or {}).get('compress_hbm_shrink', '--'):>11} "
+                  f"{'--':>8}  (no measured r12 pair yet)", file=sys.stderr)
+        out["compress_rows"].append(crow)
     print(json.dumps(out, indent=1))
     return 0
 

@@ -161,6 +161,44 @@ ROWS: List[Row] = [
        BENCH_MODEL="transformer_lm", BENCH_BATCH=8, BENCH_USHARD=1,
        BENCH_CFG='{"d_model":256,"n_head":8,"n_layer":4,"seq_len":128,'
                  '"vocab":8192,"synthetic_train":64,"n_workers":4}'),
+    # -- r12: fused compression kernels (ops/compress.py, ops/factor_pack.py,
+    # docs/design.md §24).  Per compression strategy, a `fuse` row (Pallas
+    # kernel pipeline, BENCH_FUSE=1) against a control row (jnp oracle path,
+    # BENCH_FUSE=0 → THEANOMPI_TPU_NO_PALLAS=1) — identical wire bits, the
+    # step-time delta is the kernels' HBM-traffic win.  On the CPU sim both
+    # run the oracles (the rows pin wiring + the compress_traffic_report
+    # columns); the A/B lands when the hardware window reopens.
+    # scripts/predict_scaling.py joins these against the modeled shrink.
+    _r("transformer_lm-b8-onebit-n2", "r12",
+       BENCH_MODEL="transformer_lm", BENCH_BATCH=8, BENCH_STRATEGY="onebit",
+       BENCH_FUSE=0,
+       BENCH_CFG='{"d_model":256,"n_head":8,"n_layer":4,"seq_len":128,'
+                 '"vocab":8192,"synthetic_train":64,"n_workers":2}'),
+    _r("transformer_lm-b8-onebit-n2-fuse", "r12",
+       BENCH_MODEL="transformer_lm", BENCH_BATCH=8, BENCH_STRATEGY="onebit",
+       BENCH_FUSE=1,
+       BENCH_CFG='{"d_model":256,"n_head":8,"n_layer":4,"seq_len":128,'
+                 '"vocab":8192,"synthetic_train":64,"n_workers":2}'),
+    _r("transformer_lm-b8-topk-n2", "r12",
+       BENCH_MODEL="transformer_lm", BENCH_BATCH=8, BENCH_STRATEGY="topk",
+       BENCH_FUSE=0,
+       BENCH_CFG='{"d_model":256,"n_head":8,"n_layer":4,"seq_len":128,'
+                 '"vocab":8192,"synthetic_train":64,"n_workers":2}'),
+    _r("transformer_lm-b8-topk-n2-fuse", "r12",
+       BENCH_MODEL="transformer_lm", BENCH_BATCH=8, BENCH_STRATEGY="topk",
+       BENCH_FUSE=1,
+       BENCH_CFG='{"d_model":256,"n_head":8,"n_layer":4,"seq_len":128,'
+                 '"vocab":8192,"synthetic_train":64,"n_workers":2}'),
+    _r("transformer_lm-b8-powersgd2-n2", "r12",
+       BENCH_MODEL="transformer_lm", BENCH_BATCH=8,
+       BENCH_STRATEGY="powersgd2", BENCH_FUSE=0,
+       BENCH_CFG='{"d_model":256,"n_head":8,"n_layer":4,"seq_len":128,'
+                 '"vocab":8192,"synthetic_train":64,"n_workers":2}'),
+    _r("transformer_lm-b8-powersgd2-n2-fuse", "r12",
+       BENCH_MODEL="transformer_lm", BENCH_BATCH=8,
+       BENCH_STRATEGY="powersgd2", BENCH_FUSE=1,
+       BENCH_CFG='{"d_model":256,"n_head":8,"n_layer":4,"seq_len":128,'
+                 '"vocab":8192,"synthetic_train":64,"n_workers":2}'),
 ]
 
 

@@ -1287,10 +1287,10 @@ def test_injection_dropped_done_in_onebit_strategy(tmp_path):
     """Same gate on the compressed wire: strip the all_gather_done from
     OneBit's bucketed decode loop → unbalanced pair."""
     rel = _inject(tmp_path, "theanompi_tpu/parallel/strategies.py",
-                  "compress_ops.unpack_signs_weighted_sum(\n"
-                  "                all_gather_done(t), all_scales)",
-                  "compress_ops.unpack_signs_weighted_sum(\n"
-                  "                t.value, all_scales)")
+                  "compress_ops.unpack_signs_weighted_mean(\n"
+                  "                all_gather_done(t), all_scales, size)",
+                  "compress_ops.unpack_signs_weighted_mean(\n"
+                  "                t.value, all_scales, size)")
     found = core.run_lint(str(tmp_path), paths=[rel],
                           only=["collective-discipline"])
     assert any("unbalanced async collective pair" in f.message
@@ -3170,5 +3170,92 @@ def test_schema_drift_probes_stay_jax_free():
     env = dict(os.environ, TPULINT_ASSERT_NO_JAX="1")
     proc = subprocess.run(
         [sys.executable, LINT, "--only", "schema-drift", "--no-cache"],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+# ---------------------------------------------------------------------------
+# oracle-pair (ops/ Pallas kernels must keep registered, tested jnp oracles)
+# ---------------------------------------------------------------------------
+
+ORACLE_MOD_GOOD = '''
+from jax.experimental import pallas as pl
+
+
+def thing_jnp(x):
+    return x + 1
+
+
+def _thing_pallas(x):
+    return pl.pallas_call(lambda i, o: None)(x)
+
+
+PALLAS_ORACLES = {"_thing_pallas": "thing_jnp"}
+'''
+
+ORACLE_TEST_GOOD = '''
+def test_thing_pallas_matches_oracle():
+    assert _thing_pallas is not thing_jnp
+'''
+
+
+def _oracle_lint(tmp_path, mod_code, test_code=ORACLE_TEST_GOOD):
+    from theanompi_tpu.analysis.checkers import oracle_pair
+    ops = tmp_path / "theanompi_tpu" / "ops"
+    ops.mkdir(parents=True)
+    (ops / "mymod.py").write_text(mod_code)
+    tdir = tmp_path / "tests"
+    tdir.mkdir()
+    (tdir / "test_mymod.py").write_text(test_code)
+    return oracle_pair.oracle_pair_findings(str(tmp_path))
+
+
+def test_oracle_pair_good_fixture(tmp_path):
+    assert _oracle_lint(tmp_path, ORACLE_MOD_GOOD) == []
+
+
+def test_oracle_pair_missing_registry(tmp_path):
+    bad = ORACLE_MOD_GOOD.replace(
+        'PALLAS_ORACLES = {"_thing_pallas": "thing_jnp"}', "")
+    found = _oracle_lint(tmp_path, bad)
+    assert len(found) == 1 and "declares no pure-literal" in \
+        found[0].message, [f.render() for f in found]
+
+
+def test_oracle_pair_unregistered_wrapper_and_stale_entry(tmp_path):
+    # registry names a ghost wrapper while the real one goes unregistered:
+    # both directions of drift must surface
+    bad = ORACLE_MOD_GOOD.replace('{"_thing_pallas": "thing_jnp"}',
+                                  '{"_gone_pallas": "thing_jnp"}')
+    found = _oracle_lint(tmp_path, bad)
+    msgs = " | ".join(f.message for f in found)
+    assert len(found) == 2, [f.render() for f in found]
+    assert "`_thing_pallas` has no PALLAS_ORACLES entry" in msgs
+    assert "stale registry entry" in msgs
+
+
+def test_oracle_pair_oracle_not_defined(tmp_path):
+    bad = ORACLE_MOD_GOOD.replace('"thing_jnp"}', '"missing_jnp"}')
+    found = _oracle_lint(tmp_path, bad)
+    assert len(found) == 1 and "not defined in this module" in \
+        found[0].message, [f.render() for f in found]
+
+
+def test_oracle_pair_untested_pair(tmp_path):
+    # the test file references only the wrapper, never the oracle — the
+    # equality contract is unpinned even though both names exist
+    found = _oracle_lint(tmp_path, ORACLE_MOD_GOOD,
+                         "def test_x():\n    return _thing_pallas\n")
+    assert len(found) == 1 and "no tests/ file references both" in \
+        found[0].message, [f.render() for f in found]
+
+
+def test_oracle_pair_repo_is_clean_and_jax_free():
+    """The real ops/ tree must pass (every kernel paired + tested), and
+    the probe itself must never import jax — it runs inside the lint
+    CLI's backend-free process."""
+    env = dict(os.environ, TPULINT_ASSERT_NO_JAX="1")
+    proc = subprocess.run(
+        [sys.executable, LINT, "--only", "oracle-pair", "--no-cache"],
         cwd=REPO, env=env, capture_output=True, text=True, timeout=120)
     assert proc.returncode == 0, proc.stdout + proc.stderr

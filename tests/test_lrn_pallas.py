@@ -63,12 +63,17 @@ def test_general_beta_branch():
 
 
 def test_public_lrn_dispatches_to_oracle_off_tpu(monkeypatch):
+    from theanompi_tpu.ops import _pallas_util
     monkeypatch.setenv("THEANOMPI_TPU_NO_PALLAS", "1")   # force oracle path
-    x = jax.random.normal(jax.random.key(5), (2, 3, 3, 96), jnp.bfloat16)
-    got = lrn_ops.lrn(x)
-    want = lrn_ops.lrn_jnp(x, 5, 2.0, 1e-4, 0.75)
-    np.testing.assert_array_equal(np.asarray(got, np.float32),
-                                  np.asarray(want, np.float32))
+    _pallas_util.reset_dispatch_cache()   # the gate is memoized per process
+    try:
+        x = jax.random.normal(jax.random.key(5), (2, 3, 3, 96), jnp.bfloat16)
+        got = lrn_ops.lrn(x)
+        want = lrn_ops.lrn_jnp(x, 5, 2.0, 1e-4, 0.75)
+        np.testing.assert_array_equal(np.asarray(got, np.float32),
+                                      np.asarray(want, np.float32))
+    finally:
+        _pallas_util.reset_dispatch_cache()
 
 # excluded from the 870s-budgeted tier-1 gate; see pytest.ini (slow marker)
 import pytest as _pytest

@@ -233,6 +233,101 @@ def test_unpack_weighted_sum_pallas_matches_jnp_oracle():
                                np.asarray(expect), rtol=1e-6, atol=1e-6)
 
 
+@pallas_interpret_venue
+def test_encode_pallas_matches_jnp_oracle():
+    """Fused onebit encode: one error-fed read → (packed signs, |c|),
+    bit-identical to the oracle on both outputs."""
+    r = np.random.RandomState(15)
+    flat = jnp.asarray(r.randn(2 * compress.PACK_ALIGN).astype(np.float32))
+    state = jnp.asarray(r.randn(2 * compress.PACK_ALIGN).astype(np.float32))
+    packed_pl, abs_pl = compress._encode_pallas(
+        flat.reshape(-1, compress.LANES),
+        state.reshape(-1, compress.LANES), interpret=True)
+    packed_jnp, abs_jnp = compress.pack_signs_encode_jnp(flat, state)
+    np.testing.assert_array_equal(np.asarray(packed_pl),
+                                  np.asarray(packed_jnp))
+    np.testing.assert_array_equal(np.asarray(abs_pl).reshape(-1),
+                                  np.asarray(abs_jnp))
+
+
+@pallas_interpret_venue
+def test_residual_pallas_matches_jnp_oracle():
+    """Fused onebit residual: ``where(bit, |c|−scale, scale−|c|)`` from the
+    packed bits, bit-identical to the oracle (which is itself bit-exact vs
+    the unfused ``c − scale·sign`` — pinned in test_compress_fusion.py)."""
+    r = np.random.RandomState(16)
+    c = r.randn(2 * compress.PACK_ALIGN).astype(np.float32)
+    c[::97] = 0.0                    # exercise the c == 0 bit-1 convention
+    c = jnp.asarray(c)
+    packed = compress.pack_signs_jnp(c)
+    absc = jnp.abs(c)
+    scale = jnp.float32(0.37)
+    got = compress._residual_pallas(
+        absc.reshape(-1, compress.LANES), packed, scale, interpret=True)
+    expect = compress.signed_residual_jnp(absc, packed, scale)
+    np.testing.assert_array_equal(np.asarray(got).reshape(-1),
+                                  np.asarray(expect))
+
+
+@pallas_interpret_venue
+def test_topk_encode_pallas_matches_jnp_oracle():
+    """Fused topk encode: iterative-argmax selection must match lax.top_k
+    bit-for-bit on values, indices (incl. the lower-index tie-break), and
+    the in-place bf16 residual — with an all-zero row, where only explicit
+    selected-lane masking keeps the orders identical."""
+    r = np.random.RandomState(17)
+    rows, chunk, k = 3, 512, 8
+    c2 = r.randn(rows, chunk).astype(np.float32)
+    c2[1, :] = 0.0
+    c2 = jnp.asarray(c2)
+    vals_pl, idx_pl, state_pl = compress._topk_encode_pallas(
+        c2, k, interpret=True)
+    vals_jnp, idx_jnp, state_jnp = compress.topk_encode_jnp(c2, k)
+    np.testing.assert_array_equal(
+        np.asarray(vals_pl, dtype=np.float32),
+        np.asarray(vals_jnp, dtype=np.float32))
+    np.testing.assert_array_equal(np.asarray(idx_pl), np.asarray(idx_jnp))
+    np.testing.assert_array_equal(np.asarray(state_pl),
+                                  np.asarray(state_jnp))
+
+
+@pallas_interpret_venue
+def test_topk_decode_pallas_matches_jnp_oracle():
+    """Fused topk decode: VMEM block-local expand + folded /size mean vs
+    the oracle's scatter-add (same (worker asc, slot asc) accumulation
+    order per element)."""
+    r = np.random.RandomState(18)
+    w, rows, chunk, k = 3, 2, 256, 16
+    encs = [compress.topk_encode_jnp(
+        jnp.asarray(r.randn(rows, chunk).astype(np.float32)), k)
+        for _ in range(w)]
+    all_vals = jnp.stack([e[0] for e in encs])
+    all_idx = jnp.stack([e[1] for e in encs])
+    got = compress._topk_decode_pallas(all_vals, all_idx, chunk, w,
+                                       interpret=True)
+    expect = compress.topk_decode_jnp(all_vals, all_idx, chunk, size=w)
+    np.testing.assert_allclose(np.asarray(got).reshape(-1),
+                               np.asarray(expect), rtol=1e-6, atol=1e-6)
+
+
+@pallas_interpret_venue
+def test_matmul_pack_pallas_matches_jnp_oracle():
+    """Fused PowerSGD factor matmul + staging pack: the MXU tile must equal
+    ``m @ q`` with the pad rows exactly zero (the stacked-psum identity in
+    parallel/strategies.py PowerSGD rests on those zeros)."""
+    from theanompi_tpu.ops import factor_pack
+    r = np.random.RandomState(19)
+    m = jnp.asarray(r.randn(10, 64).astype(np.float32))
+    q = jnp.asarray(r.randn(64, 2).astype(np.float32))
+    rows_pad = factor_pack.pad_rows(10)
+    got = factor_pack._matmul_pack_pallas(m, q, rows_pad, interpret=True)
+    expect = factor_pack.matmul_pack_jnp(m, q, rows_pad)
+    assert got.shape == (rows_pad, 2)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(expect),
+                               rtol=1e-6, atol=1e-6)
+    np.testing.assert_array_equal(np.asarray(got)[10:], 0.0)
+
+
 def test_unpack_weighted_sum_oracle():
     r = np.random.RandomState(9)
     c = r.randn(3, compress.PACK_ALIGN).astype(np.float32)

@@ -9,7 +9,9 @@ runs on the engine's thread-role inference; the protocol pass
 (wire-contract, retry-safety, state-machine) runs on the declared
 endpoint model (``analysis/protocol.py``, docs/design.md §21);
 compat-boundary and telemetry-hot-path stay per-file (their invariants
-are lexical); schema-drift is the live-object project probe.
+are lexical); schema-drift is the live-object project probe, and
+oracle-pair is the disk-scoped project probe pinning every ops/ Pallas
+kernel to a registered jnp oracle with an equality test.
 """
 
 from . import (  # noqa: F401
@@ -18,6 +20,7 @@ from . import (  # noqa: F401
     donation_safety,
     exchange_symmetry,
     host_concurrency,
+    oracle_pair,
     protocol_conformance,
     rng_discipline,
     schema_drift,

@@ -1,0 +1,193 @@
+"""oracle-pair: every Pallas kernel in ops/ keeps its jnp oracle honest.
+
+The house kernel pattern (docs/design.md §24, ops/compress.py module
+docstring) is a PAIR: a ``pl.pallas_call`` wrapper plus a pure-jnp
+oracle with the identical bit layout, registered in the module's
+``PALLAS_ORACLES`` dict and pinned equal by an interpret-mode test.
+The oracle is not documentation — it IS the non-TPU dispatch target
+(``_pallas_util.dispatch_pallas``), so an unregistered kernel is a
+kernel whose CPU/forced-oracle path silently diverges from what TPUs
+run, and an untested pair is a bit-layout contract nobody checks.
+
+This checker closes the loop statically, jax-free:
+
+* every function in ``theanompi_tpu/ops/*.py`` that issues a
+  ``pl.pallas_call`` must have an entry in that module's top-level
+  ``PALLAS_ORACLES`` dict (a pure literal, parsed with
+  ``ast.literal_eval``);
+* the named oracle must be a function defined in the same module;
+* some file under ``tests/`` must reference BOTH names (the
+  interpret-mode equality test — matched lexically by word boundary);
+* a registry entry naming a function with no ``pl.pallas_call`` is
+  stale and flagged too, so the dict cannot rot into folklore.
+
+PROJECT-scoped on purpose: the ops modules and the test tree are read
+from DISK (glob under the repo root), not from the file list the run
+was invoked on — ``scripts/lint.py --diff`` passes only changed files,
+and deleting a test must fail the gate even when no ops file changed.
+"""
+
+from __future__ import annotations
+
+import ast
+import glob
+import os
+import re
+from typing import Dict, List, Optional, Tuple
+
+from ..core import Checker, Finding, SourceFile, register
+
+OPS_GLOB = os.path.join("theanompi_tpu", "ops", "*.py")
+TESTS_GLOB = os.path.join("tests", "*.py")
+PALLAS_CALL = "jax.experimental.pallas.pallas_call"
+REGISTRY_NAME = "PALLAS_ORACLES"
+
+
+def _repo_root() -> str:
+    return os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__)))))
+
+
+def _pallas_sites(sf: SourceFile) -> List[Tuple[Optional[str], int]]:
+    """``(innermost enclosing function name, line)`` of every
+    ``pl.pallas_call`` call in the module — resolved through the shared
+    import resolver, so an aliased ``from jax.experimental import
+    pallas as p`` still counts."""
+    sites: List[Tuple[Optional[str], int]] = []
+
+    def visit(node: ast.AST, fn: Optional[str]) -> None:
+        for child in ast.iter_child_nodes(node):
+            inner = fn
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                inner = child.name
+            if isinstance(child, ast.Call) and \
+                    sf.resolver.resolve(child.func) == PALLAS_CALL:
+                sites.append((fn, child.lineno))
+            visit(child, inner)
+
+    visit(sf.tree, None)
+    return sites
+
+
+def _registry(sf: SourceFile) -> Tuple[Optional[Dict[str, str]], int]:
+    """The module's top-level ``PALLAS_ORACLES`` literal and its line —
+    ``(None, 1)`` when absent, ``(None, line)`` when present but not a
+    pure ``{str: str}`` literal (flagged by the caller)."""
+    for node in sf.tree.body:
+        targets = node.targets if isinstance(node, ast.Assign) else \
+            [node.target] if isinstance(node, ast.AnnAssign) else []
+        if not any(isinstance(t, ast.Name) and t.id == REGISTRY_NAME
+                   for t in targets):
+            continue
+        try:
+            val = ast.literal_eval(node.value)
+        except (ValueError, TypeError):
+            return None, node.lineno
+        if isinstance(val, dict) and all(
+                isinstance(k, str) and isinstance(v, str)
+                for k, v in val.items()):
+            return val, node.lineno
+        return None, node.lineno
+    return None, 1
+
+
+def _module_functions(sf: SourceFile) -> set:
+    return {n.name for n in ast.walk(sf.tree)
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))}
+
+
+def _test_files_referencing(root: str) -> List[Tuple[str, str]]:
+    """``(relpath, text)`` of every test module on disk."""
+    out = []
+    for path in sorted(glob.glob(os.path.join(root, TESTS_GLOB))):
+        try:
+            with open(path, encoding="utf-8") as f:
+                out.append((os.path.relpath(path, root).replace(os.sep, "/"),
+                            f.read()))
+        except OSError:
+            continue
+    return out
+
+
+def _referenced(name: str, texts: List[Tuple[str, str]]) -> List[str]:
+    pat = re.compile(r"(?<![\w])%s(?![\w])" % re.escape(name))
+    return [rel for rel, text in texts if pat.search(text)]
+
+
+def oracle_pair_findings(root: str, check_name: str = "oracle-pair"
+                         ) -> List[Finding]:
+    """The whole audit, parameterized on the repo root so tests can run
+    it against synthetic tmp_path trees (the schema_drift helper
+    pattern)."""
+    findings: List[Finding] = []
+    tests = _test_files_referencing(root)
+    for path in sorted(glob.glob(os.path.join(root, OPS_GLOB))):
+        rel = os.path.relpath(path, root).replace(os.sep, "/")
+        try:
+            sf = SourceFile(root, rel)
+        except (SyntaxError, OSError):
+            continue       # the parse step reports it already
+        sites = _pallas_sites(sf)
+        registry, reg_line = _registry(sf)
+        if not sites and registry is None:
+            continue
+        if registry is None:
+            findings.append(Finding(
+                check_name, rel, reg_line if reg_line > 1 else
+                (sites[0][1] if sites else 1), 0,
+                f"module issues pl.pallas_call but declares no "
+                f"pure-literal {REGISTRY_NAME} dict mapping each "
+                f"kernel wrapper to its jnp oracle"))
+            continue
+        defined = _module_functions(sf)
+        wrappers = {fn for fn, _ in sites if fn}
+        for fn, line in sites:
+            if fn is None:
+                findings.append(Finding(
+                    check_name, rel, line, 0,
+                    "pl.pallas_call at module scope — wrap it in a "
+                    "function so it can be oracle-paired"))
+            elif fn not in registry:
+                findings.append(Finding(
+                    check_name, rel, line, 0,
+                    f"pl.pallas_call wrapper `{fn}` has no "
+                    f"{REGISTRY_NAME} entry — its non-TPU dispatch "
+                    f"path is unpinned"))
+        for wrapper, oracle in sorted(registry.items()):
+            if wrapper not in wrappers:
+                findings.append(Finding(
+                    check_name, rel, reg_line, 0,
+                    f"{REGISTRY_NAME} entry `{wrapper}` names no "
+                    f"function issuing pl.pallas_call in this module "
+                    f"— stale registry entry"))
+                continue
+            if oracle not in defined:
+                findings.append(Finding(
+                    check_name, rel, reg_line, 0,
+                    f"{REGISTRY_NAME} maps `{wrapper}` to `{oracle}`, "
+                    f"which is not defined in this module"))
+                continue
+            if tests and not set(_referenced(wrapper, tests)) & \
+                    set(_referenced(oracle, tests)):
+                findings.append(Finding(
+                    check_name, rel, reg_line, 0,
+                    f"no tests/ file references both `{wrapper}` and "
+                    f"`{oracle}` — the kernel/oracle bit-layout "
+                    f"contract has no interpret-mode equality test"))
+    return findings
+
+
+@register
+class OraclePairChecker(Checker):
+    name = "oracle-pair"
+    description = ("every pl.pallas_call wrapper in ops/ must be "
+                   "registered in its module's PALLAS_ORACLES dict, the "
+                   "named jnp oracle must exist in the same module, and "
+                   "a tests/ file must reference both (interpret-mode "
+                   "equality test) — the oracle is the non-TPU dispatch "
+                   "target, so an unpaired kernel diverges silently")
+    reads_files = False    # disk-scoped project probe: --diff safe
+
+    def check_project(self, files) -> List[Finding]:
+        return oracle_pair_findings(
+            files[0].root if files else _repo_root(), self.name)

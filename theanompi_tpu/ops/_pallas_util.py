@@ -7,14 +7,36 @@ import os
 import jax
 
 
+# Memoized dispatch decision: the env lookup + backend probe run once per
+# process, not once per op call (the compressed exchanger consults this on
+# every encode/decode inside traced code, where a surprise os.environ read
+# per call is pure overhead).  None = not yet decided.
+_DISPATCH_MEMO: bool | None = None
+
+
 def dispatch_pallas() -> bool:
     """Compiled Pallas on TPU; elsewhere the jnp oracle (same semantics,
     equality-tested) — interpret-mode Pallas can't run inside shard_map's
     vma-checked trace, so it is reserved for the direct kernel tests.
-    ``THEANOMPI_TPU_NO_PALLAS=1`` forces the oracle everywhere."""
-    if os.environ.get("THEANOMPI_TPU_NO_PALLAS", "0") == "1":
-        return False
-    return jax.default_backend() == "tpu"
+    ``THEANOMPI_TPU_NO_PALLAS=1`` forces the oracle everywhere.
+
+    The decision is cached per process; tests that flip the env var must
+    call :func:`reset_dispatch_cache` after ``monkeypatch.setenv``.
+    """
+    global _DISPATCH_MEMO
+    if _DISPATCH_MEMO is None:
+        if os.environ.get("THEANOMPI_TPU_NO_PALLAS", "0") == "1":
+            _DISPATCH_MEMO = False
+        else:
+            _DISPATCH_MEMO = jax.default_backend() == "tpu"
+    return _DISPATCH_MEMO
+
+
+def reset_dispatch_cache() -> None:
+    """Drop the memoized dispatch decision (for tests that toggle
+    ``THEANOMPI_TPU_NO_PALLAS`` mid-process)."""
+    global _DISPATCH_MEMO
+    _DISPATCH_MEMO = None
 
 
 def vma_of(*xs) -> frozenset:

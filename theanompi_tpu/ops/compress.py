@@ -1,4 +1,4 @@
-"""Sign bit-pack/unpack kernels for the compressed exchanger.
+"""Compression kernels for the compressed exchanger (onebit + topk).
 
 TPU-native successor to the reference's in-repo native code: Theano-MPI's
 ``Exch_asa16``/``Exch_copper16`` compiled inline fp32↔fp16 CUDA kernels at
@@ -9,6 +9,28 @@ kernel language), with a pure-jnp implementation in the identical bit layout
 kept as the numerical oracle and as the dispatch target on non-TPU backends
 (and under ``THEANOMPI_TPU_NO_PALLAS=1``).  The kernel unit tests run the
 Pallas pair in interpret mode against the oracle bit-for-bit.
+
+Beyond the original sign pack/unpack pair, this module carries the fused
+single-pass pipelines (docs/design.md §24):
+
+* **onebit encode** (:func:`pack_signs_encode`): per 256×128 block, read the
+  gradient and the error state once, form ``c = flat + state`` in VMEM, and
+  emit BOTH the packed sign tile and ``|c|`` — ``c`` itself never exists in
+  HBM.  The follow-up :func:`signed_residual` turns ``|c|`` + packed bits +
+  the scalar scale into the new error state ``c − scale·sign(c)`` in one more
+  pass (bit-identical to the unfused formula; see the oracle's docstring).
+* **onebit decode** (:func:`unpack_signs_weighted_mean`): the decode+weighted
+  accumulate with the ``/size`` mean folded into the per-worker scales, so the
+  full-length division pass disappears.
+* **topk encode/decode** (:func:`topk_encode` / :func:`topk_decode`): chunk-row
+  kernels fusing the |c| top-k select, bf16 value cast, int16 offset emit and
+  in-place residual write (encode), and the expansion of every worker's
+  (vals, idx) rows into the dense chunk row block-locally in VMEM (decode),
+  replacing the serialized HBM scatter XLA makes of ``.at[idx].add``.
+
+Every ``pl.pallas_call`` wrapper here is paired with its jnp oracle in
+:data:`PALLAS_ORACLES`; the tpulint ``oracle-pair`` checker enforces the
+pairing and the existence of an equality test.
 
 Wire format (internal contract between :func:`pack_signs` and the unpackers —
 chosen for TPU tiling, NOT byte-compatible with anything external):
@@ -87,6 +109,76 @@ def unpack_signs_weighted_sum_jnp(all_packed: jnp.ndarray,
     return jnp.sum(decoded * scales.reshape(w, 1), axis=0)
 
 
+def pack_signs_encode_jnp(flat: jnp.ndarray, state: jnp.ndarray):
+    """Oracle for the fused onebit encode: ``c = flat + state`` →
+    (packed signs of c, |c|).  Same packed bit layout as
+    :func:`pack_signs_jnp` applied to the materialized sum."""
+    c = flat + state
+    return pack_signs_jnp(c), jnp.abs(c)
+
+
+def signed_residual_jnp(absc: jnp.ndarray, packed: jnp.ndarray,
+                        scale: jnp.ndarray) -> jnp.ndarray:
+    """Oracle for the fused residual: reconstruct ``c − scale·sign(c)`` from
+    ``|c|`` and the packed sign bits.
+
+    Bit-exact equivalence with the unfused ``c − scale·sign(where(c==0,1,c))``
+    (the packed bit for c == 0 is 1, matching that ``where``):
+
+    * c ≥ 0 (bit 1): ``c − scale·(+1) = c ⊖ scale = |c| ⊖ scale``  (|c| = c).
+    * c < 0 (bit 0): ``c − scale·(−1) = c ⊕ scale = scale ⊖ |c|`` — IEEE
+      ``x ⊖ y`` is ``x ⊕ (−y)`` with an exact sign flip, and |c| = −c exactly.
+    """
+    sign_pos = unpack_signs_jnp(packed) > 0
+    return jnp.where(sign_pos, absc - scale, scale - absc)
+
+
+def unpack_signs_weighted_mean_jnp(all_packed: jnp.ndarray,
+                                   scales: jnp.ndarray,
+                                   size: int) -> jnp.ndarray:
+    """Oracle: decode + weighted accumulate with the ``/size`` mean folded
+    into the scales — ``Σ_w (scales[w]/size)·signs[w]``.  The full-length
+    division pass of the unfused ``sum/size`` becomes a [w]-length one."""
+    return unpack_signs_weighted_sum_jnp(all_packed, scales / jnp.float32(size))
+
+
+def topk_encode_jnp(c2: jnp.ndarray, k: int):
+    """Oracle for the fused topk encode: per chunk row of ``c2`` [rows, chunk]
+    select the k largest-|·| entries, cast to the wire dtypes, and write the
+    bf16 rounding residual back in place.
+
+    Returns ``(wire_vals bf16 [rows, k], wire_idx int16 [rows, k],
+    new_c2 f32 [rows, chunk])``.  Tie-break follows ``lax.top_k``: equal
+    magnitudes pick the lower index first.
+    """
+    rows = c2.shape[0]
+    _, idx = jax.lax.top_k(jnp.abs(c2), k)                 # [rows, k]
+    vals = jnp.take_along_axis(c2, idx, axis=1)            # f32 [rows, k]
+    wire_vals = vals.astype(jnp.bfloat16)
+    wire_idx = idx.astype(jnp.int16)
+    residual = vals - wire_vals.astype(jnp.float32)
+    r = jnp.arange(rows)[:, None]
+    new_c2 = c2.at[r, idx].set(residual)
+    return wire_vals, wire_idx, new_c2
+
+
+def topk_decode_jnp(all_vals: jnp.ndarray, all_idx: jnp.ndarray,
+                    chunk: int, size: int = 1) -> jnp.ndarray:
+    """Oracle for the fused topk decode: expand every worker's (vals, idx)
+    chunk rows into the dense vector — dense[r·chunk + idx] += val summed
+    over workers, divided by ``size`` (the worker mean folded into the
+    decode so no full-length division pass follows; ``acc / size`` per
+    element is bit-identical to dividing the assembled dense vector).
+    [w, rows, k] bf16/int16 → f32 [rows·chunk]."""
+    w, rows, k = all_vals.shape
+    base = (jnp.arange(rows, dtype=jnp.int32) * chunk).reshape(1, rows, 1)
+    gidx = all_idx.astype(jnp.int32) + base                # [w, rows, k]
+    dense = jnp.zeros((rows * chunk,), jnp.float32)
+    dense = dense.at[gidx.reshape(-1)].add(
+        all_vals.astype(jnp.float32).reshape(-1))
+    return dense / jnp.float32(size) if size != 1 else dense
+
+
 # ---------------------------------------------------------------------------
 # Pallas kernels
 # ---------------------------------------------------------------------------
@@ -160,6 +252,170 @@ def _unpack_wsum_pallas(all_packed: jnp.ndarray, scales: jnp.ndarray,
     )(all_packed, scales)
 
 
+def _encode_kernel(flat_ref, state_ref, packed_ref, abs_ref):
+    """(256, 128) f32 flat + state blocks → (8, 128) u32 packed + (256, 128)
+    f32 |c|.  ``c = flat + state`` lives only in VMEM registers: the fused
+    encode reads the error-fed vector once and never writes ``c`` to HBM."""
+    word = jnp.zeros((_WORDS_PER_BLOCK, LANES), jnp.uint32)
+    for b in range(32):
+        c = flat_ref[8 * b:8 * (b + 1), :] + state_ref[8 * b:8 * (b + 1), :]
+        word = word | ((c >= 0).astype(jnp.uint32) << np.uint32(b))
+        abs_ref[8 * b:8 * (b + 1), :] = jnp.abs(c)
+    packed_ref[:] = word
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def _encode_pallas(flat2d: jnp.ndarray, state2d: jnp.ndarray,
+                   interpret: bool):
+    nb = flat2d.shape[0] // BLOCK_ROWS
+    vma = _vma_of(flat2d, state2d)
+    block_in = pl.BlockSpec((BLOCK_ROWS, LANES), lambda j: (j, 0),
+                            memory_space=pltpu.VMEM)
+    return pl.pallas_call(
+        _encode_kernel,
+        grid=(nb,),
+        in_specs=[block_in, block_in],
+        out_specs=[
+            pl.BlockSpec((_WORDS_PER_BLOCK, LANES), lambda j: (j, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((BLOCK_ROWS, LANES), lambda j: (j, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((nb * _WORDS_PER_BLOCK, LANES), jnp.uint32,
+                                 vma=vma),
+            jax.ShapeDtypeStruct((nb * BLOCK_ROWS, LANES), jnp.float32,
+                                 vma=vma),
+        ],
+        interpret=interpret,
+    )(flat2d, state2d)
+
+
+def _residual_kernel(abs_ref, packed_ref, scale_ref, out_ref):
+    """(256, 128) f32 |c| + (8, 128) u32 packed + SMEM scale →
+    (256, 128) f32 residual ``c − scale·sign(c)``, recovered branch-free as
+    ``where(bit, |c| − scale, scale − |c|)`` (bit-exact; see the oracle)."""
+    scale = scale_ref[0]
+    for b in range(32):
+        bit = (packed_ref[:] >> np.uint32(b)) & np.uint32(1)
+        a = abs_ref[8 * b:8 * (b + 1), :]
+        out_ref[8 * b:8 * (b + 1), :] = jnp.where(bit == 1, a - scale,
+                                                  scale - a)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def _residual_pallas(abs2d: jnp.ndarray, packed: jnp.ndarray,
+                     scale: jnp.ndarray, interpret: bool) -> jnp.ndarray:
+    nb = abs2d.shape[0] // BLOCK_ROWS
+    return pl.pallas_call(
+        _residual_kernel,
+        grid=(nb,),
+        in_specs=[
+            pl.BlockSpec((BLOCK_ROWS, LANES), lambda j: (j, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((_WORDS_PER_BLOCK, LANES), lambda j: (j, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+        ],
+        out_specs=pl.BlockSpec((BLOCK_ROWS, LANES), lambda j: (j, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((nb * BLOCK_ROWS, LANES), jnp.float32,
+                                       vma=_vma_of(abs2d, packed, scale)),
+        interpret=interpret,
+    )(abs2d, packed, scale.reshape(1).astype(jnp.float32))
+
+
+def _make_topk_encode_kernel(k: int, chunk: int):
+    def kernel(c_ref, vals_ref, idx_ref, state_ref):
+        """One chunk row per grid step: iterative argmax over |row| (first
+        max index == lax.top_k's lower-index tie-break), emitting the bf16
+        wire value, int16 chunk-local offset, and the in-place bf16 rounding
+        residual — all from one VMEM-resident copy of the row."""
+        lanes = jax.lax.broadcasted_iota(jnp.int32, (1, chunk), 1)
+
+        def body(j, carry):
+            cur, amask = carry
+            m = jnp.max(amask)
+            # First lane attaining the max: ties pick the lowest index,
+            # matching lax.top_k's ordering in the oracle.
+            idx = jnp.min(jnp.where(amask == m, lanes, chunk))
+            v = jnp.sum(jnp.where(lanes == idx, cur, 0.0))
+            wv = v.astype(jnp.bfloat16)
+            pl.store(vals_ref, (0, pl.dslice(j, 1)), wv.reshape(1, 1))
+            pl.store(idx_ref, (0, pl.dslice(j, 1)),
+                     idx.astype(jnp.int16).reshape(1, 1))
+            hit = lanes == idx
+            cur = jnp.where(hit, v - wv.astype(jnp.float32), cur)
+            # Selected lanes leave the running argmax for good: |·| ≥ 0, so
+            # −1 can never win again (relying on the residual being small
+            # would diverge from top_k on all-zero rows).
+            amask = jnp.where(hit, jnp.float32(-1.0), amask)
+            return cur, amask
+
+        row = c_ref[:]
+        cur, _ = jax.lax.fori_loop(0, k, body, (row, jnp.abs(row)))
+        state_ref[:] = cur
+    return kernel
+
+
+@functools.partial(jax.jit, static_argnames=("k", "interpret"))
+def _topk_encode_pallas(c2: jnp.ndarray, k: int, interpret: bool):
+    rows, chunk = c2.shape
+    vma = _vma_of(c2)
+    row_spec = lambda shape: pl.BlockSpec((1, shape), lambda j: (j, 0),
+                                          memory_space=pltpu.VMEM)
+    return pl.pallas_call(
+        _make_topk_encode_kernel(k, chunk),
+        grid=(rows,),
+        in_specs=[row_spec(chunk)],
+        out_specs=[row_spec(k), row_spec(k), row_spec(chunk)],
+        out_shape=[
+            jax.ShapeDtypeStruct((rows, k), jnp.bfloat16, vma=vma),
+            jax.ShapeDtypeStruct((rows, k), jnp.int16, vma=vma),
+            jax.ShapeDtypeStruct((rows, chunk), jnp.float32, vma=vma),
+        ],
+        interpret=interpret,
+    )(c2)
+
+
+def _make_topk_decode_kernel(n_workers: int, k: int, chunk: int, size: int):
+    def kernel(vals_ref, idx_ref, out_ref):
+        """All workers' (vals, idx) for one chunk row → the dense row,
+        accumulated block-locally in VMEM in (worker asc, slot asc) order —
+        the same per-element order as the flattened ``.at[gidx].add`` scatter
+        the oracle performs, with no serialized HBM scatter anywhere.  The
+        ``/size`` worker mean rides the final store."""
+        lanes = jax.lax.broadcasted_iota(jnp.int32, (1, chunk), 1)
+        acc = jnp.zeros((1, chunk), jnp.float32)
+        for w in range(n_workers):
+            def body(j, acc, w=w):
+                v = pl.load(vals_ref, (w, 0, pl.dslice(j, 1)))
+                i = pl.load(idx_ref, (w, 0, pl.dslice(j, 1)))
+                hit = lanes == i.astype(jnp.int32).reshape(1, 1)
+                return acc + jnp.where(hit, v.astype(jnp.float32), 0.0)
+            acc = jax.lax.fori_loop(0, k, body, acc)
+        out_ref[:] = acc / jnp.float32(size) if size != 1 else acc
+    return kernel
+
+
+@functools.partial(jax.jit, static_argnames=("chunk", "size", "interpret"))
+def _topk_decode_pallas(all_vals: jnp.ndarray, all_idx: jnp.ndarray,
+                        chunk: int, size: int, interpret: bool) -> jnp.ndarray:
+    w, rows, k = all_vals.shape
+    wire_spec = pl.BlockSpec((w, 1, k), lambda j: (0, j, 0),
+                             memory_space=pltpu.VMEM)
+    return pl.pallas_call(
+        _make_topk_decode_kernel(w, k, chunk, size),
+        grid=(rows,),
+        in_specs=[wire_spec, wire_spec],
+        out_specs=pl.BlockSpec((1, chunk), lambda j: (j, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((rows, chunk), jnp.float32,
+                                       vma=_vma_of(all_vals, all_idx)),
+        interpret=interpret,
+    )(all_vals, all_idx)
+
+
 # ---------------------------------------------------------------------------
 # Public API (dispatching)
 # ---------------------------------------------------------------------------
@@ -198,3 +454,72 @@ def unpack_signs_weighted_sum(all_packed: jnp.ndarray,
         return unpack_signs_weighted_sum_jnp(all_packed, scales)
     return _unpack_wsum_pallas(
         all_packed, scales.astype(jnp.float32), False).reshape(-1)
+
+
+def pack_signs_encode(flat: jnp.ndarray, state: jnp.ndarray):
+    """Fused onebit encode: ``c = flat + state`` formed in VMEM, returning
+    ``(packed signs of c, |c|)`` — one read of each input, no HBM copy of
+    ``c``.  Both 1-D inputs must share a length % PACK_ALIGN == 0."""
+    n = flat.shape[0]
+    _check_len(n)
+    if not _dispatch_pallas():
+        return pack_signs_encode_jnp(flat, state)
+    packed, abs2d = _encode_pallas(flat.reshape(n // LANES, LANES),
+                                   state.reshape(n // LANES, LANES), False)
+    return packed, abs2d.reshape(-1)
+
+
+def signed_residual(absc: jnp.ndarray, packed: jnp.ndarray,
+                    scale: jnp.ndarray) -> jnp.ndarray:
+    """New onebit error state ``c − scale·sign(c)`` from ``|c|`` + packed
+    sign bits + the scalar scale (bit-exact vs the unfused formula)."""
+    n = absc.shape[0]
+    _check_len(n)
+    if not _dispatch_pallas():
+        return signed_residual_jnp(absc, packed, scale)
+    return _residual_pallas(absc.reshape(n // LANES, LANES), packed,
+                            scale, False).reshape(-1)
+
+
+def unpack_signs_weighted_mean(all_packed: jnp.ndarray, scales: jnp.ndarray,
+                               size: int) -> jnp.ndarray:
+    """Decode ``[n_workers, m, 128]`` packed buffers into the worker-mean
+    ``Σ_w (scales[w]/size)·signs[w]`` — the ``/size`` folded into the [w]
+    scale vector so no full-length division pass follows the decode."""
+    ws = scales.astype(jnp.float32) / jnp.float32(size)
+    if not _dispatch_pallas():
+        return unpack_signs_weighted_sum_jnp(all_packed, ws)
+    return _unpack_wsum_pallas(all_packed, ws, False).reshape(-1)
+
+
+def topk_encode(c2: jnp.ndarray, k: int):
+    """Fused topk encode of ``c2`` [rows, chunk]: per chunk row, the k
+    largest-|·| entries as ``(bf16 vals, int16 offsets)`` plus the new error
+    state with the bf16 rounding residual written in place."""
+    if not _dispatch_pallas():
+        return topk_encode_jnp(c2, k)
+    return _topk_encode_pallas(c2, k, False)
+
+
+def topk_decode(all_vals: jnp.ndarray, all_idx: jnp.ndarray,
+                chunk: int, size: int = 1) -> jnp.ndarray:
+    """Fused topk decode: all workers' ``[w, rows, k]`` wire rows expanded
+    and summed into the dense f32 ``[rows·chunk]`` vector block-locally (no
+    serialized HBM scatter), with the ``/size`` worker mean folded in."""
+    if not _dispatch_pallas():
+        return topk_decode_jnp(all_vals, all_idx, chunk, size)
+    return _topk_decode_pallas(all_vals, all_idx, chunk, size,
+                               False).reshape(-1)
+
+
+# pallas_call wrapper → jnp oracle pairing, enforced by the tpulint
+# ``oracle-pair`` checker (every wrapper must appear here, every oracle must
+# be defined in this module, and a test must reference both).
+PALLAS_ORACLES = {
+    "_pack_pallas": "pack_signs_jnp",
+    "_unpack_wsum_pallas": "unpack_signs_weighted_sum_jnp",
+    "_encode_pallas": "pack_signs_encode_jnp",
+    "_residual_pallas": "signed_residual_jnp",
+    "_topk_encode_pallas": "topk_encode_jnp",
+    "_topk_decode_pallas": "topk_decode_jnp",
+}

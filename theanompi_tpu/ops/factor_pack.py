@@ -1,0 +1,107 @@
+"""PowerSGD factor matmuls fused with the collective staging pack.
+
+PowerSGD ships per-leaf low-rank factors (``P = Mp @ Q``, ``Qn = Mpᵀ @ Ph``,
+arXiv:1905.13727).  Unfused, every leaf's small matmul lands in its own HBM
+buffer and a separate flatten/pad/concat pass assembles the collective's
+staging buffer — one extra round-trip per factor per step.  The fused kernel
+here emits each factor tile already padded to the staging row alignment, so
+the MXU output IS the staging slice: the strategy concatenates the padded
+tiles and issues ONE psum for every compressible leaf's factors instead of
+one collective per leaf (``parallel/strategies.py`` PowerSGD).
+
+House pattern (docs/design.md §24): pure-jnp oracle :func:`matmul_pack_jnp`
+with the identical layout as the non-TPU dispatch target, interpret-mode
+equality test, ``vma_of`` for shard_map vma propagation, dispatch gated by
+``THEANOMPI_TPU_NO_PALLAS``.  The padded rows are zeros, so a psum over the
+staging buffer is elementwise identical to the per-leaf psums it replaces.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from ._pallas_util import dispatch_pallas as _dispatch_pallas
+from ._pallas_util import vma_of as _vma_of
+
+# Factor tiles are padded to the fp32 sublane multiple so every slice of the
+# concatenated staging buffer stays tile-aligned.
+_SUBLANE = 8
+# Grid block over the output rows; the contraction dim rides whole in VMEM
+# (PowerSGD leaves have cols ≤ a few thousand — far under the VMEM budget).
+ROW_BLOCK = 256
+
+
+def pad_rows(rows: int) -> int:
+    """Staging row count for a factor with ``rows`` true rows."""
+    return -(-rows // _SUBLANE) * _SUBLANE
+
+
+def matmul_pack_jnp(m: jnp.ndarray, q: jnp.ndarray,
+                    rows_pad: int) -> jnp.ndarray:
+    """Oracle: ``m @ q`` zero-padded to ``[rows_pad, rank]`` — the staging
+    slice layout the kernel emits directly from the MXU."""
+    p = m @ q
+    return jnp.pad(p, ((0, rows_pad - p.shape[0]), (0, 0)))
+
+
+def _make_matmul_pack_kernel(rows: int, block_rows: int):
+    def kernel(m_ref, q_ref, out_ref):
+        """(block, cols) f32 × (cols, rank) f32 → (block, rank) f32 staging
+        tile, rows ≥ the true row count zeroed so the downstream psum of the
+        concatenated staging buffer matches the per-leaf psums exactly."""
+        j = pl.program_id(0)
+        p = jnp.dot(m_ref[:], q_ref[:], preferred_element_type=jnp.float32)
+        rid = j * block_rows + jax.lax.broadcasted_iota(
+            jnp.int32, (block_rows, 1), 0)
+        out_ref[:] = jnp.where(rid < rows, p, 0.0)
+    return kernel
+
+
+@functools.partial(jax.jit, static_argnames=("rows_pad", "interpret"))
+def _matmul_pack_pallas(m: jnp.ndarray, q: jnp.ndarray, rows_pad: int,
+                        interpret: bool) -> jnp.ndarray:
+    rows, cols = m.shape
+    rank = q.shape[1]
+    block = min(ROW_BLOCK, rows_pad)
+    nb = -(-rows_pad // block)
+    return pl.pallas_call(
+        _make_matmul_pack_kernel(rows, block),
+        grid=(nb,),
+        in_specs=[
+            pl.BlockSpec((block, cols), lambda j: (j, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((cols, rank), lambda j: (0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((block, rank), lambda j: (j, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((rows_pad, rank), jnp.float32,
+                                       vma=_vma_of(m, q)),
+        interpret=interpret,
+    )(m, q)
+
+
+def matmul_pack(m: jnp.ndarray, q: jnp.ndarray,
+                rows_pad: int | None = None) -> jnp.ndarray:
+    """``m [rows, cols] @ q [cols, rank]`` emitted as a zero-padded
+    ``[rows_pad, rank]`` staging tile (``rows_pad`` defaults to the sublane
+    round-up of ``rows``).  For the Q-side factor pass callers hand in the
+    transposed operand (``matmul_pack(Mp.T, Ph, ...)``)."""
+    rows = m.shape[0]
+    if rows_pad is None:
+        rows_pad = pad_rows(rows)
+    assert rows_pad >= rows and rows_pad % _SUBLANE == 0, (rows, rows_pad)
+    if not _dispatch_pallas():
+        return matmul_pack_jnp(m, q, rows_pad)
+    return _matmul_pack_pallas(m, q, rows_pad, False)
+
+
+# pallas_call wrapper → jnp oracle pairing (tpulint ``oracle-pair`` checker).
+PALLAS_ORACLES = {
+    "_matmul_pack_pallas": "matmul_pack_jnp",
+}

@@ -195,3 +195,11 @@ def lrn(x: jnp.ndarray, n: int = 5, k: float = 2.0, alpha: float = 1e-4,
     if _dispatch_pallas():
         return _lrn_tpu(x, n, float(k), float(alpha), float(beta))
     return lrn_jnp(x, n, k, alpha, beta)
+
+
+# pallas_call wrapper → jnp oracle pairing (tpulint ``oracle-pair`` checker).
+# The bwd kernel's oracle is jax.grad of lrn_jnp, so both map to it.
+PALLAS_ORACLES = {
+    "_lrn_fwd_pallas": "lrn_jnp",
+    "_lrn_bwd_pallas": "lrn_jnp",
+}

@@ -265,30 +265,38 @@ class OneBit(Strategy):
     def __call__(self, tree, state, *, axis: str, size: int):
         flat = helper_funcs.flatten_tree(
             tree, pad_to_multiple_of=compress_ops.PACK_ALIGN)
-        c = flat + state
-        scale = jnp.mean(jnp.abs(c)) + 1e-12
-        new_state = c - scale * jnp.sign(jnp.where(c == 0, 1.0, c))
+        n_true = helper_funcs.tree_size(tree)
+        # fused encode: c = flat + state is formed in VMEM and emits the
+        # packed sign tiles AND |c| in one pass — c itself never lands in
+        # HBM (ops/compress.py pack_signs_encode; jnp oracle elsewhere)
+        packed, absc = compress_ops.pack_signs_encode(flat, state)
+        # scale over the TRUE length only: the PACK_ALIGN zero pad would
+        # deflate mean(|c|) by up to pad/n
+        scale = jnp.mean(absc[:n_true]) + 1e-12
+        # new error state from |c| + sign bits + scale, bit-exact vs the
+        # unfused c − scale·sign(c)
+        new_state = compress_ops.signed_residual(absc, packed, scale)
         all_scales = lax.all_gather(scale, axis)       # [size] — one scalar
         if self.bucket_bytes > 0:
-            # per-bucket wire: pack+gather each PACK_ALIGN-aligned slice
-            # of the error-fed vector as its own async all-gather pair
-            # (all starts before the first done), decode per bucket with
-            # the GLOBAL scale — the scale is one mean over the whole
-            # vector in both modes, so bucketing stays bit-identical
-            n = c.shape[0]
+            # per-bucket wire: the vector is packed ONCE and each bucket
+            # all-gathers its PACK_ALIGN-aligned slice of PACKED rows as
+            # its own async pair (all starts before the first done),
+            # decoding per bucket with the GLOBAL scale — the pack/decode
+            # pair is blockwise, so bucketed ≡ monolithic bit-for-bit
             seg = self._segment_elems(self.bucket_bytes)
-            bounds = [(a, min(a + seg, n)) for a in range(0, n, seg)]
-            tickets = [all_gather_start(compress_ops.pack_signs(c[a:b]),
-                                        axis) for a, b in bounds]
-            segs = [compress_ops.unpack_signs_weighted_sum(
-                all_gather_done(t), all_scales) for t in tickets]
-            signs_sum = segs[0] if len(segs) == 1 else jnp.concatenate(segs)
+            rows_per = seg // (32 * compress_ops.LANES)  # packed rows/bucket
+            p_rows = packed.shape[0]
+            bounds = [(a, min(a + rows_per, p_rows))
+                      for a in range(0, p_rows, rows_per)]
+            tickets = [all_gather_start(packed[a:b], axis)
+                       for a, b in bounds]
+            segs = [compress_ops.unpack_signs_weighted_mean(
+                all_gather_done(t), all_scales, size) for t in tickets]
+            mean = segs[0] if len(segs) == 1 else jnp.concatenate(segs)
         else:
-            packed = compress_ops.pack_signs(c)       # uint32 [P/4096, 128]
             all_packed = lax.all_gather(packed, axis)  # P/8 bytes/worker
-            signs_sum = compress_ops.unpack_signs_weighted_sum(all_packed,
-                                                               all_scales)
-        mean = signs_sum / size
+            mean = compress_ops.unpack_signs_weighted_mean(
+                all_packed, all_scales, size)
         return helper_funcs.unflatten_like(tree, mean), new_state
 
 
@@ -306,8 +314,9 @@ class TopK(Strategy):
     and makes the wire format packable:
 
     * values cross as **bfloat16** (master accumulation stays fp32),
-    * indices cross as **int16** chunk-local offsets (chunk_size ≤ 65536;
-      the chunk id is implicit in position), global index = c·chunk + off.
+    * indices cross as **int16** chunk-local offsets (signed int16, so
+      chunk_size ≤ 32768 — enforced in ``__init__``; the chunk id is
+      implicit in position), global index = c·chunk + off.
 
     Wire bytes per worker ≈ 4·k total (vs 8·k before; vs P/8 for onebit —
     at the 1% default ratio that is 0.04·P vs 0.125·P, ~3× less than
@@ -353,53 +362,38 @@ class TopK(Strategy):
         n_chunks = n // self.chunk
         k_c = self._k_c()
         c2 = c.reshape(n_chunks, self.chunk)
-        _, idx = lax.top_k(jnp.abs(c2), k_c)            # [C, k_c] row-wise
-        vals = jnp.take_along_axis(c2, idx, axis=1)     # [C, k_c] fp32
-        rows = jnp.arange(n_chunks)[:, None]
 
-        # packed wire: bf16 values + int16 chunk-local offsets.  The bf16
+        # fused encode: top-k select, bf16 value cast, int16 offset emit
+        # and the in-place bf16 rounding residual, one chunk-row pass
+        # (ops/compress.py topk kernels; jnp oracle elsewhere).  The bf16
         # quantization residual of each shipped value feeds back into the
         # error buffer alongside the unselected mass, so the fp32 master
         # stream loses nothing to the wire rounding either.
-        wire_vals = vals.astype(jnp.bfloat16)
-        wire_idx = idx.astype(jnp.int16)
-        residual = vals - wire_vals.astype(jnp.float32)
-        new_state = c2.at[rows, idx].set(residual).reshape(-1)
+        wire_vals, wire_idx, new_c2 = compress_ops.topk_encode(c2, k_c)
+        new_state = new_c2.reshape(-1)
         if self.bucket_bytes > 0:
             # per-bucket wire: the (vals, idx) pairs of ~bucket_bytes
             # worth of CHUNK ROWS ride as their own async all-gather
             # pairs; each bucket decodes into its own disjoint dense
-            # segment (chunk c only ever scatters into
-            # [c·chunk, (c+1)·chunk)), so the per-bucket scatter-adds
-            # reproduce the monolithic scatter bit-for-bit
+            # segment (chunk c only ever lands in [c·chunk, (c+1)·chunk)),
+            # so the per-bucket decodes reproduce the monolithic decode
+            # bit-for-bit
             rows_per = self._rows_per_bucket(k_c, self.bucket_bytes)
             bounds = [(a, min(a + rows_per, n_chunks))
                       for a in range(0, n_chunks, rows_per)]
             tickets = [(all_gather_start(wire_vals[a:b], axis),
-                        all_gather_start(wire_idx[a:b], axis), a, b)
+                        all_gather_start(wire_idx[a:b], axis))
                        for a, b in bounds]
-            segs = []
-            for tv, ti, a, b in tickets:
-                sv = all_gather_done(tv)                # [size, b-a, k_c]
-                si = all_gather_done(ti)
-                base = (jnp.arange(b - a, dtype=jnp.int32)
-                        * self.chunk)[None, :, None]
-                lidx = si.astype(jnp.int32) + base      # segment-local
-                seg = jnp.zeros(((b - a) * self.chunk,), jnp.float32)
-                segs.append(seg.at[lidx.reshape(-1)].add(
-                    sv.astype(jnp.float32).reshape(-1)))
-            dense = segs[0] if len(segs) == 1 else jnp.concatenate(segs)
+            segs = [compress_ops.topk_decode(all_gather_done(tv),
+                                             all_gather_done(ti),
+                                             self.chunk, size)
+                    for tv, ti in tickets]
+            mean = segs[0] if len(segs) == 1 else jnp.concatenate(segs)
         else:
             all_vals = lax.all_gather(wire_vals, axis)  # [size, C, k_c]
             all_idx = lax.all_gather(wire_idx, axis)
-
-            base = (jnp.arange(n_chunks, dtype=jnp.int32)
-                    * self.chunk)[None, :, None]
-            gidx = all_idx.astype(jnp.int32) + base      # global indices
-            dense = jnp.zeros((n,), jnp.float32)
-            dense = dense.at[gidx.reshape(-1)].add(
-                all_vals.astype(jnp.float32).reshape(-1))
-        mean = dense / size
+            mean = compress_ops.topk_decode(all_vals, all_idx,
+                                            self.chunk, size)
         return helper_funcs.unflatten_like(tree, mean), new_state
 
     def n_buckets(self, params, bucket_bytes: int):
@@ -488,42 +482,69 @@ class PowerSGD(Strategy):
         return buckets.count_buckets(dense, bucket_bytes) if dense else 0
 
     def __call__(self, tree, state, *, axis: str, size: int):
+        from ..ops import factor_pack
+        from .steps import _vary
         inv = 1.0 / size
         leaves, treedef = jax.tree_util.tree_flatten(tree)
         assert len(leaves) == len(state), (len(leaves), len(state))
-        out, new_state = [], []
-        dense_ids: list = []
-        for g, st in zip(leaves, state):
-            if not self._compressible(np.shape(g)):
-                if self.bucket_bytes > 0:
-                    dense_ids.append(len(out))   # bucketed sum below
-                    out.append(g)
-                else:
-                    out.append(lax.psum(g, axis) * inv)
-                new_state.append(st)
-                continue
-            shape = g.shape
-            M = g.reshape(-1, shape[-1]).astype(jnp.float32)
-            Mp = M + st["e"]
-            P = lax.psum(Mp @ st["q"], axis) * inv
-            Ph, _ = jnp.linalg.qr(P)
-            Qn = lax.psum(Mp.T @ Ph, axis) * inv
-            Mhat = Ph @ Qn.T
-            out.append(Mhat.reshape(shape).astype(g.dtype))
-            # Qn is a psum result (worker-INVARIANT in the vma type
-            # system), but it persists in the boxed per-worker state whose
-            # scan carry under steps_per_call is worker-varying — re-mark
-            # it (values are identical everywhere; this is a type cast)
-            from .steps import _vary
-            new_state.append({"q": _vary(Qn, axis), "e": Mp - Mhat})
-        if dense_ids:
+        out = [None] * len(leaves)
+        new_state = list(state)
+        comp = [i for i, g in enumerate(leaves)
+                if self._compressible(np.shape(g))]
+
+        # -- compressible leaves: stacked low-rank factor exchange --------
+        # Every factor matmul lands directly in its zero-padded slice of
+        # ONE staging buffer (ops/factor_pack.matmul_pack fuses the matmul
+        # with the staging pack), so all P factors ride a single psum —
+        # and likewise all Q factors — instead of one collective per leaf.
+        # Zero pad rows psum to zero, so each slice equals the per-leaf
+        # psum it replaces bit-for-bit.
+        Mps = {i: leaves[i].reshape(-1, leaves[i].shape[-1])
+               .astype(jnp.float32) + state[i]["e"] for i in comp}
+
+        def _stacked_psum(tiles):
+            buf = tiles[0] if len(tiles) == 1 else jnp.concatenate(tiles, 0)
+            return lax.psum(buf, axis) * inv
+
+        if comp:
+            p_tiles = [factor_pack.matmul_pack(Mps[i], state[i]["q"])
+                       for i in comp]
+            P_all = _stacked_psum(p_tiles)
+            Phs, off = {}, 0
+            for i, t in zip(comp, p_tiles):
+                rows = Mps[i].shape[0]
+                Phs[i], _ = jnp.linalg.qr(P_all[off:off + rows])
+                off += t.shape[0]
+            q_tiles = [factor_pack.matmul_pack(Mps[i].T, Phs[i])
+                       for i in comp]
+            Q_all = _stacked_psum(q_tiles)
+            off = 0
+            for i, t in zip(comp, q_tiles):
+                g = leaves[i]
+                cols = Mps[i].shape[1]
+                Qn = Q_all[off:off + cols]
+                off += t.shape[0]
+                Mhat = Phs[i] @ Qn.T
+                out[i] = Mhat.reshape(g.shape).astype(g.dtype)
+                # Qn is a psum result (worker-INVARIANT in the vma type
+                # system), but it persists in the boxed per-worker state
+                # whose scan carry under steps_per_call is worker-varying —
+                # re-mark it (values are identical everywhere; a type cast)
+                new_state[i] = {"q": _vary(Qn, axis), "e": Mps[i] - Mhat}
+
+        # -- dense remainder ----------------------------------------------
+        dense_ids = [i for i in range(len(leaves)) if out[i] is None]
+        if self.bucket_bytes > 0 and dense_ids:
             # the dense remainder rides the bucket planner: one async
             # psum pair per ~bucket_bytes of incompressible leaves
             # (element-wise sum — bit-identical to the leaf-wise psums)
-            summed = buckets.bucketed_psum([out[i] for i in dense_ids],
+            summed = buckets.bucketed_psum([leaves[i] for i in dense_ids],
                                            axis, self.bucket_bytes)
             for i, s in zip(dense_ids, summed):
                 out[i] = s * inv
+        else:
+            for i in dense_ids:
+                out[i] = lax.psum(leaves[i], axis) * inv
         return jax.tree_util.tree_unflatten(treedef, out), new_state
 
 

@@ -239,6 +239,13 @@ def key_extra(fn: str, model=None, exchanger=None,
             # of the same rule must never share an entry (belt-and-braces
             # over the HLO hash, like the rule signature)
             extra["bucket_bytes"] = bb
+    if os.environ.get("THEANOMPI_TPU_NO_PALLAS", "0") == "1":
+        # the compression/LRN ops dispatch to the jnp oracles instead of
+        # the Pallas kernels (ops/_pallas_util) — a different program with
+        # the same config, so the forced-oracle build must never share an
+        # entry with the kernel build.  Stamped only when forced, so every
+        # pre-existing key (and every default TPU build) stays byte-stable.
+        extra["no_pallas"] = 1
     return extra
 
 

@@ -144,6 +144,24 @@ USHARD_ROW_COLUMNS = (
     "update_state_shrink",
 )
 
+# The bench-row columns compression rows add (onebit/topk/powersgd
+# strategies; ops/compress.py, ops/factor_pack.py, docs/design.md §24) —
+# the :func:`compress_traffic_report` estimate: local HBM bytes one
+# exchange moves through the compression pipeline, modeled at XLA-op
+# granularity WITHOUT fusion credit (each jnp-level op reads its operands
+# and writes its result — an upper bound for the unfused graph, exact for
+# the single-pass Pallas kernels), before (legacy unfused ops) and after
+# (fused kernel pipeline), plus the decode-stage ratio on its own (the
+# scatter replacement is topk's headline).  Same jax-free schema-home
+# discipline as the vocabularies above; disjointness is pinned in
+# tests/test_compress_fusion.py.
+COMPRESS_ROW_COLUMNS = (
+    "compress_hbm_bytes_legacy",
+    "compress_hbm_bytes_fused",
+    "compress_hbm_shrink",
+    "compress_decode_shrink",
+)
+
 # HLO opcodes whose device time is collective/communication time.  Async
 # pairs (`<op>-start` / `<op>-done`) share the prefix and match too.
 COMM_OP_PREFIXES = (
@@ -847,6 +865,151 @@ def update_state_report(model) -> Dict[str, Any]:
         "update_state_shrink": (round(replicated / per_chip, 3)
                                 if per_chip else None),
     }
+
+
+def compress_traffic_model(strategy: str, n_elems: int, n_workers: int, *,
+                           rank: int = 2, chunk: int = 8192,
+                           k_c: Optional[int] = None,
+                           leaf_shapes: Optional[list] = None
+                           ) -> Optional[Dict[str, Any]]:
+    """Analytic per-exchange HBM-traffic model for the compression
+    pipelines — pure python, jax-free (scripts/predict_scaling.py joins it
+    against measured rows without touching a backend).
+
+    Accounting contract: XLA-op granularity with NO fusion credit — every
+    jnp-level op in the strategy's exchange reads its operands and writes
+    its result to HBM, fp32 = 4 bytes/elem.  That is an upper bound for
+    what XLA's fuser actually emits from the unfused graph, and exact for
+    the Pallas kernels (each kernel is one pass by construction), so the
+    legacy/fused ratio is the *guaranteed-by-construction* shrink, not a
+    measured one.  Stage lists name every counted op so the estimate is
+    auditable.
+
+    Returns ``None`` for strategies with no compression pipeline.
+    """
+    w = int(n_workers)
+
+    def _total(stages):
+        return float(sum(b for _, b in stages))
+
+    if strategy == "onebit":
+        # pad to the pack grid, like flatten_tree(pad_to_multiple_of=...)
+        n = n_elems + (-n_elems) % 32768
+        fn, pk = 4.0 * n, n / 8.0          # fp32 pass / packed buffer bytes
+        legacy_enc = [
+            ("add c = flat + state", 3 * fn),
+            ("abs(c)", 2 * fn),
+            ("mean reduce -> scale", fn),
+            ("where(c==0, 1, c)", 2 * fn),
+            ("sign", 2 * fn),
+            ("scale * sign", 2 * fn),
+            ("sub -> new_state", 3 * fn),
+            ("pack_signs", fn + pk),
+        ]
+        legacy_dec = [
+            ("unpack+weighted-sum", w * pk + fn),
+            ("div /size -> mean", 2 * fn),
+        ]
+        fused_enc = [
+            ("pack_signs_encode kernel", 2 * fn + pk + fn),
+            ("mean reduce -> scale", fn),
+            ("signed_residual kernel", fn + pk + fn),
+        ]
+        fused_dec = [
+            ("unpack_signs_weighted_mean kernel", w * pk + fn),
+        ]
+    elif strategy == "topk":
+        n = n_elems + (-n_elems) % chunk
+        rows = n // chunk
+        k = int(k_c or max(1, round(chunk * 0.01)))
+        fn = 4.0 * n
+        wire = 4.0 * rows * k              # bf16 val + int16 offset per slot
+        legacy_enc = [
+            ("add c = flat + state", 3 * fn),
+            ("abs(c)", 2 * fn),
+            ("top_k select", fn + 2 * wire),
+            ("take_along_axis vals", fn + wire),
+            ("bf16/int16 casts + residual", 3 * wire),
+            ("scatter-set residual -> new_state", 3 * fn),
+        ]
+        legacy_dec = [
+            ("zeros dense", fn),
+            ("global-index arith", 3 * w * wire),
+            ("serialized HBM scatter-add", 2 * fn + w * wire),
+            ("div /size -> mean", 2 * fn),
+        ]
+        fused_enc = [
+            ("topk_encode kernel", fn + fn + 2 * wire),
+        ]
+        fused_dec = [
+            ("topk_decode kernel (VMEM expand + /size)", w * wire + fn),
+        ]
+    elif strategy.startswith("powersgd"):
+        r = rank
+        shapes = [s for s in (leaf_shapes or [])
+                  if len(s) >= 2
+                  and min(math.prod(s[:-1]), int(s[-1])) > 4 * r]
+        if not shapes:
+            return None
+        fac = 4.0 * r * sum(math.prod(s[:-1]) + int(s[-1])
+                            for s in shapes)   # both factors' fp32 bytes
+        mats = 4.0 * sum(math.prod(s) for s in shapes)
+        legacy_enc = [
+            ("Mp = M + e (per leaf)", 3 * mats),
+            ("factor matmuls", 2 * (mats + fac)),
+            ("per-leaf staging pack (flatten/pad/concat)", 2 * fac),
+            ("per-leaf psum staging copies", 2 * fac),
+        ]
+        legacy_dec = [
+            ("qr + Mhat decode", mats + 2 * fac),
+            ("residual e' = Mp - Mhat", 3 * mats),
+        ]
+        fused_enc = [
+            ("Mp = M + e (per leaf)", 3 * mats),
+            ("matmul_pack kernels (MXU -> staging)", 2 * (mats + fac)),
+            ("stacked psum staging (one buffer)", 2 * fac),
+        ]
+        fused_dec = legacy_dec
+    else:
+        return None
+
+    legacy = _total(legacy_enc) + _total(legacy_dec)
+    fused = _total(fused_enc) + _total(fused_dec)
+    return {
+        "strategy": strategy,
+        "n_workers": w,
+        "stages": {"legacy_encode": legacy_enc, "legacy_decode": legacy_dec,
+                   "fused_encode": fused_enc, "fused_decode": fused_dec},
+        "compress_hbm_bytes_legacy": legacy,
+        "compress_hbm_bytes_fused": fused,
+        "compress_hbm_shrink": round(legacy / fused, 3),
+        "compress_decode_shrink": round(_total(legacy_dec)
+                                        / _total(fused_dec), 3),
+    }
+
+
+def compress_traffic_report(model) -> Optional[Dict[str, Any]]:
+    """The :data:`COMPRESS_ROW_COLUMNS` bench columns for a live model —
+    :func:`compress_traffic_model` fed from the model's actual strategy
+    config and parameter count.  ``None`` when the exchange strategy has
+    no compression pipeline; bench.py folds the columns into onebit/topk/
+    powersgd rows next to the measured step time."""
+    import jax
+    strat = model.exchanger.strategy
+    leaf_shapes = [tuple(getattr(l, "shape", ()) or ())
+                   for l in jax.tree.leaves(model.params)]
+    n_elems = sum(math.prod(s) if s else 1 for s in leaf_shapes)
+    from ..parallel.mesh import WORKER_AXIS
+    w = int(model.mesh.shape[WORKER_AXIS])
+    kw: Dict[str, Any] = {}
+    if strat.name == "topk":
+        kw = {"chunk": strat.chunk, "k_c": strat._k_c()}
+    elif strat.name.startswith("powersgd"):
+        kw = {"rank": strat.rank, "leaf_shapes": leaf_shapes}
+    m = compress_traffic_model(strat.name, n_elems, w, **kw)
+    if m is None:
+        return None
+    return {c: m[c] for c in COMPRESS_ROW_COLUMNS}
 
 
 def format_profile(profile: Dict[str, Any], top: int = 15) -> str:
