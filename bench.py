@@ -1133,6 +1133,21 @@ def main() -> int:
     qd = telem.hists.get("prefetch.queue_depth")
     if qd is not None and qd.count:
         out["min_queue_depth"] = qd.min
+    if getattr(model, "numerics_aux", None) is not None:
+        # §25 numerics columns (rows with `numerics` on): the worst-rank
+        # grad norm and the cross-rank beacon spread of the LAST sampled
+        # step — bench rows carry training-health evidence, not just speed
+        from theanompi_tpu.utils import numerics as _numerics
+        try:
+            _rep = _numerics.host_report(
+                jax.device_get(model.numerics_aux))
+            if _rep is not None:
+                out["grad_norm"] = round(float(_rep["grad_norm"]), 6)
+                out["divergence"] = None if _rep["divergence"] is None \
+                    else float(_rep["divergence"])
+        except Exception as e:
+            print(f"bench: numerics report unavailable ({e!r})",
+                  file=sys.stderr)
     print(json.dumps(out))
     return 0
 

@@ -104,17 +104,23 @@ def load_rows(path):
 
 
 def build_baseline(paths):
-    """label -> (best fresh value, source path).  Stale/degraded rows are
-    excluded per the module docstring."""
+    """(label -> (best fresh value, source path), stale-only labels).
+    Stale/degraded rows are excluded per the module docstring; a label
+    the trajectory carries ONLY in stale rows is returned separately so
+    the caller can tell "never measured" apart from "every committed
+    measurement was a wedge re-emission" — the latter must not fail the
+    gate (there is no trustworthy bar), but it deserves a loud warning."""
     best = {}
+    seen_stale = set()
     for path in paths:
         for row in load_rows(path):
             if row["stale"] or row["degraded"]:
+                seen_stale.add(row["label"])
                 continue
             cur = best.get(row["label"])
             if cur is None or row["value"] > cur[0]:
                 best[row["label"]] = (row["value"], path)
-    return best
+    return best, seen_stale - set(best)
 
 
 def judge(fresh_rows, baseline, threshold_pct):
@@ -165,7 +171,7 @@ def main(argv=None):
     fresh_abs = {os.path.abspath(p) for p in args.fresh}
     base_paths = [p for p in base_paths
                   if os.path.abspath(p) not in fresh_abs]
-    baseline = build_baseline(base_paths)
+    baseline, stale_only = build_baseline(base_paths)
 
     fresh_rows = [r for p in args.fresh for r in load_rows(p)]
     verdicts = judge(fresh_rows, baseline, args.threshold)
@@ -189,7 +195,29 @@ def main(argv=None):
         print("bench_regress: no comparable fresh rows — nothing judged",
               file=sys.stderr)
         return 2
+    if not judged:
+        # every fresh row was a stale/degraded re-emission: the run under
+        # judgment carried no trustworthy measurement.  That is a
+        # baseline-hygiene problem, not a perf verdict — exit 0 so a
+        # wedged hardware window doesn't fail CI on its own echo.
+        print("bench_regress: STALE-BASELINE WARNING — every fresh row "
+              "is stale/degraded; nothing trustworthy to judge. "
+              "Re-run the bench window before trusting the trajectory.",
+              file=sys.stderr)
+        return 0
     if not any("baseline" in v for v in judged):
+        stale_hit = sorted({v["label"] for v in judged
+                            if v["label"] in stale_only})
+        if stale_hit:
+            # the labels DO exist in the committed trajectory, but only
+            # in rows the stale filter excluded — the baseline for them
+            # is all wedge re-emissions.  Loud, but not a failure.
+            print("bench_regress: STALE-BASELINE WARNING — baseline for "
+                  f"label(s) {stale_hit} exists only in stale/degraded "
+                  "committed rows; no trustworthy bar to judge against. "
+                  "Commit a fresh measurement to re-arm the gate.",
+                  file=sys.stderr)
+            return 0
         print("bench_regress: no fresh label overlaps the baseline "
               "trajectory — nothing judged", file=sys.stderr)
         return 2

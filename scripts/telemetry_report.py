@@ -73,15 +73,19 @@ TRACKED_EVENTS = ("phase", "train_record", "val_record", "gauges",
                   "fatal_signal", "worker_join", "worker_leave",
                   "worker_demote", "fault_injected",
                   "center_down", "center_restored", "wire",
-                  "span", "statusz", "alert")
+                  "span", "statusz", "alert", "numerics")
 
 # gauges-event keys drawn as Perfetto counter tracks (plus
 # images_per_sec from train_record events); heartbeat.iter is the
 # membership lease's liveness signal (parallel/membership.py);
 # wire.outage_s is the wire client's healed-outage duration
-# (parallel/wire.py)
+# (parallel/wire.py); the numerics.* keys ride `numerics` events
+# (utils/numerics, docs/design.md §25) — grad-norm, update-ratio,
+# beacon-divergence and ‖w−c‖ counter tracks per rank
 TRACE_COUNTER_KEYS = ("hbm_bytes_in_use", "prefetch.queue_depth",
-                      "heartbeat.iter", "wire.outage_s")
+                      "heartbeat.iter", "wire.outage_s",
+                      "numerics.grad_norm", "numerics.update_ratio",
+                      "numerics.divergence", "numerics.dist_center")
 
 INSTANT_EVENTS = ("anomaly", "crash", "stall", "fatal_signal",
                   "worker_join", "worker_leave", "worker_demote",
@@ -452,6 +456,46 @@ def health_flags(events, summaries):
     return flags
 
 
+def numerics_health(events):
+    """Per-rank numerics-plane digest (utils/numerics, §25): the LAST
+    report's stats plus worst-case values over the window — the beacon
+    divergence and nonfinite count must surface even if the run recovered
+    afterwards.  Empty dict when the plane was off."""
+    out = {}
+    for ev in events:
+        if ev.get("ev") != "numerics":
+            continue
+        rank = int(ev.get("rank", 0))
+        row = out.setdefault(rank, {"reports": 0, "max_divergence": 0.0,
+                                    "nonfinite_total": 0.0,
+                                    "max_grad_norm": 0.0,
+                                    "min_update_ratio": None,
+                                    "max_dist_center": 0.0, "last": {}})
+        row["reports"] += 1
+        div = ev.get("divergence")
+        if isinstance(div, (int, float)) and div == div:
+            row["max_divergence"] = max(row["max_divergence"], div)
+        nf = ev.get("nonfinite")
+        if isinstance(nf, (int, float)):
+            row["nonfinite_total"] += nf
+        gn = ev.get("grad_norm")
+        if isinstance(gn, (int, float)) and gn == gn:
+            row["max_grad_norm"] = max(row["max_grad_norm"], gn)
+        ur = ev.get("update_ratio")
+        if isinstance(ur, (int, float)):
+            row["min_update_ratio"] = ur if row["min_update_ratio"] \
+                is None else min(row["min_update_ratio"], ur)
+        dc = ev.get("dist_center")
+        if isinstance(dc, (int, float)) and dc == dc:
+            row["max_dist_center"] = max(row["max_dist_center"], dc)
+        row["last"] = {k: ev.get(k)
+                       for k in ("iter", "grad_norm", "grad_max_abs",
+                                 "nonfinite", "param_norm", "update_norm",
+                                 "update_ratio", "divergence",
+                                 "dist_center", "ef_norm", "beacon")}
+    return out
+
+
 def wire_health(events, summaries):
     """Per-rank wire-layer health (parallel/wire.py): rtt percentiles,
     retry/timeout/corrupt/dedup counters from the summaries, healed
@@ -546,6 +590,18 @@ def build_trace(events):
                     body.append({"ph": "C", "pid": rank, "tid": 0,
                                  "ts": us(ev["ts"]), "name": key,
                                  "args": {"value": ev[key]}})
+        elif kind == "numerics":
+            # numerics events carry short field names; the counter-track
+            # vocabulary uses the gauge-qualified "numerics.<field>"
+            for key in TRACE_COUNTER_KEYS:
+                if not key.startswith("numerics."):
+                    continue
+                field = key.split(".", 1)[1]
+                val = ev.get(field)
+                if isinstance(val, (int, float)) and val == val:
+                    body.append({"ph": "C", "pid": rank, "tid": 0,
+                                 "ts": us(ev["ts"]), "name": key,
+                                 "args": {"value": val}})
         elif kind == "train_record":
             if "images_per_sec" in ev:
                 body.append({"ph": "C", "pid": rank, "tid": 0,
@@ -691,6 +747,7 @@ def build_report(record_dir, window_s=10.0, events=None):
         "flags": health_flags(events, summaries),
         "counters": {r: s.get("counters", {}) for r, s in summaries.items()},
         "wire": wire_health(events, summaries),
+        "numerics": numerics_health(events),
         "alerts": alerts,
         "traces": trace_summary(events, window_s),
         "membership_events": membership,
@@ -749,6 +806,36 @@ def print_report(rep):
             print(f"  rank {rank}: compute {d.get('compute_secs', 0):.3f}s "
                   f"comm {d.get('comm_secs', 0):.3f}s exposed "
                   f"{d.get('exposed_comm_secs', 0):.3f}s ({overlap})")
+    nm = rep.get("numerics")
+    if nm:
+        print("\nnumerics health (per-rank, last report + window worst):")
+        for rank, n in sorted(nm.items()):
+            last = n.get("last", {})
+            verdict = ""
+            if n["max_divergence"] > 0:
+                verdict = " — DIVERGED"
+            elif n["nonfinite_total"] > 0:
+                verdict = " — OVERFLOWED"
+            gn = last.get("grad_norm")
+            ur = last.get("update_ratio")
+            dc = last.get("dist_center")
+            ef = last.get("ef_norm")
+            parts = [f"iter {last.get('iter')}"]
+            if isinstance(gn, (int, float)):
+                parts.append(f"grad_norm {gn:.4g}")
+            if isinstance(ur, (int, float)):
+                parts.append(f"update_ratio {ur:.3g}")
+            if isinstance(dc, (int, float)) and dc:
+                parts.append(f"dist_center {dc:.4g}")
+            if isinstance(ef, (int, float)) and ef:
+                parts.append(f"ef_norm {ef:.4g}")
+            beacon = last.get("beacon")
+            parts.append(
+                f"divergence {n['max_divergence']:.4g} (max)"
+                if beacon else "no beacon")
+            parts.append(f"nonfinite {int(n['nonfinite_total'])}")
+            print(f"  rank {rank}: " + ", ".join(parts)
+                  + f" over {n['reports']} report(s){verdict}")
     an = rep["flags"].get("anomalies")
     if an:
         print("\nsentry anomalies:")

@@ -46,11 +46,15 @@ def _baseline_dir(tmp_path):
 
 
 def test_baseline_excludes_stale_and_keeps_best(tmp_path):
-    base = br.build_baseline(
+    base, stale_only = br.build_baseline(
         sorted(p for g in _baseline_dir(tmp_path)
                for p in __import__("glob").glob(g)))
     assert base["alexnet-b128"][0] == 13300.0   # not the stale 14162
     assert base["vgg16-easgd"][0] == 900.0      # not the stale 950
+    # both real labels carry fresh rows, so neither is stale-ONLY (the
+    # label-less wedge row falls to "default", which IS stale-only here)
+    assert "alexnet-b128" not in stale_only
+    assert "vgg16-easgd" not in stale_only
 
 
 def test_gate_pass_regression_and_new_labels(tmp_path):
@@ -70,13 +74,33 @@ def test_gate_pass_regression_and_new_labels(tmp_path):
     assert verdicts[0]["verdict"] == "regression" \
         and verdicts[0]["baseline"] == 13300.0
     # a stale FRESH row is skipped, never judged (the wedge fallback
-    # re-emission can't fail its own gate)
+    # re-emission can't fail its own gate) — that's a baseline-hygiene
+    # warning, not a verdict: exit 0, not 2
     _write_jsonl(fresh, [("alexnet-b128", {"value": 11000.0,
                                            "stale": True})])
-    assert br.main(args + ["--threshold", "10"]) == 2
+    assert br.main(args + ["--threshold", "10"]) == 0
     # no overlap with the trajectory at all: exit 2 (warning, no verdict)
     _write_jsonl(fresh, [("never-seen", {"value": 5.0})])
     assert br.main(args + ["--threshold", "10"]) == 2
+
+
+def test_stale_only_baseline_warns_loudly_and_passes(tmp_path, capsys):
+    """A label whose every COMMITTED row is stale/degraded has no
+    trustworthy bar: the gate must warn loudly and exit 0 — it must not
+    judge fresh work against a wedge re-emission, in either direction."""
+    _write_bench(str(tmp_path / "BENCH_r05.json"), 14162.0,
+                 metric="STALE last-good (alexnet-b128) — wedged",
+                 error="tunnel wedged",
+                 last_good={"config": "alexnet-b128"})
+    fresh = str(tmp_path / "fresh.jsonl")
+    # 11000 would be a -22% regression against the stale 14162 — but
+    # that bar is a wedge echo, so: warning, exit 0
+    _write_jsonl(fresh, [("alexnet-b128", {"value": 11000.0})])
+    args = [fresh, "--baseline", str(tmp_path / "BENCH_r*.json")]
+    assert br.main(args + ["--threshold", "10"]) == 0
+    err = capsys.readouterr().err
+    assert "STALE-BASELINE WARNING" in err
+    assert "alexnet-b128" in err
 
 
 def test_r9_script_wires_the_gate():

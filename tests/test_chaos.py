@@ -188,9 +188,15 @@ def test_elastic_easgd_survives_sigkill_and_rejoins(tmp_path):
     worker restored from the center and contributed again."""
     record_dir = str(tmp_path)
     schedule = chaos.parse_schedule("kill@6:1")
+    # two margins make the kill land mid-run whatever the box's load:
+    # the monkey clock is progress-gated (run_elastic releases it only
+    # once a lease reports step ≥ 1, so jit-compile time never eats the
+    # window), and iter_sleep stretches the post-gate run to
+    # ≥ steps·sleep ≈ 10 s — the t=6 kill sits well inside it with room
+    # on both sides (≥ 1 step done before, ≥ 3 s of run left after)
     rc = mb.run_elastic(
-        "easgd", "tests.conftest", "TinyModel",
-        {"sync_freq": 2, "batch_size": 8}, 2,
+        "easgd", "tests.conftest", "SleepyModel",
+        {"sync_freq": 2, "batch_size": 8, "iter_sleep": 0.25}, 2,
         record_dir=record_dir, steps=40, host_devices=1,
         chaos_schedule=schedule, timeout_s=420,
         supervisor_kw={"poll_s": 0.2, "backoff": mb.Backoff(base=0.3),
@@ -219,6 +225,93 @@ def test_elastic_easgd_survives_sigkill_and_rejoins(tmp_path):
     assert len(w2_joins) == 1
     # the center heard pushes and the final snapshot landed for offline eval
     assert os.path.exists(os.path.join(record_dir, "center_final.npz"))
+
+
+def test_elastic_corrupt_chaos_raises_replica_divergence(tmp_path):
+    """ISSUE 19 acceptance: a chaos ``corrupt`` fault perturbs one
+    island's LIVE params — the bad value never crosses the wire as a
+    frame, so the §15 CRC can't catch it; the §25 numerics plane must.
+    The perturbed island gauges its post-rejoin ``‖w_i − c‖`` spike, the
+    fleetmon ``replica_divergence`` rule alerts on that worker within
+    one beacon period, the §20 coverage audit closes over the realized
+    fault, and a simfleet rehearsal of the same fault kind raises the
+    identical alert set."""
+    from theanompi_tpu.utils import fleetmon
+
+    record_dir = str(tmp_path)
+    # the third field is the perturbation SCALE; the rule threshold sits
+    # between the healthy ‖w−c‖ drift ceiling (≲1 for this model) and
+    # the corruption's jump (50·√numel ≫ 10) — the §25 calibration
+    # contract the docs spell out
+    schedule = chaos.parse_schedule("corrupt@2:1:50")
+    rc = mb.run_elastic(
+        "easgd", "tests.conftest", "SleepyModel",
+        {"sync_freq": 2, "batch_size": 8, "iter_sleep": 0.25,
+         "fleetmon": True, "fleetmon_divergence": 10.0,
+         "fleetmon_eval_s": 0.5}, 2,
+        record_dir=record_dir, steps=40, host_devices=1,
+        chaos_schedule=schedule, timeout_s=420,
+        supervisor_kw={"poll_s": 0.2, "backoff": mb.Backoff(base=0.3),
+                       "lease_timeout": 60.0})
+    assert rc == 0
+    assert schedule[0].error is None, "corrupt fault never landed"
+    # the trigger file was consumed by the island (perturbation applied)
+    assert not os.path.exists(
+        os.path.join(record_dir, "chaos", "corrupt_w1.json"))
+    events = _merged_events(record_dir)
+    assert any(e["ev"] == chaos.FAULT_EVENT and e.get("kind") == "corrupt"
+               for e in events)
+    alerts = [e for e in events if e["ev"] == fleetmon.ALERT_EVENT]
+    div_alerts = [a for a in alerts if a["rule"] == "replica_divergence"]
+    assert div_alerts, [a["rule"] for a in alerts]
+    # Fleet-wide alarm is the CORRECT detection for EASGD corruption:
+    # the corrupted replica's elastic push moves the CENTER, so every
+    # live replica's distance to the consensus spikes — not just the
+    # poisoned one.  Both workers must raise replica_divergence.
+    assert {a["worker"] for a in div_alerts} == {1, 2}
+    # the §20 coverage audit closes: corrupt → replica_divergence within
+    # the deadline.  interval_s covers the full symptom pipeline — the
+    # island polls the trigger at its next sync round (≤ 2·iter_sleep),
+    # then one streamer beat (1 s) carries the gauge to the collector
+    with open(os.path.join(record_dir, "chaos_realized.jsonl")) as f:
+        realized = [json.loads(ln) for ln in f if ln.strip()]
+    assert any(doc["kind"] == "corrupt" and not doc.get("error")
+               for doc in realized)
+    rules = fleetmon.default_rules(heartbeat_s=10.0, divergence=10.0)
+    ok, lines = fleetmon.audit_alerts(alerts, realized, rules,
+                                      eval_window_s=0.5, interval_s=4.0)
+    assert ok, "\n".join(lines)
+    assert any("corrupt" in ln and "replica_divergence" in ln
+               for ln in lines)
+
+    # the simfleet rehearsal of the same fault kind: deterministic, and
+    # the SAME alert set — the corrupted push poisons the center, so the
+    # rehearsal (like the live run) alerts on EVERY replica, no flapping.
+    # 400 steps at sync_freq=8 is ~10 virtual seconds; inject at t=4 so
+    # the fault lands mid-run, not on the finish line
+    from theanompi_tpu.simfleet.fleet import FleetSim
+
+    def rehearse():
+        f = FleetSim(n_workers=2, steps=400, sync_freq=8, seed=9,
+                     n_stragglers=0,
+                     schedule=list(chaos.parse_schedule("corrupt@4:1:50")),
+                     fleetmon=True)
+        f.run()
+        return f
+
+    f1, f2 = rehearse(), rehearse()
+    assert f1.log.sha256() == f2.log.sha256()
+    sim_alerts = f1.log.select("alert")
+    sim_set = {(a["rule"], a["worker"]) for a in sim_alerts}
+    live_set = {(a["rule"], a["worker"]) for a in div_alerts}
+    assert sim_set == live_set == {("replica_divergence", 1),
+                                   ("replica_divergence", 2)}
+    ok, lines = fleetmon.audit_alerts(
+        f1.health.collector.alerts, f1.realized,
+        f1.health.collector.rules,
+        eval_window_s=f1.health.eval_window_s,
+        interval_s=FleetSim.BEAT_EVERY_S)
+    assert ok, "\n".join(lines)
 
 
 # -- supervised SIGKILL resume (the BSP reaction) ----------------------------

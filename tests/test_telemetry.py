@@ -314,7 +314,13 @@ def test_worker_sigterm_dumps_flight(tmp_path):
     recorder, previously only exercised by the stall path: a CLI worker
     SIGTERM'd mid-run leaves a flight_rank0.jsonl that parses and ends
     with the fatal_signal event, and the process dies with the honest
-    signal exit."""
+    signal exit.
+
+    ISSUE 19 rides the same run: with ``numerics=true`` and an absurd
+    ratio floor every numerics report trips ``update_ratio_collapse``,
+    so the dumped ring must carry the §25 numerics report events AND the
+    numerics-detector anomaly — the end-to-end proof that the new
+    detectors reach the flight/post-mortem plane."""
     import signal
 
     rec = str(tmp_path / "rec")
@@ -325,6 +331,7 @@ def test_worker_sigterm_dumps_flight(tmp_path):
          "bsp", "tests.conftest", "TinyModel",
          "platform=cpu", "epochs=999", "batch_size=8", "n_train=64",
          "verbose=false", "scale_lr=false", "printFreq=2",
+         "numerics=true", "sentry_ratio_floor=1000000",
          f"record_dir={rec}"],
         cwd=REPO, env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
         text=True)
@@ -363,6 +370,13 @@ def test_worker_sigterm_dumps_flight(tmp_path):
     # the trail shows the run was mid-training when the signal landed
     assert any(e["ev"] in ("phase", "beat", "train_record")
                for e in flight[1:])
+    # §25 end-to-end: the ring carries the numerics reports and the
+    # numerics-detector anomaly the rigged ratio floor forced
+    numerics_evs = [e for e in flight[1:] if e["ev"] == "numerics"]
+    assert numerics_evs, "no numerics report reached the flight ring"
+    assert all(e["grad_norm"] > 0 for e in numerics_evs)
+    anoms = [e for e in flight[1:] if e["ev"] == "anomaly"]
+    assert any(e["kind"] == "update_ratio_collapse" for e in anoms), anoms
 
 
 def test_crash_dumps_flight_and_launcher_sweeps(tmp_path):

@@ -54,6 +54,7 @@ DEVPROF_PATH = "theanompi_tpu/utils/devprof.py"
 SENTRY_PATH = "theanompi_tpu/utils/sentry.py"
 REPORT_PATH = "scripts/telemetry_report.py"
 CHAOS_PATH = "theanompi_tpu/utils/chaos.py"
+NUMERICS_PATH = "theanompi_tpu/utils/numerics.py"
 
 # one lane, one module: a compute span [0,50]us and a comm span [40,60]us
 # → compute 50us, comm 20us, exposed 10us, overlap 0.5 — a COMPLETE
@@ -596,7 +597,7 @@ def fleetmon_schema_errors(fleetmon, membership, telemetry,
     try:
         fleetmon.validate_rules(fleetmon.DEFAULT_RULES)
         full = fleetmon.validate_rules(fleetmon.default_rules(
-            step_p99_s=1.0, hbm_headroom_bytes=1.0))
+            step_p99_s=1.0, hbm_headroom_bytes=1.0, divergence=1.0))
     except ValueError as e:
         errors.append((FLEETMON_PATH,
                        f"the stock rule set fails its own validator: {e}"))
@@ -689,6 +690,100 @@ def fleetmon_schema_errors(fleetmon, membership, telemetry,
                            f"TRACKED_EVENTS is missing fleet-health "
                            f"event kind(s) {missing} — alerts would be "
                            "dropped from report and Perfetto export"))
+    return errors
+
+
+def numerics_schema_errors(numerics, sentry, fleetmon, telemetry,
+                           telemetry_report=None) -> List[tuple]:
+    """Round-25 probes: the numerics health plane (docs/design.md §25).
+    LIVE, jax-free (the host-plane half of ``utils/numerics`` is
+    stdlib-only by contract):
+
+    * the sentry kinds the plane raises are declared anomaly kinds;
+    * a live ``record(example_report())`` emits EVERY declared
+      ``NUMERICS_GAUGES`` gauge, every ``NUMERICS_HISTOGRAMS``
+      distribution, and exactly one ``NUMERICS_EVENT`` event;
+    * a live sentry fed an overflowing report raises ``grad_overflow``
+      through the real anomaly event path;
+    * fleetmon's snapshot schema carries the beacon series the
+      ``replica_divergence`` rule reads;
+    * the report/trace converter consumes the event kind and renders
+      the divergence/grad-norm counter tracks."""
+    errors: List[tuple] = []
+    if numerics is None:
+        return errors
+
+    if sentry is not None:
+        missing = sorted(set(numerics.SENTRY_KINDS) -
+                         set(sentry.ANOMALY_KINDS))
+        if missing:
+            errors.append((NUMERICS_PATH,
+                           f"numerics SENTRY_KINDS {missing} absent from "
+                           f"sentry.ANOMALY_KINDS — the detectors would "
+                           "raise undeclared anomalies"))
+
+    # a live record() must cover the whole declared gauge/event surface
+    tm = telemetry.Telemetry(rank=0, run_id="drift-check")
+    rep = numerics.example_report()
+    numerics.record(tm, rep, rank=0)
+    missing = sorted(set(numerics.NUMERICS_GAUGES) - set(tm.gauges))
+    if missing:
+        errors.append((NUMERICS_PATH,
+                       f"record(example_report()) never set declared "
+                       f"gauge(s) {missing}"))
+    missing = sorted(set(numerics.NUMERICS_HISTOGRAMS) - set(tm.hists))
+    if missing:
+        errors.append((NUMERICS_PATH,
+                       f"record(example_report()) never observed declared "
+                       f"histogram(s) {missing}"))
+    evs = [e for e in tm.tail(4) if e["ev"] == numerics.NUMERICS_EVENT]
+    if len(evs) != 1:
+        errors.append((NUMERICS_PATH,
+                       f"record(example_report()) emitted {len(evs)} "
+                       f"{numerics.NUMERICS_EVENT!r} event(s) — "
+                       "expected exactly 1"))
+
+    # a live sentry fed an overflow raises through the real event path
+    if sentry is not None:
+        tm2 = telemetry.Telemetry(rank=0, run_id="drift-check")
+        s = sentry.TrainingSentry({"verbose": False}, telemetry=tm2)
+        bad = dict(numerics.example_report())
+        bad["nonfinite"] = 4.0
+        kind = s.observe_numerics(bad)
+        anoms = [e for e in tm2.tail(4)
+                 if e["ev"] == sentry.ANOMALY_EVENT]
+        if kind != "grad_overflow" or not anoms:
+            errors.append((SENTRY_PATH,
+                           "an overflowing numerics report did not raise "
+                           f"a live grad_overflow anomaly (got {kind!r})"))
+
+    # fleetmon's snapshot schema must carry the beacon series
+    if fleetmon is not None:
+        missing = sorted({"grad_norm", "divergence"} -
+                         set(fleetmon.METRIC_FIELDS))
+        if missing:
+            errors.append((FLEETMON_PATH,
+                           f"METRIC_FIELDS is missing numerics series "
+                           f"{missing} — the replica_divergence rule "
+                           "would read an unregistered series"))
+
+    # the report consumes the event + renders the counter tracks
+    if telemetry_report is not None:
+        tracked = set(getattr(telemetry_report, "TRACKED_EVENTS", ()))
+        if numerics.NUMERICS_EVENT not in tracked:
+            errors.append((REPORT_PATH,
+                           f"TRACKED_EVENTS is missing "
+                           f"{numerics.NUMERICS_EVENT!r} — numerics "
+                           "reports would be dropped from the report"))
+        counters = set(getattr(telemetry_report,
+                               "TRACE_COUNTER_KEYS", ()))
+        missing = sorted({"numerics.grad_norm", "numerics.divergence"} -
+                         counters)
+        if missing:
+            errors.append((REPORT_PATH,
+                           f"TRACE_COUNTER_KEYS is missing numerics "
+                           f"key(s) {missing} — the Perfetto export "
+                           "would drop the counter tracks"))
     return errors
 
 
@@ -963,6 +1058,17 @@ class SchemaDriftChecker(Checker):
             fleetmon_mod = None
         errors += fleetmon_schema_errors(fleetmon_mod, membership,
                                          telemetry, report)
+        # round 25: the numerics health plane — sentry-kind vocabulary,
+        # live record() gauge/event coverage, live grad_overflow raise,
+        # beacon series in the fleetmon snapshot schema, report/trace
+        # consumption (utils/numerics keeps jax out of module scope by
+        # contract, importable through the synthetic package)
+        try:
+            from theanompi_tpu.utils import numerics as numerics_mod
+        except ImportError:
+            numerics_mod = None
+        errors += numerics_schema_errors(numerics_mod, sentry,
+                                         fleetmon_mod, telemetry, report)
         # round 19: the §21 protocol model cross-checked live — the
         # extracted center op table must equal the ops a real
         # RemoteCenter sends (static view vs runtime surface; the

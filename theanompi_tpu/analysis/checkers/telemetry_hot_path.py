@@ -40,20 +40,23 @@ from ..core import Checker, Finding, ImportResolver, SourceFile, register
 
 HOT_BASENAMES = {"steps.py", "prefetch.py", "exchanger.py", "worker.py",
                  "async_easgd.py", "wire.py", "center_server.py",
-                 "fleetmon.py"}
+                 "fleetmon.py", "numerics.py"}
 
 TELEMETRY_MODULE = "theanompi_tpu.utils.telemetry"
 TRACING_MODULE = "theanompi_tpu.utils.tracing"
 FLEETMON_MODULE = "theanompi_tpu.utils.fleetmon"
+NUMERICS_MODULE = "theanompi_tpu.utils.numerics"
 
 # methods that record (cost when disabled = wasted work); the accessors
 # and `.enabled` reads are the sanctioned unguarded surface.  `begin`
 # (Tracer) and the emit_* one-shot helpers are the §17 span API;
 # `emit_alert` is the §20 fleet-health alert emitter (fleetmon.py joins
-# the hot set — its streamer/collector record into the same registry).
+# the hot set — its streamer/collector record into the same registry);
+# `record` is the §25 numerics report emitter (numerics.py joins too).
 RECORDING = {"counter", "gauge", "observe", "phase", "event",
              "system_snapshot", "dump_flight", "tail", "summary", "close",
-             "begin", "emit_wire_span", "emit_server_span", "emit_alert"}
+             "begin", "emit_wire_span", "emit_server_span", "emit_alert",
+             "record"}
 
 HANDLE_SOURCES = {TELEMETRY_MODULE + ".active", TELEMETRY_MODULE + ".init",
                   TRACING_MODULE + ".active", TRACING_MODULE + ".init"}
@@ -202,7 +205,7 @@ class TelemetryHotPathChecker(Checker):
         resolved_base = sf.resolver.resolve(func.value)
         is_handle = (base in handles) or \
             (resolved_base in (TELEMETRY_MODULE, TRACING_MODULE,
-                               FLEETMON_MODULE))
+                               FLEETMON_MODULE, NUMERICS_MODULE))
         if is_handle:
             findings.append(Finding(
                 self.name, sf.path, node.lineno, node.col_offset,

@@ -25,6 +25,7 @@ from ..parallel import steps
 from ..parallel.mesh import WORKER_AXIS, worker_mesh
 from ..utils import checkpoint as ckpt_lib
 from ..utils import helper_funcs
+from ..utils import numerics as numerics_lib
 from ..utils.opt import get_optimizer
 from . import layers as L
 
@@ -464,6 +465,12 @@ class ModelBase:
                 self.data.set_window(0)
         self.train_fn = steps.build_train_step(self.mesh, self,
                                                self.exchanger, n_steps=spc)
+        # numerics health plane (§25): the dispatch returns a 4th aux
+        # output exactly when the build sampled one (same gate as
+        # steps.graph_plan — fsdp has no params-shaped replica view)
+        self._numerics_on = numerics_lib.enabled(self.config) \
+            and self._fsdp is None
+        self.numerics_aux = None
         self.val_fn = steps.build_val_step(self.mesh, self)
         self._step_rng = jax.random.key(self.seed + 2)
         # Persistent AOT executable cache (utils/compile_cache.py): when a
@@ -777,9 +784,18 @@ class ModelBase:
         if recorder:
             recorder.end("stage")
             recorder.start()
-        self.step_state, cost, err = self.train_fn(
-            self.step_state, dev_batch, jnp.float32(self.current_lr),
-            self._step_rng, jnp.int32(count))
+        if getattr(self, "_numerics_on", False):
+            # the aux stays device-resident (async dispatch preserved) —
+            # the worker materializes it at print cadence, alongside
+            # cost/error
+            (self.step_state, cost, err,
+             self.numerics_aux) = self.train_fn(
+                self.step_state, dev_batch, jnp.float32(self.current_lr),
+                self._step_rng, jnp.int32(count))
+        else:
+            self.step_state, cost, err = self.train_fn(
+                self.step_state, dev_batch, jnp.float32(self.current_lr),
+                self._step_rng, jnp.int32(count))
         cost, err = jnp.mean(cost), jnp.mean(err)
         if recorder:
             recorder.end("train")

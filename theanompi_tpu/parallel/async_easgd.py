@@ -54,6 +54,8 @@ Round-4 extensions:
 
 from __future__ import annotations
 
+import json
+import os
 import threading
 import time
 from typing import Any, Callable, Dict, List, Optional
@@ -355,6 +357,15 @@ class IslandRunner(threading.Thread):
             rec.telemetry = tm
         rnd = None
         stage_base = 0.0
+        # chaos `corrupt` trigger (utils/chaos.py): the monkey drops a
+        # per-island trigger file; this island consumes it at its next
+        # exchange round and perturbs its OWN live replica — corruption
+        # from the inside, past every wire CRC
+        corrupt_path = None
+        chaos_dir = self.config.get("chaos_dir")
+        if chaos_dir:
+            corrupt_path = os.path.join(
+                str(chaos_dir), f"corrupt_w{self.island_id}.json")
         count = 0
         while not self.stop_event.is_set():
             count += 1
@@ -370,6 +381,22 @@ class IslandRunner(threading.Thread):
             if self.throttle_s:
                 time.sleep(self.throttle_s)
             if count % self.sync_freq == 0:
+                if corrupt_path is not None and \
+                        os.path.exists(corrupt_path):
+                    try:
+                        with open(corrupt_path) as f:
+                            doc = json.load(f)
+                        os.remove(corrupt_path)
+                        scale = float(doc.get("scale", 0.0)) or 1e-3
+                    except (OSError, ValueError):
+                        scale = None
+                    if scale is not None:
+                        leaves, td = jax.tree.flatten(
+                            model.step_state["params"])
+                        leaves[0] = leaves[0] + jnp.asarray(
+                            scale, leaves[0].dtype)
+                        model.step_state["params"] = \
+                            jax.tree.unflatten(td, leaves)
                 ctx = None
                 if rnd is not None:
                     # local-step wall time — the round residual beyond
@@ -390,6 +417,7 @@ class IslandRunner(threading.Thread):
                 # the center became (restored from snapshot, advanced by
                 # the other islands) while the supervisor respawns it.
                 outcome = "exchanged"
+                dist = None
                 try:
                     if self.rule == "asgd":
                         if anchor is None:
@@ -412,13 +440,22 @@ class IslandRunner(threading.Thread):
                             anchor = self.center.push_pull(
                                 delta, self.island_id, trace=ctx)
                             _set_params_from(anchor)
+                            dist = float(np.sqrt(sum(
+                                float(np.sum(np.square(
+                                    np.asarray(x, np.float64))))
+                                for x in jax.tree.leaves(delta))))
                     else:
                         center = self.center.pull(trace=ctx)
                         new_params, delta_mean = elastic_fn(
                             model.step_state["params"], center)
                         model.step_state["params"] = new_params
-                        self.center.push_delta(jax.device_get(delta_mean),
-                                               self.island_id, trace=ctx)
+                        dm = jax.device_get(delta_mean)
+                        self.center.push_delta(dm, self.island_id,
+                                               trace=ctx)
+                        dist = float(np.sqrt(sum(
+                            float(np.sum(np.square(
+                                np.asarray(x, np.float64))))
+                            for x in jax.tree.leaves(dm))))
                     self.exchanges_done += 1
                 except WireGiveUp:
                     outcome = "skipped"
@@ -451,6 +488,15 @@ class IslandRunner(threading.Thread):
                             anchor = self.center.pull()
                     except (WireGiveUp, CenterUninitialized):
                         pass           # next exchange gets another shot
+                if dist is not None and tm.enabled:
+                    # the §25 signals at HOST level for the elastic venue:
+                    # islands are separate processes with no cross-process
+                    # collective, so this island's ‖w−c‖ distance IS its
+                    # replica-divergence proxy — a corrupt perturbation
+                    # spikes it within one exchange round, and fleetmon's
+                    # replica_divergence rule reads the streamed gauge
+                    tm.gauge("numerics.dist_center", dist)
+                    tm.gauge("numerics.divergence", dist)
                 if rnd is not None:
                     rnd.end(outcome=outcome)
                     rnd = None

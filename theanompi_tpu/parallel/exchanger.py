@@ -417,6 +417,26 @@ class Exchanger:
         averages them so replicas stay bit-identical."""
         return bn_state
 
+    def numerics_extra(self, params, extra, axis):
+        """Rule-specific inputs for the numerics health plane
+        (utils/numerics, docs/design.md §25) — traced inside the step,
+        pure reads.  Keys, all optional:
+
+        * ``beacon_tree`` — a tree this rule keeps BIT-IDENTICAL across
+          workers (BSP grads-mode params, the EASGD/ASGD center copy):
+          the consistency beacon digests it, and any cross-rank digest
+          mismatch means replica desync.  Absent when replicas genuinely
+          diverge (gossip, local training) — healthy divergence must not
+          masquerade as corruption.
+        * ``center`` — the center-parameter tree, for the exact
+          ``‖w_i − c‖`` distance of the source paper.
+        * ``ef_state`` — the strategy's error-feedback/residual state,
+          for the EF-saturation norm.
+
+        The base rule trains locally between exchanges: nothing is
+        replicated, nothing is a center — no fields."""
+        return {}
+
     # -- exchange collective (Python cadence + jitted body) ----------------
 
     def due(self, count: int) -> bool:
@@ -641,6 +661,21 @@ class BSP_Exchanger(Exchanger):
         # step (cheap — BN state is tiny next to params).
         return jax.tree.map(lambda x: lax.pmean(x, axis), bn_state)
 
+    def numerics_extra(self, params, extra, axis):
+        out = {}
+        if self.mode == "grads" and self.strategy.name != "none":
+            # every worker applied the same reduced gradient (stateful
+            # strategies included — the decoded psum result is uniform
+            # even though the EF buffers differ), so post-update params
+            # are bit-identical: the beacon digests them.  Params mode
+            # samples PRE-exchange (replicas legitimately apart between
+            # cadenced averages) and the 'none' strategy never reduces —
+            # no beacon there.
+            out["beacon_tree"] = params
+        if self.strategy.stateful and "strat" in extra:
+            out["ef_state"] = extra["strat"]
+        return out
+
 
 def _canonical_center(exch: Exchanger, state):
     """The center-parameter tree out of BOXED state, for both center rules
@@ -743,6 +778,13 @@ class EASGD_Exchanger(Exchanger):
         against the server's center parameters)."""
         return _canonical_center(self, state)
 
+    def numerics_extra(self, params, extra, axis):
+        # the center copy is bit-identical across workers (every worker
+        # applies the same psum'd mean delta) — the beacon digests it,
+        # and ‖w_i − c‖ is the exact elastic distance of the source paper
+        center = self.unshard_extra(extra, axis)["center"]
+        return {"beacon_tree": center, "center": center}
+
 
 class ASGD_Exchanger(Exchanger):
     """Downpour-style push-pull (reference: ``ASGD_Exchanger`` — described
@@ -816,6 +858,11 @@ class ASGD_Exchanger(Exchanger):
 
     def canonical_params(self, state):
         return _canonical_center(self, state)
+
+    def numerics_extra(self, params, extra, axis):
+        # same contract as EASGD: replicated center = beacon + distance
+        center = self.unshard_extra(extra, axis)["center"]
+        return {"beacon_tree": center, "center": center}
 
 
 class GOSGD_Exchanger(Exchanger):

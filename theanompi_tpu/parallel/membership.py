@@ -1275,6 +1275,11 @@ def run_elastic(rule: str, modelfile: str, modelclass: str,
             os.remove(realized)
         except OSError:
             realized = None
+    # `corrupt` faults land as trigger files here; each island polls the
+    # dir at its exchange rounds (async_easgd) and perturbs its own live
+    # params — the §25 numerics plane must then catch the desync
+    corrupt_dir = os.path.join(record_dir, "chaos") \
+        if (record_dir and chaos_schedule) else None
     proxy = None
     worker_addr = addr
     if net_chaos_schedule:
@@ -1293,10 +1298,12 @@ def run_elastic(rule: str, modelfile: str, modelclass: str,
     metrics_addr = None
     if record_dir and config.get("fleetmon"):
         from ..utils.fleetmon import FleetMonServer, default_rules
+        divergence = config.get("fleetmon_divergence")
         rules = config.get("fleetmon_rules") or default_rules(
             heartbeat_s=float(config.get("fleetmon_heartbeat_s", 10.0)),
             step_p99_s=config.get("fleetmon_step_p99_s"),
-            step_window_s=float(config.get("fleetmon_step_window_s", 10.0)))
+            step_window_s=float(config.get("fleetmon_step_window_s", 10.0)),
+            divergence=None if divergence is None else float(divergence))
         fleetmon_srv = FleetMonServer(
             rules=rules, run_dir=record_dir,
             snapshot_dir=os.path.join(record_dir, "fleetmon_snap"),
@@ -1309,7 +1316,8 @@ def run_elastic(rule: str, modelfile: str, modelclass: str,
     for drop in ("lease_dir", "record_dir", "run_id", "center_addr",
                  "rule", "n_workers", "fleetmon", "fleetmon_rules",
                  "fleetmon_heartbeat_s", "fleetmon_step_p99_s",
-                 "fleetmon_step_window_s", "fleetmon_eval_s"):
+                 "fleetmon_step_window_s", "fleetmon_eval_s",
+                 "fleetmon_divergence"):
         base_kv.pop(drop, None)
 
     def cmd_for(wid: int, attempt: int) -> List[str]:
@@ -1318,6 +1326,8 @@ def run_elastic(rule: str, modelfile: str, modelclass: str,
                   steps=steps, host_devices=host_devices, run_id=run_id)
         if record_dir:
             kv["record_dir"] = record_dir
+        if corrupt_dir:
+            kv["chaos_dir"] = corrupt_dir
         if metrics_addr:
             kv["metrics_addr"] = metrics_addr
         return [sys.executable, "-m", "theanompi_tpu.parallel.membership",
@@ -1331,17 +1341,40 @@ def run_elastic(rule: str, modelfile: str, modelclass: str,
     kw.update(supervisor_kw or {})
     sup = ElasticSupervisor(cmd_for, list(range(1, n_workers + 1)),
                             lease_dir, **kw)
-    monkey = None
+    # progress-gated chaos: fault times are relative to the run MAKING
+    # PROGRESS (first lease beat with step >= 1), not to process spawn —
+    # a loaded box's slow first compile must not eat the schedule's whole
+    # window before training even exists (the kill-lands-mid-run
+    # guarantee the chaos tests assert).  Bounded fallback: if no step
+    # ever beats, the monkey starts anyway so no-pid drops still resolve.
+    monkey_box: List[Any] = []
+    gate_halt = threading.Event()
     if chaos_schedule:
         from ..utils.chaos import ChaosMonkey
-        monkey = ChaosMonkey(chaos_schedule, pid_of=sup.pid_of,
-                             telemetry_=tm, realized_path=realized)
-        monkey.start()
+
+        def _gated_start():
+            deadline = time.time() + min(120.0, float(timeout_s))
+            while time.time() < deadline and not gate_halt.is_set():
+                if any(int(doc.get("step", 0)) >= 1
+                       for doc in read_leases(lease_dir).values()):
+                    break
+                time.sleep(0.1)
+            if gate_halt.is_set():
+                return
+            m = ChaosMonkey(chaos_schedule, pid_of=sup.pid_of,
+                            telemetry_=tm, realized_path=realized,
+                            corrupt_dir=corrupt_dir)
+            monkey_box.append(m)
+            m.start()
+
+        threading.Thread(target=_gated_start, daemon=True,
+                         name="chaos-gate").start()
     try:
         rc = sup.run(timeout_s=timeout_s)
     finally:
-        if monkey is not None:
-            monkey.stop()
+        gate_halt.set()
+        for m in monkey_box:
+            m.stop()
         if proxy is not None:
             proxy.stop()
         # persist the final center + its bookkeeping for offline eval

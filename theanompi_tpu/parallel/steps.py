@@ -29,6 +29,7 @@ from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..jax_compat import shard_map
+from ..utils import numerics
 from .mesh import WORKER_AXIS, batch_sharding, worker_local_sharding
 
 
@@ -345,6 +346,29 @@ def build_train_step(mesh: Mesh, model, exchanger, n_steps: int = 1) -> Callable
     fsdp = getattr(model, "_fsdp", None)       # FsdpLayout when fsdp=true
     fuse_exchange = n_steps > 1 and exchanger.has_exchange()
     exchange_freq = int(getattr(exchanger, "exchange_freq", 1))
+    # numerics health plane (utils/numerics, docs/design.md §25): None
+    # unless config `numerics` is on — the off path below is byte-identical
+    # to a build without the plane (the inertness contract).  When on, the
+    # sample is computed under ``lax.cond(c % numerics_every == 0, ...)``
+    # (the same invariant-count cadence pattern as the fused exchange),
+    # carried as a latest-sample scan carry, and returned as a 4th output
+    # with one P(axis) out-spec per key — the boxed [n_workers] layout IS
+    # the beacon's cross-rank gather, with zero extra host round-trips.
+    nx = numerics.graph_plan(model, exchanger, axis)
+
+    def mark_varying(tree):
+        return jax.tree.map(lambda x: _vary(x, axis), tree)
+
+    def gated_sample(prev, ing, c):
+        """The cadence-gated sample: compute on ``c % every == 0``, else
+        keep the carried latest sample.  Both arms are re-marked worker-
+        varying — the compute arm's ``iter`` derives from the invariant
+        count while the carry is varying, and cond arms must agree."""
+        if nx.every == 1:
+            return mark_varying(nx.compute(*ing, c))
+        return lax.cond(c % nx.every == 0,
+                        lambda _: mark_varying(nx.compute(*ing, c)),
+                        lambda _: mark_varying(prev), 0)
 
     def fsdp_step(state, batch, lr, rng, count):
         # FSDP / ZeRO-3 (parallel/fsdp.py): state["params"] is this
@@ -403,6 +427,10 @@ def build_train_step(mesh: Mesh, model, exchanger, n_steps: int = 1) -> Callable
         if pu is not None:
             new_params, new_opt = pu(params, opt_state, new_params, new_opt,
                                      count)
+        # numerics ingredients (§25): the already-live old/new params,
+        # grads and extra — handed back for the cadence-gated sample at
+        # the per_worker level.  Pure reads; None keeps this path inert.
+        ing = None if nx is None else (params, new_params, grads, extra)
         params, opt_state = new_params, new_opt
         new_bn = _revary_bn(exchanger.sync_bn(new_bn, axis=axis, size=n),
                             axis)
@@ -413,26 +441,63 @@ def build_train_step(mesh: Mesh, model, exchanger, n_steps: int = 1) -> Callable
             "bn_state": box(new_bn),
             "extra": box(extra),
         }
-        return new_state, cost, err
+        if nx is None:
+            return new_state, cost, err
+        return new_state, cost, err, ing
 
     if n_steps == 1:
-        def per_worker(state, batch, lr, rng, count):
-            new_state, cost, err = one_step(state, batch, lr, rng, count)
-            return new_state, cost[None], err[None]
+        if nx is None:
+            def per_worker(state, batch, lr, rng, count):
+                new_state, cost, err = one_step(state, batch, lr, rng, count)
+                return new_state, cost[None], err[None]
+        else:
+            def per_worker(state, batch, lr, rng, count):
+                new_state, cost, err, ing = one_step(state, batch, lr, rng,
+                                                     count)
+                # no scan to carry a latest sample through: off-cadence
+                # dispatches return the template (iter=-1, host skips it)
+                smp = gated_sample(nx.template(), ing, count)
+                return (new_state, cost[None], err[None],
+                        jax.tree.map(lambda x: x[None], smp))
     elif not fuse_exchange:
-        def per_worker(state, batches, lr, rng, count):
-            # batches leaves: [k, local_rows, ...]; count names the LAST step
-            count0 = count - (n_steps - 1)
+        if nx is None:
+            def per_worker(state, batches, lr, rng, count):
+                # batches leaves: [k, local_rows, ...]; count names the
+                # LAST step
+                count0 = count - (n_steps - 1)
 
-            def body(carry, xs):
-                batch, j = xs
-                new_state, cost, err = one_step(carry, batch, lr, rng,
-                                                count0 + j)
-                return new_state, (cost, err)
+                def body(carry, xs):
+                    batch, j = xs
+                    new_state, cost, err = one_step(carry, batch, lr, rng,
+                                                    count0 + j)
+                    return new_state, (cost, err)
 
-            js = _vary(jnp.arange(n_steps), axis)
-            state, (costs, errs) = lax.scan(body, state, (batches, js))
-            return state, jnp.mean(costs)[None], jnp.mean(errs)[None]
+                js = _vary(jnp.arange(n_steps), axis)
+                state, (costs, errs) = lax.scan(body, state, (batches, js))
+                return state, jnp.mean(costs)[None], jnp.mean(errs)[None]
+        else:
+            def per_worker(state, batches, lr, rng, count):
+                # numerics needs an INVARIANT step counter for its cond
+                # predicate (js is worker-varying — a varying predicate
+                # would poison the collectives inside the sample), so the
+                # scan grows the same (c, latest-sample) carry the fused
+                # variant below already uses
+                count0 = count - (n_steps - 1)
+
+                def body(carry, xs):
+                    s, c, smp = carry
+                    batch, j = xs
+                    s, cost, err, ing = one_step(s, batch, lr, rng,
+                                                 count0 + j)
+                    smp = gated_sample(smp, ing, c)
+                    return (s, c + 1, smp), (cost, err)
+
+                js = _vary(jnp.arange(n_steps), axis)
+                smp0 = mark_varying(nx.template())
+                (state, _, smp), (costs, errs) = lax.scan(
+                    body, (state, count0, smp0), (batches, js))
+                return (state, jnp.mean(costs)[None], jnp.mean(errs)[None],
+                        jax.tree.map(lambda x: x[None], smp))
     else:
         def per_worker(state, batches, lr, rng, count):
             # fused cadence: the scan carries an INVARIANT step counter c
@@ -450,22 +515,48 @@ def build_train_step(mesh: Mesh, model, exchanger, n_steps: int = 1) -> Callable
                 # — re-mark, values untouched (same move as _revary_bn)
                 return jax.tree.map(lambda x: _vary(x, axis), s)
 
-            def body(carry, xs):
-                s, c = carry
-                batch, j = xs
-                s, cost, err = one_step(s, batch, lr, rng, count0 + j)
-                if exchange_freq == 1:
-                    s = do_exchange(s, c)
-                else:
-                    s = lax.cond(c % exchange_freq == 0,
-                                 lambda s: do_exchange(s, c),
-                                 lambda s: s, s)
-                return (s, c + 1), (cost, err)
+            if nx is None:
+                def body(carry, xs):
+                    s, c = carry
+                    batch, j = xs
+                    s, cost, err = one_step(s, batch, lr, rng, count0 + j)
+                    if exchange_freq == 1:
+                        s = do_exchange(s, c)
+                    else:
+                        s = lax.cond(c % exchange_freq == 0,
+                                     lambda s: do_exchange(s, c),
+                                     lambda s: s, s)
+                    return (s, c + 1), (cost, err)
 
-            js = _vary(jnp.arange(n_steps), axis)
-            (state, _), (costs, errs) = lax.scan(
-                body, (state, count0), (batches, js))
-            return state, jnp.mean(costs)[None], jnp.mean(errs)[None]
+                js = _vary(jnp.arange(n_steps), axis)
+                (state, _), (costs, errs) = lax.scan(
+                    body, (state, count0), (batches, js))
+                return state, jnp.mean(costs)[None], jnp.mean(errs)[None]
+            else:
+                def body(carry, xs):
+                    s, c, smp = carry
+                    batch, j = xs
+                    s, cost, err, ing = one_step(s, batch, lr, rng,
+                                                 count0 + j)
+                    if exchange_freq == 1:
+                        s = do_exchange(s, c)
+                    else:
+                        s = lax.cond(c % exchange_freq == 0,
+                                     lambda s: do_exchange(s, c),
+                                     lambda s: s, s)
+                    # sampled from the PRE-exchange ingredients: the stats
+                    # describe the step's own update; the beacon trees
+                    # (BSP params / the center copy) persist across the
+                    # exchange, so desync detection is unaffected
+                    smp = gated_sample(smp, ing, c)
+                    return (s, c + 1, smp), (cost, err)
+
+                js = _vary(jnp.arange(n_steps), axis)
+                smp0 = mark_varying(nx.template())
+                (state, _, smp), (costs, errs) = lax.scan(
+                    body, (state, count0, smp0), (batches, js))
+                return (state, jnp.mean(costs)[None], jnp.mean(errs)[None],
+                        jax.tree.map(lambda x: x[None], smp))
 
     state_spec = state_partition_specs(model, exchanger, axis)
     bs = model.batch_spec()
@@ -473,10 +564,14 @@ def build_train_step(mesh: Mesh, model, exchanger, n_steps: int = 1) -> Callable
     # n_steps > 1 prefixes the scan dim (round-4: composes with custom
     # batch specs — a sequence-parallel stack is P(None, workers, seq))
     batch_spec = P(*base) if n_steps == 1 else P(None, *base)
+    out_specs = (state_spec, P(axis), P(axis))
+    if nx is not None:
+        out_specs = out_specs + (
+            {k: P(axis) for k in numerics.SAMPLE_KEYS},)
     sm = shard_map(
         per_worker, mesh=mesh,
         in_specs=(state_spec, batch_spec, P(), P(), P()),
-        out_specs=(state_spec, P(axis), P(axis)),
+        out_specs=out_specs,
     )
     return jax.jit(sm, donate_argnums=(0,))
 

@@ -136,8 +136,10 @@ def crosscheck(record_dir: str, *, n_workers: int = 4,
                      gossip=False)
     fleet.run()
     realized_path = os.path.join(record_dir, "sim_realized.jsonl")
-    # live faults before ~6 s hit workers still importing jax; the monkey
-    # grace covers it, but landing them a touch later keeps them mid-run
+    # the live monkey's clock is progress-gated (membership.run_elastic
+    # starts it once every worker leases step >= 1), so rel times here
+    # are measured from real training progress, not from spawn — min_at
+    # only needs to keep the fault clear of the very first steps
     export_realized(fleet.realized, realized_path, min_at=6.0)
     sim_seq = sim_membership_sequence(fleet)
     out = {"sim": sim_seq, "realized_path": realized_path,
@@ -149,9 +151,13 @@ def crosscheck(record_dir: str, *, n_workers: int = 4,
     live_dir = os.path.join(record_dir, "live")
     proc_sched = [f for f in live_sched
                   if f.kind not in NET_FAULT_KINDS]
+    # SleepyModel stretches the live run past the schedule's last fault:
+    # with the progress-gated monkey a bare TinyModel burns all `steps`
+    # in well under the re-timed fault offsets, and the kill would land
+    # on a finished fleet (no death, sequence mismatch)
     rc = run_elastic(
-        "easgd", "tests.conftest", "TinyModel",
-        {"sync_freq": 2, "batch_size": 8}, n_workers,
+        "easgd", "tests.conftest", "SleepyModel",
+        {"sync_freq": 2, "batch_size": 8, "iter_sleep": 0.25}, n_workers,
         record_dir=live_dir, steps=steps, host_devices=1,
         chaos_schedule=proc_sched,
         net_chaos_schedule=[f for f in live_sched

@@ -135,7 +135,7 @@ class SimWorker:
                  "seqs", "round_seqs", "pending", "round_reply_t",
                  "retry_attempts", "wedged_until",
                  "slow_until", "persistent_factor", "round_t0",
-                 "last_beat", "delay_episodes")
+                 "last_beat", "delay_episodes", "divergence")
 
     def __init__(self, wid: int):
         self.wid = wid
@@ -154,6 +154,7 @@ class SimWorker:
         self.round_t0 = 0.0
         self.last_beat = -1.0
         self.delay_episodes = 0
+        self.divergence = 0.0        # §25 beacon spread (corrupt faults)
 
 
 class FleetSim:
@@ -434,6 +435,7 @@ class FleetSim:
         w.attempts += 1
         w.wedged_until = -1.0
         w.pending = 0
+        w.divergence = 0.0     # a respawn restores from the live center
         # a respawn of a straggler-demoted worker rejoins (the real
         # join→on_join path readmits it) — its α legitimately unfreezes
         self._alpha_at_demote.pop(wid, None)
@@ -496,7 +498,15 @@ class FleetSim:
         # the ranking exactly as it does in the live phase brackets
         self._window_sample(w, now - w.round_t0)
         if self.health is not None:
-            self.health.on_round(w.wid, now - w.round_t0)
+            self.health.on_round(w.wid, now - w.round_t0,
+                                 divergence=w.divergence)
+        if w.divergence:
+            # the elastic pull drags a corrupted replica back toward the
+            # center each round: decay until the rule's breach episode
+            # clears, so a LATER corrupt fault can re-alert (no-flapping
+            # episode semantics need the condition to go false between)
+            w.divergence = 0.0 if w.divergence < 1e-9 \
+                else w.divergence * 0.5
         w.round_t0 = now
         self._beat(w)
         w.steps_done += self.sync_freq
@@ -607,6 +617,19 @@ class FleetSim:
         elif fault.kind == "delay":
             w.slow_until = now + fault.duration
             w.delay_episodes += 1
+            self._realize(fault)
+        elif fault.kind == "corrupt":
+            # the live semantics (utils/chaos.py): the replica perturbs
+            # itself by `duration`-as-scale and the §25 beacon spread
+            # jumps; the next round's divergence sample must trip the
+            # replica_divergence rule within one beacon period.  The bad
+            # push then moves the CENTER, so every live replica's
+            # distance to the consensus spikes — the live elastic run
+            # alerts fleet-wide, and the rehearsal must match that set
+            scale = fault.duration or 1e-3
+            for peer in self.workers.values():
+                if peer.status != "dead":
+                    peer.divergence = max(peer.divergence, scale)
             self._realize(fault)
 
     def _center_restored(self) -> None:

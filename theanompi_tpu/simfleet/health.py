@@ -61,6 +61,12 @@ def sim_rules(fleet) -> list:
         {"name": "wire_degraded", "series": "wire_retries",
          "predicate": "rate_of_change", "op": ">", "value": 0.05,
          "window_s": 5.0, "scope": "rank", "roles": ("worker",)},
+        # the §25 beacon: any nonzero divergence sample above float
+        # noise is a replica that bit-desynced from the consensus — a
+        # `corrupt` fault sets it orders of magnitude above this floor
+        {"name": "replica_divergence", "series": "divergence",
+         "predicate": "threshold", "op": ">", "value": 1e-6,
+         "scope": "rank", "roles": ("worker",)},
     ]
 
 
@@ -101,11 +107,16 @@ class HealthPlane:
              "wire_retries": float(self._retries.get(wid, 0))},
             rank=wid, role="worker", status=status)
 
-    def on_round(self, wid: int, duration_s: float) -> None:
-        self.collector.ingest(
-            {"step_p99": float(duration_s),
-             "wire_retries": float(self._retries.get(wid, 0))},
-            rank=wid, role="worker")
+    def on_round(self, wid: int, duration_s: float,
+                 divergence: Optional[float] = None) -> None:
+        sample = {"step_p99": float(duration_s),
+                  "wire_retries": float(self._retries.get(wid, 0))}
+        if divergence is not None:
+            # every round carries the current beacon spread (0.0 when
+            # healthy) so the replica_divergence episode can CLEAR once
+            # the corruption is pulled back toward the center
+            sample["divergence"] = float(divergence)
+        self.collector.ingest(sample, rank=wid, role="worker")
 
     def on_wire_retry(self, wid: int) -> None:
         n = self._retries.get(wid, 0) + 1

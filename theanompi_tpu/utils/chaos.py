@@ -21,6 +21,15 @@ Fault kinds (POSIX process targets via ``pid_of``; in-process targets via
   exactly the case exit-code supervision misses.
 * ``delay`` — a straggler: ``delay_hook(target, duration)`` when given
   (in-process throttle), else a STOP/CONT pair of that duration.
+* ``corrupt`` — a parameter corruption that slipped PAST the wire CRC
+  (a bad apply, a flipped bit in device memory): the monkey drops a
+  ``corrupt_w<target>.json`` trigger under ``corrupt_dir`` and the
+  target worker perturbs its own live parameters at its next exchange
+  round.  The §25 numerics beacon must then raise
+  ``replica_divergence`` within one beacon period
+  (``fleetmon.FAULT_ALERT_COVERAGE``) — the detection this kind exists
+  to prove.  The ``duration`` field carries the perturbation SCALE
+  (0 = the 1e-3 default), not seconds.
 
 Stdlib-only on purpose: the harness must import (and the schedule parse
 must run) in jax-free tooling and in the lint CLI's no-backend process.
@@ -49,7 +58,7 @@ try:
 except ImportError:        # file-path load (jax-free tooling): absolute
     from theanompi_tpu.utils.clock import WALL
 
-FAULT_KINDS = ("kill", "stop", "delay")
+FAULT_KINDS = ("kill", "stop", "delay", "corrupt")
 
 # wire-level fault kinds (round 14): applied by the ChaosProxy to framed
 # center traffic instead of to processes.  ``at`` opens a fault WINDOW of
@@ -212,7 +221,8 @@ class ChaosMonkey(threading.Thread):
                  delay_hook: Optional[Callable[[int, float], None]] = None,
                  telemetry_=None, poll_s: float = 0.05,
                  grace_s: float = 10.0, t0: Optional[float] = None,
-                 clock=None, realized_path: Optional[str] = None):
+                 clock=None, realized_path: Optional[str] = None,
+                 corrupt_dir: Optional[str] = None):
         super().__init__(daemon=True, name="chaos-monkey")
         # net_* faults are the ChaosProxy's job — a pid-targeted monkey
         # given a mixed schedule must not SIGSTOP a process because a
@@ -228,6 +238,7 @@ class ChaosMonkey(threading.Thread):
         self.clock = clock or WALL
         self.t0 = self.clock.now() if t0 is None else float(t0)
         self.realized_path = realized_path
+        self.corrupt_dir = corrupt_dir
         self._halt = threading.Event()
         self.applied: List[Fault] = []
 
@@ -256,6 +267,29 @@ class ChaosMonkey(threading.Thread):
         """True when the fault landed (or permanently failed)."""
         if fault.kind == "delay" and self.delay_hook is not None:
             self.delay_hook(fault.target, fault.duration)
+            fault.applied = True
+            self._emit(fault, None)
+            return True
+        if fault.kind == "corrupt":
+            # no pid involved: the trigger file is consumed by the target
+            # worker itself at its next exchange round (async_easgd polls
+            # its chaos_dir) — corruption from the inside, past every CRC
+            if not self.corrupt_dir:
+                fault.error = "no-corrupt-dir"
+                fault.applied = True
+                self._emit(fault, None)
+                return True
+            scale = fault.duration if fault.duration > 0 else 1e-3
+            path = os.path.join(self.corrupt_dir,
+                                f"corrupt_w{fault.target}.json")
+            try:
+                os.makedirs(self.corrupt_dir, exist_ok=True)
+                tmp = f"{path}.tmp{os.getpid()}"
+                with open(tmp, "w") as f:
+                    json.dump({"target": fault.target, "scale": scale}, f)
+                os.replace(tmp, path)
+            except OSError as e:
+                fault.error = repr(e)
             fault.applied = True
             self._emit(fault, None)
             return True

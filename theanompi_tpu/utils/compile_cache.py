@@ -213,6 +213,16 @@ def key_extra(fn: str, model=None, exchanger=None,
             # Stamped only when v > 1 so every pre-existing key (and every
             # prewarmed fill/drain entry) stays byte-stable.
             extra["pp_interleave"] = v
+        cfg = getattr(model, "config", {}) or {}
+        if str(fn) == "train" and cfg.get("numerics", False) \
+                and getattr(model, "_fsdp", None) is None:
+            # the numerics health plane adds the aux out-path + cadence
+            # cond to the traced TRAIN step only (utils/numerics) —
+            # stamped only when effectively ON (fsdp builds stay inert),
+            # so every pre-existing key (and every numerics-off build)
+            # stays byte-stable
+            from . import numerics as _numerics
+            extra["numerics"] = _numerics.cadence(cfg)
         if getattr(model, "config", {}).get("update_sharding", False):
             # leaf-wise update-plane sharding reshapes the step (chunked
             # moments, fused allgather) AND its state avals; the threshold

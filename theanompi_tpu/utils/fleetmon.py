@@ -98,6 +98,10 @@ METRIC_FIELDS = (
                              # healed outage clears and a later fault
                              # re-alerts instead of latching forever)
     "steps",                 # heartbeat.iter gauge / caller extra
+    "grad_norm",             # numerics.grad_norm gauge (§25 plane)
+    "divergence",            # numerics.divergence gauge — the cross-rank
+                             # beacon spread; nonzero means replicas that
+                             # must agree bit-diverged
 )
 
 #: Series the collector maintains beyond the streamed fields — derived
@@ -134,6 +138,10 @@ FAULT_ALERT_COVERAGE = {
     "delay": ("step_time_degraded",),
     "net_partition": ("wire_degraded",),
     "net_drop": ("wire_degraded",),
+    # a parameter corruption that slips PAST the wire CRC (net_corrupt
+    # stays absorbed by design — this kind models the bad apply itself):
+    # the numerics beacon must catch the resulting replica desync
+    "corrupt": ("replica_divergence",),
 }
 
 
@@ -143,13 +151,16 @@ def default_rules(heartbeat_s: float = 10.0,
                   hbm_headroom_bytes: Optional[float] = None,
                   wire_retry_rate: float = 0.05,
                   wire_window_s: float = 5.0,
-                  queue_starved_window_s: float = 10.0) -> List[dict]:
-    """The stock rule set.  ``step_p99_s``/``hbm_headroom_bytes`` default
-    to None = rule omitted (absolute step-time and HBM budgets are
-    workload-specific; the heartbeat/retry/queue rules are not).  The
-    wire rule is rate-of-change over the CUMULATIVE retry counter
-    deliberately: a latched last-outage gauge would fire once and never
-    clear, so a second fault could never re-alert."""
+                  queue_starved_window_s: float = 10.0,
+                  divergence: Optional[float] = None) -> List[dict]:
+    """The stock rule set.  ``step_p99_s``/``hbm_headroom_bytes``/
+    ``divergence`` default to None = rule omitted (absolute step-time and
+    HBM budgets are workload-specific, and the divergence rule only means
+    something when the §25 numerics beacon streams; the heartbeat/retry/
+    queue rules are not).  The wire rule is rate-of-change over the
+    CUMULATIVE retry counter deliberately: a latched last-outage gauge
+    would fire once and never clear, so a second fault could never
+    re-alert."""
     rules = [
         {"name": "heartbeat_lost", "series": "heartbeat_age_s",
          "predicate": "threshold", "op": ">", "value": float(heartbeat_s),
@@ -176,6 +187,15 @@ def default_rules(heartbeat_s: float = 10.0,
              "predicate": "threshold", "op": "<",
              "value": float(hbm_headroom_bytes), "scope": "rank",
              "roles": ("worker",)})
+    if divergence is not None:
+        # threshold, not sustained: a single breaching beacon sample IS
+        # the symptom — bit-desync never heals on its own, and the §25
+        # acceptance bound is one beacon period, not a sustain window
+        rules.append(
+            {"name": "replica_divergence", "series": "divergence",
+             "predicate": "threshold", "op": ">",
+             "value": float(divergence), "scope": "rank",
+             "action": "flight_dump", "roles": ("worker",)})
     return rules
 
 
@@ -267,7 +287,9 @@ def snapshot_from_telemetry(tm, **extra) -> Dict[str, float]:
                          ("hbm_headroom_bytes", "hbm_min_headroom_bytes"),
                          ("queue_depth", "prefetch.queue_depth"),
                          ("wire_outage_s", "wire.outage_s"),
-                         ("steps", "heartbeat.iter")):
+                         ("steps", "heartbeat.iter"),
+                         ("grad_norm", "numerics.grad_norm"),
+                         ("divergence", "numerics.divergence")):
         v = tm.gauges.get(gauge)
         if v is not None:
             out[field] = float(v)

@@ -1,0 +1,371 @@
+"""Numerics health plane (docs/design.md §25).
+
+The training stack can trace time (§17), watch fleet health (§20) and
+attribute device cycles (§16), but none of that sees the *values* flowing
+through training: a silently desynced BSP replica, a rejoined worker whose
+``center_restore`` drifted, or a saturating error-feedback buffer all train
+on undetected until the loss diverges.  This module closes that gap with
+three pieces:
+
+* **In-graph tensor statistics** — grad/param/update global L2 norm,
+  max-abs, nonfinite count and update-to-param ratio, computed *inside*
+  the compiled train step at a configurable ``numerics_every`` cadence
+  (a ``lax.cond`` on the step count, the same pattern as the fused §8
+  exchange cadence) and carried out of the dispatch as a small auxiliary
+  pytree of per-worker f32 scalars.  Enabling them never adds a host
+  round-trip: the host materializes the aux at print cadence, exactly
+  when it already materializes cost/error.
+
+* **Cross-rank consistency beacons** — a cheap dtype-stable float digest
+  (per-leaf weighted f32 sums) of whatever tree the exchange rule declares
+  bit-identical across workers (``Exchanger.numerics_extra``): the params
+  under BSP grads mode, the center copy under EASGD/ASGD.  The boxed
+  ``[n_workers]`` aux layout IS the all_gather — the host compares the
+  per-rank digests and any bit-desync shows as ``divergence > 0`` within
+  one beacon period.  Rules with genuinely divergent replicas and no
+  replicated tree (GoSGD, BSP params mode between exchanges) mark the
+  beacon invalid rather than alarm on healthy divergence.
+
+* **The exact EASGD/ASGD distance** ``‖w_i − c‖`` — the central quantity
+  of the source paper — plus the per-strategy EF-buffer/residual norm for
+  the compressed wires (onebit/topk/powersgd).
+
+The observer is provably inert: with ``numerics`` unset, every code path
+in ``steps.build_train_step`` (and the compile-cache key) is byte-
+identical to a build without this module; with it set, the stats read the
+already-live values and change no update math (pinned per rule by
+``tests/test_numerics.py``).
+
+Module scope is stdlib-only (the §11 telemetry contract): the report/
+record plane runs on machines with no jax; the traced helpers import jax
+inside the function, at trace time.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Dict, Optional
+
+# -- schema -----------------------------------------------------------------
+
+# Aux-pytree keys every sampled step carries out of the dispatch (per-worker
+# f32 scalars; the host sees [n_workers] per key).  Fixed across rules —
+# concepts a rule lacks read 0.0 with the matching validity flag down.
+SAMPLE_KEYS = ("iter", "grad_norm", "grad_max_abs", "nonfinite",
+               "param_norm", "update_norm", "update_ratio",
+               "digest", "beacon", "dist_center", "ef_norm")
+
+# Telemetry gauge vocabulary `record` emits under the one-`enabled`-check
+# contract — the schema-drift checker probes live that every one of these
+# lands in the registry.
+NUMERICS_GAUGES = ("numerics.grad_norm", "numerics.grad_max_abs",
+                   "numerics.nonfinite", "numerics.param_norm",
+                   "numerics.update_norm", "numerics.update_ratio",
+                   "numerics.divergence", "numerics.dist_center",
+                   "numerics.ef_norm")
+
+# Histograms (distributions across reports, p95/p99 in telemetry_report)
+NUMERICS_HISTOGRAMS = ("numerics.grad_norm", "numerics.update_ratio")
+
+# The event kind one report emits (telemetry_report TRACKED_EVENTS member;
+# its numeric fields become Perfetto counter tracks)
+NUMERICS_EVENT = "numerics"
+
+# Sentry anomaly kinds the numerics detectors raise — must stay a subset
+# of sentry.ANOMALY_KINDS (schema-drift-probed)
+SENTRY_KINDS = ("grad_overflow", "update_ratio_collapse",
+                "replica_divergence")
+
+DEFAULT_EVERY = 1
+
+
+def enabled(config) -> bool:
+    """The ONE config gate: ``numerics=true``."""
+    return bool((config or {}).get("numerics", False))
+
+
+def cadence(config) -> int:
+    return max(1, int((config or {}).get("numerics_every", DEFAULT_EVERY)))
+
+
+def _leaf_weight(i: int) -> float:
+    """Deterministic per-leaf digest weight in [0.5, 1.5): a Knuth-hash LCG
+    on the leaf index, baked at trace time.  Distinct weights keep two
+    leaves' corruptions from cancelling in the digest sum."""
+    return 0.5 + ((i * 2654435761) % 65536) / 65536.0
+
+
+def _sharded_axes(spec, group):
+    """The group axes a PartitionSpec actually shards over (entries may be
+    axis names or tuples of names)."""
+    return tuple(a for e in (spec or ())
+                 for a in (e if isinstance(e, (tuple, list)) else (e,))
+                 if a in group)
+
+
+# -- traced plane (jax imported at trace time only) -------------------------
+
+class GraphPlan:
+    """The traced numerics sampler for one ``build_train_step`` build.
+
+    Constructed only when the plane is on (see :func:`graph_plan`);
+    ``steps.build_train_step`` then threads ``compute``'s sample dict
+    through the scan carry under ``lax.cond(count % every == 0, ...)``
+    and adds one ``P(axis)`` out-spec per key — the off path never sees
+    this class.
+    """
+
+    def __init__(self, model, exchanger, axis: str):
+        self.model = model
+        self.exchanger = exchanger
+        self.axis = axis
+        self.every = cadence(model.config)
+
+    # group axes (model/pipe) a tp layout shards leaves over — worker-axis
+    # stats psum over these so every rank reports the GLOBAL quantity
+    def _group(self):
+        return tuple(a for a in self.model.mesh.axis_names
+                     if a != self.axis)
+
+    def template(self):
+        """The not-yet-sampled aux value: zeros with ``iter = -1`` (the
+        host-side report treats a negative iter as 'no sample yet')."""
+        import jax.numpy as jnp
+        out = {k: jnp.float32(0.0) for k in SAMPLE_KEYS}
+        out["iter"] = jnp.float32(-1.0)
+        return out
+
+    def _tree_sq(self, tree, pspecs):
+        """Global Σx² over a params-shaped tree: per-leaf f32 square-sums,
+        psum'd over the group axes a leaf's spec shards (replicated leaves
+        counted once) — the same algebra as ``Exchanger._clip_grads``."""
+        import jax
+        import jax.numpy as jnp
+        from jax import lax
+        group = self._group()
+
+        def leaf_sq(x, spec=None):
+            v = jnp.sum(jnp.square(x.astype(jnp.float32)))
+            axes = _sharded_axes(spec, group) if spec is not None else ()
+            return lax.psum(v, axes) if axes else v
+
+        if pspecs is None or not group:
+            return sum(leaf_sq(x) for x in jax.tree.leaves(tree))
+        return sum(jax.tree.leaves(jax.tree.map(leaf_sq, tree, pspecs)))
+
+    def _tree_nonfinite(self, tree, pspecs):
+        import jax
+        import jax.numpy as jnp
+        from jax import lax
+        group = self._group()
+
+        def leaf_nf(x, spec=None):
+            v = jnp.sum((~jnp.isfinite(x.astype(jnp.float32)))
+                        .astype(jnp.float32))
+            axes = _sharded_axes(spec, group) if spec is not None else ()
+            return lax.psum(v, axes) if axes else v
+
+        if pspecs is None or not group:
+            return sum(leaf_nf(x) for x in jax.tree.leaves(tree))
+        return sum(jax.tree.leaves(jax.tree.map(leaf_nf, tree, pspecs)))
+
+    def _tree_max_abs(self, tree):
+        """Global max|x|: local max then pmax over the group axes — max is
+        idempotent over replicated leaves, so one unconditional pmax is
+        correct for every layout."""
+        import jax
+        import jax.numpy as jnp
+        from jax import lax
+        group = self._group()
+        m = jnp.float32(0.0)
+        for x in jax.tree.leaves(tree):
+            m = jnp.maximum(m, jnp.max(jnp.abs(x.astype(jnp.float32))))
+        return lax.pmax(m, group) if group else m
+
+    def _digest(self, tree, pspecs):
+        """Dtype-stable float digest: Σ_leaf w_i · Σ(leaf as f32), with the
+        deterministic per-leaf weights.  Bit-identical replicas produce
+        bitwise-equal digests (same values, same reduction order)."""
+        import jax
+        import jax.numpy as jnp
+        from jax import lax
+        group = self._group()
+
+        def leaf_sum(x, spec=None):
+            v = jnp.sum(x.astype(jnp.float32))
+            axes = _sharded_axes(spec, group) if spec is not None else ()
+            return lax.psum(v, axes) if axes else v
+
+        if pspecs is None or not group:
+            terms = [leaf_sum(x) for x in jax.tree.leaves(tree)]
+        else:
+            terms = jax.tree.leaves(jax.tree.map(leaf_sum, tree, pspecs))
+        total = jnp.float32(0.0)
+        for i, v in enumerate(terms):
+            total = total + jnp.float32(_leaf_weight(i)) * v
+        return total
+
+    def compute(self, params_old, params_new, grads, extra, count):
+        """One sample (dict over SAMPLE_KEYS of per-worker f32 scalars) —
+        traced inside the step, under the caller's cadence ``cond``.  Pure
+        reads of already-live values: touches no state, changes no update
+        math (the inertness contract)."""
+        import jax
+        import jax.numpy as jnp
+        from jax import lax
+        pspecs = self.model.param_specs()
+        group = self._group()
+        tiny = jnp.float32(1e-30)
+
+        grad_sq = self._tree_sq(grads, pspecs)
+        param_sq = self._tree_sq(params_new, pspecs)
+        upd = jax.tree.map(
+            lambda a, b: a.astype(jnp.float32) - b.astype(jnp.float32),
+            params_new, params_old)
+        upd_sq = self._tree_sq(upd, pspecs)
+        grad_norm = jnp.sqrt(grad_sq)
+        param_norm = jnp.sqrt(param_sq)
+        update_norm = jnp.sqrt(upd_sq)
+
+        out = {
+            "iter": jnp.asarray(count, jnp.float32),
+            "grad_norm": grad_norm,
+            "grad_max_abs": self._tree_max_abs(grads),
+            "nonfinite": self._tree_nonfinite(grads, pspecs),
+            "param_norm": param_norm,
+            "update_norm": update_norm,
+            "update_ratio": update_norm / jnp.maximum(param_norm, tiny),
+            "digest": jnp.float32(0.0),
+            "beacon": jnp.float32(0.0),
+            "dist_center": jnp.float32(0.0),
+            "ef_norm": jnp.float32(0.0),
+        }
+        nx = self.exchanger.numerics_extra(params_new, extra, self.axis)
+        beacon_tree = nx.get("beacon_tree")
+        if beacon_tree is not None:
+            out["digest"] = self._digest(beacon_tree, pspecs)
+            out["beacon"] = jnp.float32(1.0)
+        center = nx.get("center")
+        if center is not None:
+            dist_sq = self._tree_sq(
+                jax.tree.map(
+                    lambda p, c: p.astype(jnp.float32)
+                    - c.astype(jnp.float32), params_new, center), pspecs)
+            out["dist_center"] = jnp.sqrt(dist_sq)
+        ef = nx.get("ef_state")
+        if ef is not None:
+            # EF buffers are per-device divergent (each rank compresses its
+            # own residual): the global norm sums every rank's local Σx²
+            # over the group axes unconditionally
+            sq = sum(jnp.sum(jnp.square(x.astype(jnp.float32)))
+                     for x in jax.tree.leaves(ef))
+            if group:
+                sq = lax.psum(sq, group)
+            out["ef_norm"] = jnp.sqrt(sq)
+        return out
+
+
+def graph_plan(model, exchanger, axis: str) -> Optional[GraphPlan]:
+    """The traced sampler when the plane is active for this build, else
+    None — ``build_train_step``'s off path then never touches numerics.
+    FSDP chunks have no params-shaped replica view inside the step; the
+    plane stays off there (documented §25)."""
+    if not enabled(getattr(model, "config", None)):
+        return None
+    if getattr(model, "_fsdp", None) is not None:
+        return None
+    return GraphPlan(model, exchanger, axis)
+
+
+# -- host plane (stdlib only) -----------------------------------------------
+
+def host_report(aux) -> Optional[Dict[str, Any]]:
+    """Fold the device aux (dict of ``[n_workers]`` arrays, already
+    ``device_get``'d) into one host report dict, or None while no sample
+    has landed yet (``iter < 0``).
+
+    Aggregation is worst-rank: max norms/ratios, summed nonfinite counts;
+    ``divergence`` is ``max_i |digest_i − digest_0|`` over ranks whose
+    beacon is valid (None when the rule declares no beacon)."""
+    if aux is None:
+        return None
+    vals = {k: [float(x) for x in aux[k]] for k in SAMPLE_KEYS if k in aux}
+    iters = vals.get("iter", [])
+    if not iters or max(iters) < 0:
+        return None
+    n = len(iters)
+    report: Dict[str, Any] = {
+        "iter": int(max(iters)),
+        "n_workers": n,
+        "per_rank": vals,
+        "grad_norm": max(vals["grad_norm"]),
+        "grad_max_abs": max(vals["grad_max_abs"]),
+        "nonfinite": sum(vals["nonfinite"]),
+        "param_norm": max(vals["param_norm"]),
+        "update_norm": max(vals["update_norm"]),
+        "update_ratio": min(vals["update_ratio"]),
+        "dist_center": max(vals["dist_center"]),
+        "ef_norm": max(vals["ef_norm"]),
+    }
+    beacon = vals.get("beacon", [0.0] * n)
+    digests = vals.get("digest", [0.0] * n)
+    valid = [d for d, b in zip(digests, beacon) if b > 0]
+    if len(valid) >= 2:
+        # bitwise-equal replicas give exactly-equal digests; compare
+        # against rank 0's so a single desynced rank shows as > 0.  A
+        # non-finite digest is itself a divergence signal (a corrupted
+        # replica whose params went inf/nan still must trip the beacon —
+        # nan diffs would slip through a bare max()'s comparisons).
+        ref = valid[0]
+        diffs = [abs(d - ref) for d in valid]
+        report["divergence"] = float("inf") if any(
+            not math.isfinite(x) for x in diffs) else max(diffs)
+    else:
+        report["divergence"] = None
+    return report
+
+
+def example_report(n: int = 2) -> Dict[str, Any]:
+    """A schema-complete healthy report (checker probes, tests)."""
+    aux = {k: [0.0] * n for k in SAMPLE_KEYS}
+    aux["iter"] = [1.0] * n
+    aux["beacon"] = [1.0] * n
+    aux["param_norm"] = [1.0] * n
+    aux["grad_norm"] = [0.5] * n
+    aux["update_norm"] = [0.01] * n
+    aux["update_ratio"] = [0.01] * n
+    return host_report(aux)
+
+
+def record(tm, report, *, rank: Optional[int] = None) -> None:
+    """Emit one report into telemetry: every NUMERICS_GAUGES gauge, the
+    NUMERICS_HISTOGRAMS distributions, and one NUMERICS_EVENT carrying the
+    numeric fields (the Perfetto counter tracks + flight-ring context).
+    ONE ``enabled`` check guards the whole emission (§11 contract)."""
+    if not tm.enabled or report is None:
+        return
+    div = report.get("divergence")
+    gauges = {
+        "numerics.grad_norm": report["grad_norm"],
+        "numerics.grad_max_abs": report["grad_max_abs"],
+        "numerics.nonfinite": report["nonfinite"],
+        "numerics.param_norm": report["param_norm"],
+        "numerics.update_norm": report["update_norm"],
+        "numerics.update_ratio": report["update_ratio"],
+        "numerics.divergence": 0.0 if div is None else div,
+        "numerics.dist_center": report["dist_center"],
+        "numerics.ef_norm": report["ef_norm"],
+    }
+    for name, value in gauges.items():
+        tm.gauge(name, value)
+    for name in NUMERICS_HISTOGRAMS:
+        tm.observe(name, gauges[name])
+    fields = {k: report[k] for k in ("iter", "grad_norm", "grad_max_abs",
+                                     "nonfinite", "param_norm",
+                                     "update_norm", "update_ratio",
+                                     "dist_center", "ef_norm")}
+    fields["divergence"] = div
+    fields["beacon"] = int(div is not None)
+    if rank is not None:
+        fields["rank"] = rank
+    tm.event(NUMERICS_EVENT, **fields)

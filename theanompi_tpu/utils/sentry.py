@@ -15,7 +15,24 @@ training run:
   (robust: one spike can't poison its own baseline, and WGAN-style
   negative losses don't break a ratio test);
 * **throughput_regression** — images/sec drops below
-  ``sentry_tput_drop`` × the rolling median.
+  ``sentry_tput_drop`` × the rolling median;
+* **grad_overflow** — the numerics plane (utils/numerics, §25) reports
+  nonfinite gradient entries or a non-finite gradient norm;
+* **update_ratio_collapse** — the update-to-param ratio falls below the
+  absolute ``sentry_ratio_floor`` while gradients are nonzero: the
+  optimizer is applying nothing (a zeroed LR resume, a saturated scale);
+* **replica_divergence** — the cross-rank consistency beacon reports a
+  digest mismatch beyond ``sentry_divergence_eps`` between replicas the
+  exchange rule declares bit-identical (BSP post-reduce params, the
+  EASGD/ASGD center copy).
+
+The numerics detectors run off :meth:`observe_numerics` (fed the
+``numerics.host_report`` dict at the same print cadence) and honor
+:meth:`notice_discontinuity` exactly like the throughput detector: the
+first report after a val/ckpt/restore boundary may describe a
+legitimately transient state (a ``center_restore`` rejoin pulls
+‖w−c‖ and the beacon through a real discontinuity) and is neither
+judged nor learned from.
 
 Detection runs at print cadence only (never per step — zero hot-path
 cost), emits :data:`ANOMALY_EVENT` events through the PR 4 telemetry
@@ -43,7 +60,9 @@ from statistics import median
 from typing import Any, Dict, List, Optional, Tuple
 
 ANOMALY_EVENT = "anomaly"
-ANOMALY_KINDS = ("nan_loss", "loss_spike", "throughput_regression")
+ANOMALY_KINDS = ("nan_loss", "loss_spike", "throughput_regression",
+                 "grad_overflow", "update_ratio_collapse",
+                 "replica_divergence")
 
 
 class TrainingSentry:
@@ -62,10 +81,14 @@ class TrainingSentry:
         self.verbose = bool(config.get("verbose", True))
         self._costs: deque = deque(maxlen=self.window)
         self._tputs: deque = deque(maxlen=self.window)
+        self.ratio_floor = float(config.get("sentry_ratio_floor", 1e-12))
+        self.divergence_eps = float(config.get("sentry_divergence_eps", 0.0))
         self.records_seen = 0
         self.anomalies: List[Tuple[str, Any]] = []      # (kind, iter)
         self._dumped: set = set()
         self._tput_discontinuity = False
+        self._numerics_discontinuity = False
+        self._numerics_last_iter: Optional[int] = None
 
     def notice_discontinuity(self) -> None:
         """The caller declares the next record's throughput unrepresentative
@@ -73,8 +96,11 @@ class TrainingSentry:
         print, so the first record after a validation pass / checkpoint /
         shuffle spans that dead time and would read as a regression.  The
         next record's throughput is neither judged nor learned from; loss
-        detection is unaffected (cost has no wall-time denominator)."""
+        detection is unaffected (cost has no wall-time denominator).  The
+        numerics detectors honor the same boundary (the first report after
+        it may describe a transient rejoin/restore state)."""
         self._tput_discontinuity = True
+        self._numerics_discontinuity = True
 
     # -- detection ----------------------------------------------------------
 
@@ -137,6 +163,52 @@ class TrainingSentry:
                 self._costs.append(cost)
             if tput_ok:
                 self._tputs.append(float(ips))
+        if kind is not None:
+            self._raise(kind, it, detail)
+        return kind
+
+    def observe_numerics(self, report: Optional[dict]) -> Optional[str]:
+        """Feed one ``numerics.host_report`` dict (print cadence); returns
+        the anomaly kind raised, first match wins — an overflow is not
+        ALSO judged for divergence.  Detectors are absolute-threshold
+        (no rolling baseline): a corrupted replica or a zeroed update is
+        anomalous from the very first report, which is what lets the
+        chaos/SIGTERM coverage tests assert deterministically."""
+        if report is None:
+            return None
+        it = report.get("iter")
+        # the aux is a latest-sample carry — the same sample can surface
+        # under several print records at a sparse cadence; judge each
+        # sampled step once
+        if it is not None and it == self._numerics_last_iter:
+            return None
+        self._numerics_last_iter = it
+        if self._numerics_discontinuity:
+            # val/ckpt/restore boundary: a center_restore rejoin or a
+            # checkpoint reload legitimately moves ‖w−c‖/the beacon —
+            # the first report after it is neither judged nor learned from
+            self._numerics_discontinuity = False
+            return None
+        grad_norm = float(report.get("grad_norm", 0.0))
+        nonfinite = float(report.get("nonfinite", 0.0))
+        kind: Optional[str] = None
+        detail: Dict[str, Any] = {}
+        if nonfinite > 0 or not math.isfinite(grad_norm):
+            kind = "grad_overflow"
+            detail = {"nonfinite": nonfinite, "grad_norm": str(grad_norm)}
+        if kind is None:
+            div = report.get("divergence")
+            if div is not None and div > self.divergence_eps:
+                kind = "replica_divergence"
+                detail = {"divergence": str(div),
+                          "threshold": self.divergence_eps}
+        if kind is None:
+            ratio = float(report.get("update_ratio", 1.0))
+            if grad_norm > 0 and ratio < self.ratio_floor:
+                kind = "update_ratio_collapse"
+                detail = {"update_ratio": ratio,
+                          "grad_norm": grad_norm,
+                          "floor": self.ratio_floor}
         if kind is not None:
             self._raise(kind, it, detail)
         return kind

@@ -20,7 +20,7 @@ from typing import Optional
 
 from .base import MeshProcess
 from .parallel.exchanger import get_exchanger
-from .utils import devprof, telemetry, tracing
+from .utils import devprof, numerics, telemetry, tracing
 from .utils.recorder import Recorder
 from .utils.sentry import TrainingSentry
 from .utils.watchdog import StallWatchdog
@@ -271,8 +271,25 @@ class Worker(MeshProcess):
                             telem.system_snapshot(
                                 iter=count, epoch=epoch,
                                 images_per_sec=rec["images_per_sec"])
+                        # numerics health plane (§25): materialize the
+                        # device aux exactly when cost/error already
+                        # materialize — the in-graph sampler added no
+                        # host round-trip, and this one rides the print
+                        # cadence the run pays anyway
+                        n_report = None
+                        if rec and telem.enabled and \
+                                getattr(model, "numerics_aux",
+                                        None) is not None:
+                            import jax
+                            n_report = numerics.host_report(
+                                jax.device_get(model.numerics_aux))
+                            numerics.record(
+                                telem, n_report,
+                                rank=int(config.get("rank", self.rank)))
                         if rec and sentry is not None:
                             sentry.observe_record(rec)
+                            if n_report is not None:
+                                sentry.observe_numerics(n_report)
 
                     model.begin_val()
                     for _ in range(model.data.n_batch_val):
