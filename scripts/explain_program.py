@@ -16,7 +16,10 @@ is answerable without recompiling anything.
 NEWEST matching entry wins — labels repeat across spc/batch variants).
 The diff prints per-field deltas: where did the flops/HBM go between two
 variants of the same program (e.g. ``train:AlexNet:spc1`` vs ``spc4``,
-or a donated entry vs its donation-free twin).
+or a donated entry vs its donation-free twin) — and, for entries that
+recorded their ``key_extra`` dict (PR 20+), a structured stamp diff
+naming WHICH knob produced the key split, using the cache-key checker's
+stamp vocabulary (``analysis/checkers/compile_surface.STAMP_MEANING``).
 
 Stdlib only — reads ``manifest.json``, never unpickles entry bodies.
 """
@@ -109,6 +112,57 @@ def print_table(manifest):
               "cost manifest — re-prewarm to populate)", file=sys.stderr)
 
 
+def _stamp_meanings():
+    """The cache-key checker's stamp vocabulary, imported through the
+    scripts/lint.py synthetic-package bootstrap so jax never loads; an
+    unimportable checker degrades to bare stamp names, never a crash."""
+    try:
+        if "theanompi_tpu" not in sys.modules:
+            import importlib.machinery
+            import types
+            root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+            sys.path.insert(0, root)
+            pkg = types.ModuleType("theanompi_tpu")
+            pkg.__path__ = [os.path.join(root, "theanompi_tpu")]
+            pkg.__spec__ = importlib.machinery.ModuleSpec(
+                "theanompi_tpu", loader=None, is_package=True)
+            pkg.__spec__.submodule_search_locations = pkg.__path__
+            sys.modules["theanompi_tpu"] = pkg
+        from theanompi_tpu.analysis.checkers.compile_surface import \
+            STAMP_MEANING
+        return dict(STAMP_MEANING)
+    except Exception:
+        return {}
+
+
+def print_extra_diff(a, b):
+    """The structured ``key_extra`` stamp diff — which knob split the
+    key.  Entries written before PR 20 carry no ``extra``; say so
+    instead of pretending the stamps match."""
+    ea, eb = a.get("extra"), b.get("extra")
+    print("key_extra:")
+    if ea is None or eb is None:
+        which = "both" if ea is None and eb is None else \
+            "A" if ea is None else "B"
+        print(f"  ({which} predate the extras manifest — re-prewarm to "
+              "record the stamp dicts)")
+        return
+    meanings = _stamp_meanings()
+    differing = sorted(k for k in set(ea) | set(eb)
+                       if ea.get(k) != eb.get(k))
+    if not differing:
+        print("  identical — the key split came from the traced program "
+              "itself (HLO hash, avals, shardings, donation), not a "
+              "config knob")
+        return
+    for k in differing:
+        va = ea.get(k, "<unstamped>")
+        vb = eb.get(k, "<unstamped>")
+        meaning = meanings.get(k)
+        tail = f"  ({meaning})" if meaning else ""
+        print(f"  {k:<14}{str(va):>14} -> {str(vb):<14}{tail}")
+
+
 def print_diff(manifest, a_tok, b_tok):
     ak, a = resolve(manifest, a_tok)
     bk, b = resolve(manifest, b_tok)
@@ -137,6 +191,7 @@ def print_diff(manifest, a_tok, b_tok):
                  if isinstance(va, (int, float)) and va
                  and isinstance(vb, (int, float)) else "-")
         print(f"  {field:<24}{fmt(va):>14}{fmt(vb):>14}{ratio:>8}")
+    print_extra_diff(a, b)
     return 0
 
 

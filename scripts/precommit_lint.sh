@@ -16,6 +16,10 @@
 # blob and deliberately does NOT re-implement the filter (a second copy
 # could drift and silently drop files from the verdict).  Exit codes
 # follow scripts/lint.py: 0 clean, 1 findings, 2 usage.
+#
+# TPULINT_SARIF=<path>: additionally write a SARIF 2.1.0 log of the NEW
+# findings to <path> (CI PR-diff annotation).  The extra invocation
+# shares the repo's result cache, so it costs one warm-cache hit.
 set -u
 cd "$(dirname "$0")/.."
 repo="$PWD"
@@ -38,3 +42,14 @@ python scripts/lint.py --root "$tmp" \
     --baseline "$repo/tpulint_baseline.json" \
     --cache-dir "$repo/.tpulint_cache" \
     --diff CACHED
+rc=$?
+
+if [ -n "${TPULINT_SARIF:-}" ]; then
+    python scripts/lint.py --root "$tmp" \
+        --baseline "$repo/tpulint_baseline.json" \
+        --cache-dir "$repo/.tpulint_cache" \
+        --diff CACHED --format sarif > "$TPULINT_SARIF" \
+        || echo "precommit-lint: SARIF emit failed (verdict above stands)" >&2
+fi
+
+exit "$rc"

@@ -19,7 +19,14 @@
 # DedupWindow claim dominance on every mutating handler path, §15
 # retry-verdict/close-taxonomy checks, membership state-machine
 # exhaustiveness incl. reactor hooks and the versioned wire-header
-# field vocabulary).  Any finding not covered by
+# field vocabulary), and the compile-surface pass (design.md §26:
+# cache-key completeness — config knobs that shape a traced program
+# reachable from the AOT surfaces must reach a guarded
+# compile_cache.key_extra stamp, cross-checked against a live stamping
+# probe — plus retrace hazards like fresh-lambda jit identity,
+# jit-in-loop, non-static shape params and .lower() on an installed
+# Compiled, and bf16-wire dtype-flow discipline incl. the per-module
+# NONBITEXACT round-trip registry).  Any finding not covered by
 # tpulint_baseline.json — or a stale baseline entry — fails the gate
 # here, without importing jax, before pytest.  An unchanged tree is a
 # .tpulint_cache/ hit: the gate costs well under a second.

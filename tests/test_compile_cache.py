@@ -248,7 +248,17 @@ def test_rows_manifest_consistency():
     labels = [r.label for r in ROWS]
     assert len(set(labels)) == len(labels), "duplicate row labels"
     for row in ROWS:
-        model_name, rule, config, flags = bench.bench_row_config(row.env)
+        # bench_row_config force-exports THEANOMPI_TPU_NO_PALLAS for
+        # oracle-control rows — keep that out of the test process
+        saved_np = os.environ.get("THEANOMPI_TPU_NO_PALLAS")
+        try:
+            model_name, rule, config, flags = \
+                bench.bench_row_config(row.env)
+        finally:
+            if saved_np is None:
+                os.environ.pop("THEANOMPI_TPU_NO_PALLAS", None)
+            else:
+                os.environ["THEANOMPI_TPU_NO_PALLAS"] = saved_np
         assert row.label.startswith(model_name), row
         # bench.py's fallback matcher must recognize the row's own label
         # under the row's own env (the contract last_good relies on)

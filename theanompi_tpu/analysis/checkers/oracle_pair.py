@@ -187,6 +187,10 @@ class OraclePairChecker(Checker):
                    "equality test) — the oracle is the non-TPU dispatch "
                    "target, so an unpaired kernel diverges silently")
     reads_files = False    # disk-scoped project probe: --diff safe
+    # the audit reads ops/ and tests/ from disk regardless of the lint
+    # selection — declaring them keys partial runs' result cache on
+    # their content (core.Checker.disk_scoped)
+    disk_scoped = (OPS_GLOB, TESTS_GLOB)
 
     def check_project(self, files) -> List[Finding]:
         return oracle_pair_findings(

@@ -47,6 +47,9 @@ from ..core import Checker, Finding, register
 # another if a module ever moved (review finding, round 19)
 from ..protocol import (CENTER_PATH, FLEETMON_PATH, MEMBERSHIP_PATH,
                         TRACING_PATH, WIRE_PATH)
+# the key_extra vocabulary has ONE home — the compile-surface pass; the
+# round-26 probe cross-checks it against a live stamping run
+from .compile_surface import COMPILE_CACHE_PATH
 
 TELEMETRY_PATH = "theanompi_tpu/utils/telemetry.py"
 RECORDER_PATH = "theanompi_tpu/utils/recorder.py"
@@ -787,6 +790,106 @@ def numerics_schema_errors(numerics, sentry, fleetmon, telemetry,
     return errors
 
 
+def key_extra_schema_errors(compile_cache_mod=None,
+                            root: Optional[str] = None) -> List[tuple]:
+    """Round-26 probe: the cache-key checker's statically-extracted
+    ``key_extra`` stamp vocabulary must equal the keys a REAL
+    ``key_extra`` run stamps (the stamping call every compile surface —
+    ``compile_iter_fns``, bench, prewarm — goes through), and both must
+    equal the checker's ``STAMP_KNOBS`` coverage registry — so neither
+    the extraction rules nor the registry can go stale (the PR 15
+    center-protocol precedent).  jax-free by construction:
+    ``compile_cache`` keeps jax out of module scope, the probe config
+    pins ``ushard_min_bytes`` so the ushard branch never imports
+    ``update_sharding``, and ``THEANOMPI_TPU_NO_PALLAS`` is forced for
+    the maximal call.  Also pins the §26 byte-stability floor: a
+    knob-less ``key_extra("val")`` must stay exactly ``{"fn": "val"}``."""
+    from ..core import SourceFile
+    from .compile_surface import (COMPILE_CACHE_PATH, STAMP_KNOBS,
+                                  key_extra_vocabulary)
+    errors: List[tuple] = []
+    if compile_cache_mod is None:
+        try:
+            from theanompi_tpu.utils import compile_cache as \
+                compile_cache_mod
+        except ImportError:
+            return errors
+    if root is None:
+        root = os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__)))))
+    if not os.path.exists(os.path.join(root, COMPILE_CACHE_PATH)):
+        return errors
+    try:
+        sf = SourceFile(root, COMPILE_CACHE_PATH)
+    except (OSError, SyntaxError, ValueError):
+        return errors            # the parse step reports it already
+    static_stamps, _knobs, _problems = key_extra_vocabulary(sf)
+
+    # a maximal probe call: every guarded stamp switched on at once
+    class _ProbeStrategy:
+        name = "probe"
+
+    class _ProbeExchanger:
+        strategy = _ProbeStrategy()
+        mode = "params"
+        exchange_freq = 2
+        bucket_bytes = 1 << 20
+
+    class _ProbeModel:
+        n_subb = 2
+        pp_interleave = 2
+        _fsdp = None
+        config = {"numerics": True, "update_sharding": True,
+                  "ushard_min_bytes": 4096}
+
+    # the whole probe pins THEANOMPI_TPU_NO_PALLAS — "1" for the
+    # maximal call, absent for the byte-stability floor — so the
+    # verdict (which the result cache stores keyed on file contents)
+    # never depends on whatever the host process happens to export
+    saved = os.environ.get("THEANOMPI_TPU_NO_PALLAS")
+    os.environ["THEANOMPI_TPU_NO_PALLAS"] = "1"
+    try:
+        try:
+            live = compile_cache_mod.key_extra(
+                "train", model=_ProbeModel(),
+                exchanger=_ProbeExchanger(), spc=3)
+        except Exception as e:
+            return [(COMPILE_CACHE_PATH,
+                     f"the maximal jax-free key_extra probe call raised "
+                     f"{e!r} — the stamping path must stay callable "
+                     f"without a backend")]
+        os.environ.pop("THEANOMPI_TPU_NO_PALLAS", None)
+        base = compile_cache_mod.key_extra("val")
+    finally:
+        if saved is None:
+            os.environ.pop("THEANOMPI_TPU_NO_PALLAS", None)
+        else:
+            os.environ["THEANOMPI_TPU_NO_PALLAS"] = saved
+
+    if set(static_stamps) != set(live):
+        errors.append((COMPILE_CACHE_PATH,
+                       f"statically-extracted key_extra stamps "
+                       f"{sorted(static_stamps)} != keys a maximal live "
+                       f"key_extra run stamped {sorted(live)} — the "
+                       "cache-key checker's extraction rules drifted"))
+    if set(live) != set(STAMP_KNOBS):
+        errors.append((COMPILE_CACHE_PATH,
+                       f"live key_extra stamps {sorted(live)} != the "
+                       f"cache-key checker's STAMP_KNOBS registry "
+                       f"{sorted(STAMP_KNOBS)} — declare (or drop) the "
+                       "coverage entry in "
+                       "analysis/checkers/compile_surface.py"))
+
+    # §26 byte-stability floor: knob-less extras are frozen
+    if base != {"fn": "val"}:
+        errors.append((COMPILE_CACHE_PATH,
+                       f"key_extra('val') returned {base!r} — a "
+                       "knob-less config's extras must stay exactly "
+                       "{'fn': 'val'} so every pre-existing cache key "
+                       "is byte-stable"))
+    return errors
+
+
 def thread_role_coverage_errors(root: Optional[str] = None) -> List[tuple]:
     """Round-15 probe: the host-concurrency pass is only as good as its
     thread-role map, so every ``threading.Thread(...)``/``Timer(...)``
@@ -1004,6 +1107,12 @@ class SchemaDriftChecker(Checker):
                    "bench trace columns must match their declared "
                    "vocabularies (live-object probe)")
     reads_files = False    # `--only schema-drift` skips the repo parse
+    # every file the live probes load beyond the lint selection — the
+    # runner folds these into partial runs' cache keys (core.Checker)
+    disk_scoped = (RECORDER_PATH, TELEMETRY_PATH, DEVPROF_PATH,
+                   SENTRY_PATH, REPORT_PATH, MEMBERSHIP_PATH,
+                   CHAOS_PATH, WIRE_PATH, TRACING_PATH, FLEETMON_PATH,
+                   CENTER_PATH, NUMERICS_PATH, COMPILE_CACHE_PATH)
 
     def check_project(self, files):
         # normal import both under pytest (real package loaded) and under
@@ -1080,5 +1189,9 @@ class SchemaDriftChecker(Checker):
         # round 15: the thread-role map must see and resolve every
         # Thread/Timer spawn in the thread-heaviest runtime modules
         errors += thread_role_coverage_errors()
+        # round 26: the key_extra stamp vocabulary, static extraction vs
+        # a real (jax-free) stamping run vs the cache-key checker's
+        # coverage registry
+        errors += key_extra_schema_errors()
         return [Finding(self.name, path, 1, 0, msg)
                 for path, msg in errors]

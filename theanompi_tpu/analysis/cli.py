@@ -12,6 +12,9 @@ Modes:
 * ``--format json`` (``--json`` kept as an alias): machine-readable
   findings + baseline delta; every finding carries a stable
   ``fingerprint`` (schema in docs/design.md §12).
+* ``--format sarif``: SARIF 2.1.0 log of the NEW findings (stable
+  fingerprints → ``partialFingerprints``) for CI diff annotation;
+  ``precommit_lint.sh`` writes one when ``TPULINT_SARIF`` is set.
 * ``--only`` / ``--disable``: comma-separated checker names;
   ``--list-checks`` prints the registry.
 * ``--diff <ref>``: lint only the ``.py`` files changed vs a git ref
@@ -120,27 +123,31 @@ def _cached_run(root, paths, only, disable, cache_dir=None):
                       and (disable is None or n not in disable))
     rels = iter_py_paths(root, paths)
     lint_rels = {r.replace(os.sep, "/") for r in rels}
-    if "schema-drift" in selected:
-        # EVERY file the live probes load must key the cache even on
-        # partial runs whose path set does not cover it — but they are
-        # NOT part of the linted set then, so no per-file entry may be
-        # stored for them (it would read as "no findings" to a later
-        # full run).  Omitting one (e.g. membership.py for the round-15
-        # thread-role coverage probe) would let a stale tree hit mask a
-        # drift the probe exists to catch.
-        from .checkers.schema_drift import (CENTER_PATH, CHAOS_PATH,
-                                            DEVPROF_PATH, FLEETMON_PATH,
-                                            MEMBERSHIP_PATH, RECORDER_PATH,
-                                            REPORT_PATH, SENTRY_PATH,
-                                            TELEMETRY_PATH, TRACING_PATH,
-                                            WIRE_PATH)
-        for probe in (RECORDER_PATH, TELEMETRY_PATH, DEVPROF_PATH,
-                      SENTRY_PATH, REPORT_PATH, MEMBERSHIP_PATH,
-                      CHAOS_PATH, WIRE_PATH, TRACING_PATH,
-                      FLEETMON_PATH, CENTER_PATH):
-            if probe not in lint_rels and \
-                    os.path.exists(os.path.join(root, probe)):
-                rels = list(rels) + [probe]
+    # EVERY file a disk-scoped checker loads beyond the lint selection
+    # (live-probe targets, the key_extra vocabulary, ops/ kernels) must
+    # key the cache even on partial runs whose path set does not cover
+    # it — but they are NOT part of the linted set then, so no per-file
+    # entry may be stored for them (it would read as "no findings" to a
+    # later full run).  Omitting one would let a stale tree hit mask a
+    # drift the checker exists to catch.  Checkers declare the set via
+    # ``Checker.disk_scoped`` (paths or glob patterns).
+    disk_extra: List[str] = []
+    for name in selected:
+        for pat in CHECKERS[name].disk_scoped:
+            if any(ch in pat for ch in "*?["):
+                import glob as _glob
+                probes = sorted(
+                    os.path.relpath(m, root).replace(os.sep, "/")
+                    for m in _glob.glob(os.path.join(root, pat))
+                    if m.endswith(".py"))
+            else:
+                probes = [pat]
+            for probe in probes:
+                if probe not in lint_rels and probe not in disk_extra \
+                        and os.path.exists(os.path.join(root, probe)):
+                    disk_extra.append(probe)
+    if disk_extra:
+        rels = list(rels) + disk_extra
     hashes = cache_mod.file_hashes(root, rels)
     afp = cache_mod.analysis_fingerprint()
     store = cache_mod.LintCache(root, cache_dir)
@@ -172,6 +179,46 @@ def _cached_run(root, paths, only, disable, cache_dir=None):
     return findings, "miss"
 
 
+def _sarif_log(new: List[Finding]) -> dict:
+    """Minimal SARIF 2.1.0 log over the NEW findings (the baseline is
+    tpulint's own suppression layer — CI annotates what would fail the
+    gate).  ``ruleId`` is the checker name; ``partialFingerprints``
+    carries each finding's stable id so SARIF consumers track a finding
+    across runs the way the baseline does."""
+    rule_ids = sorted({f.check for f in new})
+    rules = [{
+        "id": rid,
+        "shortDescription": {
+            "text": CHECKERS[rid].description if rid in CHECKERS
+            else rid},
+    } for rid in rule_ids]
+    results = [{
+        "ruleId": f.check,
+        "level": "error",
+        "message": {"text": f.message},
+        "locations": [{
+            "physicalLocation": {
+                "artifactLocation": {"uri": f.path},
+                "region": {"startLine": max(f.line, 1),
+                           "startColumn": max(f.col + 1, 1)},
+            },
+        }],
+        "partialFingerprints": {"tpulintFingerprint/v1": f.stable_id},
+    } for f in new]
+    return {
+        "$schema": "https://json.schemastore.org/sarif-2.1.0.json",
+        "version": "2.1.0",
+        "runs": [{
+            "tool": {"driver": {
+                "name": "tpulint",
+                "informationUri": "docs/design.md",
+                "rules": rules,
+            }},
+            "results": results,
+        }],
+    }
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     ap = argparse.ArgumentParser(
         prog="lint.py",
@@ -182,8 +229,10 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap.add_argument("--root", default=None,
                     help="repo root (default: inferred from this file)")
     ap.add_argument("--format", default=None, dest="fmt",
-                    choices=("human", "json"),
-                    help="output format (default: human)")
+                    choices=("human", "json", "sarif"),
+                    help="output format (default: human; sarif emits "
+                         "a SARIF 2.1.0 log of the NEW findings for "
+                         "CI diff annotation)")
     ap.add_argument("--json", action="store_true", dest="as_json",
                     help="alias for --format json")
     ap.add_argument("--only", default=None,
@@ -318,7 +367,9 @@ def main(argv: Optional[List[str]] = None) -> int:
                   "with a TODO placeholder — each needs a justification "
                   "(--verbose lists them)", file=sys.stderr)
 
-    if as_json:
+    if args.fmt == "sarif":
+        print(json.dumps(_sarif_log(new), indent=2, sort_keys=True))
+    elif as_json:
         def enrich(f: Finding) -> dict:
             d = f.to_dict()
             d["fingerprint"] = f.stable_id

@@ -199,12 +199,17 @@ class Checker:
     restricted to it (``--only schema-drift``, the shim's mode) skips
     the repo-wide parse — and its parse-error findings — entirely.
     ``needs_engine = True`` asks the runner for the shared call-graph
-    index."""
+    index.  ``disk_scoped`` lists repo-relative paths (or glob patterns)
+    the checker reads beyond the lint selection — the runner folds them
+    into partial runs (``--diff``, explicit paths) and into the result
+    cache's content hash so a disk-scoped checker can neither miss its
+    context nor serve stale cached verdicts."""
 
     name = "checker"
     description = ""
     reads_files = True
     needs_engine = False
+    disk_scoped: Sequence[str] = ()
 
     def applies_to(self, path: str) -> bool:
         return True

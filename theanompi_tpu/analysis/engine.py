@@ -109,6 +109,175 @@ def collective_name(resolved: Optional[str]) -> Optional[str]:
 
 
 # ---------------------------------------------------------------------------
+# compile-surface vocabulary (docs/design.md §26)
+# ---------------------------------------------------------------------------
+
+#: Receiver names whose subscripts / ``.get`` reads count as config-knob
+#: reads: the tail of the dotted receiver (``config``, ``self.config``,
+#: ``model.config``, ``cfg``) — plus any local assigned from ``parse_kv``
+#: (the caller passes those in as ``extra_receivers``).
+CONFIG_RECEIVERS = {"config", "cfg"}
+
+#: Trace-shaping consumer slots that must be STATIC at trace time — a
+#: host value landing here changes the traced program's shape (scan
+#: lengths, schedule tables, iota/zeros shapes, PartitionSpecs, jit
+#: donation/static signatures).  ``"all"`` marks every argument;
+#: otherwise a tuple of positional indices and keyword names.
+TRACE_SHAPE_SLOTS = {
+    "jax.lax.scan": ("length",),
+    "jax.numpy.arange": "all",
+    "jax.numpy.zeros": "all",
+    "jax.numpy.ones": "all",
+    "jax.numpy.full": (0, "shape"),
+    "jax.numpy.eye": "all",
+    "jax.numpy.reshape": (1, "newshape", "shape"),
+    "numpy.arange": "all",
+    "numpy.zeros": "all",
+    "numpy.ones": "all",
+    "numpy.full": (0, "shape"),
+    "jax.sharding.PartitionSpec": "all",
+    "theanompi_tpu.jax_compat.P": "all",
+    # repo-local schedule/plan builders: their scalar arguments bake
+    # host-side tables into the traced program (docs/design.md §26)
+    "theanompi_tpu.parallel.pipeline.build_schedule": "all",
+    "theanompi_tpu.parallel.update_sharding.plan_tree": "all",
+    "theanompi_tpu.parallel.buckets.plan_buckets": (1, "bucket_bytes"),
+}
+
+#: Predicate/selector slots: traced values are LEGAL here (``lax.cond``
+#: runs both branches), but a config knob baked into one still selects
+#: program behavior per compile — so the cache-key pass treats them as
+#: trace-shaping while the retrace pass does not.
+TRACE_PRED_SLOTS = {
+    "jax.lax.cond": (0, "pred"),
+    "jax.lax.switch": (0, "index"),
+    "jax.lax.fori_loop": (0, 1, "lower", "upper"),
+}
+
+#: Method names whose arguments are shape slots on any receiver.
+TRACE_SHAPE_METHODS = {"reshape", "broadcast_to"}
+
+#: ``jax.jit`` keywords whose values shape the compiled signature.
+TRACE_JIT_KWARGS = {"static_argnums", "static_argnames",
+                    "donate_argnums", "donate_argnames"}
+
+#: Attribute reads that are aval-static on a tracer — ``x.shape[0]`` in
+#: a reshape is shape arithmetic over the ALREADY-compiled signature,
+#: not a host value, so their bases never count as shaping uses.
+AVAL_ATTRS = {"shape", "ndim", "size", "dtype"}
+
+#: Dtypes the dtype-flow pass treats as low-precision wire formats.
+LOW_PRECISION_DTYPES = {"bfloat16", "float16", "float8_e4m3fn",
+                        "float8_e5m2"}
+
+_DTYPE_MODULES = ("jax.numpy.", "numpy.", "jax.dtypes.")
+
+
+def config_knob(node: ast.AST,
+                extra_receivers: Optional[Set[str]] = None
+                ) -> Optional[str]:
+    """The knob string of a config read expression — ``config["x"]``,
+    ``cfg.get("x", d)``, ``self.config.get("x")`` — or None.  A dotted
+    receiver matches when its last segment is in :data:`CONFIG_RECEIVERS`
+    or the whole chain is in ``extra_receivers`` (parse_kv locals)."""
+    recv = key = None
+    if isinstance(node, ast.Subscript):
+        recv = node.value
+        if isinstance(node.slice, ast.Constant) and \
+                isinstance(node.slice.value, str):
+            key = node.slice.value
+    elif isinstance(node, ast.Call) and \
+            isinstance(node.func, ast.Attribute) and \
+            node.func.attr == "get" and node.args:
+        recv = node.func.value
+        a0 = node.args[0]
+        if isinstance(a0, ast.Constant) and isinstance(a0.value, str):
+            key = a0.value
+    if recv is None or key is None:
+        return None
+    dotted = ImportResolver.dotted(recv)
+    if dotted is None:
+        return None
+    if dotted.rsplit(".", 1)[-1] in CONFIG_RECEIVERS or \
+            (extra_receivers and dotted in extra_receivers):
+        return key
+    return None
+
+
+def shaping_slot_exprs(call: ast.Call, resolver: ImportResolver,
+                       preds: bool = True):
+    """``(expr, slot description)`` for every argument of ``call``
+    occupying a trace-shaping slot.  ``preds=False`` restricts to the
+    shape-static slots (the retrace pass)."""
+    resolved = resolver.resolve(call.func)
+    out = []
+
+    def take(slots, label):
+        if slots == "all":
+            for a in call.args:
+                out.append((a, label))
+            for kw in call.keywords:
+                if kw.arg is not None:
+                    out.append((kw.value, label))
+            return
+        for s in slots:
+            if isinstance(s, int):
+                if s < len(call.args):
+                    out.append((call.args[s], label))
+            else:
+                for kw in call.keywords:
+                    if kw.arg == s:
+                        out.append((kw.value, label))
+
+    if resolved in TRACE_SHAPE_SLOTS:
+        take(TRACE_SHAPE_SLOTS[resolved], f"`{resolved.rsplit('.', 1)[-1]}`")
+    elif preds and resolved in TRACE_PRED_SLOTS:
+        take(TRACE_PRED_SLOTS[resolved], f"`{resolved.rsplit('.', 1)[-1]}`")
+    elif resolved == "jax.jit":
+        for kw in call.keywords:
+            if kw.arg in TRACE_JIT_KWARGS:
+                out.append((kw.value, f"`jax.jit({kw.arg}=…)`"))
+    elif resolved is None and isinstance(call.func, ast.Attribute) and \
+            call.func.attr in TRACE_SHAPE_METHODS:
+        for a in call.args:
+            out.append((a, f"`.{call.func.attr}()`"))
+    return out
+
+
+def bare_names(expr: ast.AST) -> List[ast.Name]:
+    """Name loads in ``expr``, excluding bases of aval-attribute chains
+    (``x.shape[0]`` is static per-aval, not a host value)."""
+    out: List[ast.Name] = []
+
+    def visit(n: ast.AST) -> None:
+        if isinstance(n, ast.Attribute) and n.attr in AVAL_ATTRS:
+            return
+        if isinstance(n, ast.Name):
+            out.append(n)
+        for c in ast.iter_child_nodes(n):
+            visit(c)
+
+    visit(expr)
+    return out
+
+
+def static_dtype(node: ast.AST, resolver: ImportResolver
+                 ) -> Optional[str]:
+    """The simple dtype name of a statically-resolved dtype expression
+    (``jnp.bfloat16``, ``np.float16``, ``"bfloat16"``), else None —
+    dynamic wire dtypes (``self.wire_dtype``) resolve to nothing and are
+    deliberately not guessed."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    resolved = resolver.resolve(node)
+    if resolved:
+        for mod in _DTYPE_MODULES:
+            if resolved.startswith(mod):
+                return resolved[len(mod):]
+    return None
+
+
+# ---------------------------------------------------------------------------
 # records and summaries
 # ---------------------------------------------------------------------------
 
@@ -342,6 +511,7 @@ class ProgramIndex:
         self._callees_cache: Dict[int, List[FuncRecord]] = {}
         self._summary_cache: Dict[int, FuncSummary] = {}
         self._key_params_cache: Optional[Dict[int, Set[int]]] = None
+        self._shaping_params_cache: Dict[bool, Dict[int, Set[int]]] = {}
         self._transitive_cache: Dict[int, TransitiveSummary] = {}
 
     # -- construction ------------------------------------------------------
@@ -742,6 +912,110 @@ class ProgramIndex:
                                 if j not in cur:
                                     cur.add(j)
                                     changed = True
+        return out
+
+    def shaping_params(self, rec: FuncRecord, preds: bool = True
+                       ) -> Set[int]:
+        """Parameter positions this function spends in trace-shaping
+        slots — directly, or by passing them into a callee that does
+        (fixpoint, like :meth:`key_params`).  ``preds=False`` restricts
+        to the shape-static slots (the retrace-hazard pass); the default
+        also counts predicate/selector slots (the cache-key pass)."""
+        if preds not in self._shaping_params_cache:
+            self._shaping_params_cache[preds] = \
+                self._compute_shaping_params(preds)
+        return self._shaping_params_cache[preds].get(id(rec.node), set())
+
+    def _compute_shaping_params(self, preds: bool) -> Dict[int, Set[int]]:
+        out: Dict[int, Set[int]] = {}
+        for rec in self.records.values():
+            params = rec.params()
+            if not params:
+                continue
+            direct: Set[int] = set()
+            for sub in body_walk(rec.node):
+                if not isinstance(sub, ast.Call):
+                    continue
+                for expr, _why in shaping_slot_exprs(sub, rec.sf.resolver,
+                                                     preds=preds):
+                    for nm in bare_names(expr):
+                        if nm.id in params:
+                            direct.add(params.index(nm.id))
+            if direct:
+                out[id(rec.node)] = direct
+        changed = True
+        while changed:
+            changed = False
+            for rec in self.records.values():
+                params = rec.params()
+                if not params:
+                    continue
+                idx = self.file_index[rec.sf.path]
+                ctor_types = None
+                for sub in body_walk(rec.node):
+                    if not isinstance(sub, ast.Call):
+                        continue
+                    enc = idx.enclosing.get(id(sub.func), rec.node)
+                    if ctor_types is None:
+                        ctor_types = self._local_ctor_types(rec)
+                    for tgt in self.resolve_call(rec.sf, sub.func, enc,
+                                                 ctor_types):
+                        tgt_sp = out.get(id(tgt.node))
+                        if not tgt_sp:
+                            continue
+                        tparams = tgt.params()
+                        for i in tgt_sp:
+                            arg = sub.args[i] if i < len(sub.args) else None
+                            for kw in sub.keywords:
+                                if i < len(tparams) and \
+                                        kw.arg == tparams[i]:
+                                    arg = kw.value
+                            if arg is None:
+                                continue
+                            for nm in bare_names(arg):
+                                if nm.id in params:
+                                    j = params.index(nm.id)
+                                    cur = out.setdefault(id(rec.node),
+                                                         set())
+                                    if j not in cur:
+                                        cur.add(j)
+                                        changed = True
+        return out
+
+    def shaping_use_sites(self, rec: FuncRecord, preds: bool = True,
+                          deep: bool = False):
+        """``(expr, why)`` for every expression in ``rec``'s body that
+        occupies a trace-shaping slot — the direct consumer slots plus
+        arguments feeding a callee parameter the callee spends in one.
+        ``deep=True`` walks nested defs too (closure-variable flows:
+        a knob read at build level consumed inside the traced inner
+        function)."""
+        idx = self.file_index[rec.sf.path]
+        resolver = rec.sf.resolver
+        ctor_types = None
+        out = []
+        walk = ast.walk(rec.node) if deep else body_walk(rec.node)
+        for sub in walk:
+            if not isinstance(sub, ast.Call):
+                continue
+            out.extend(shaping_slot_exprs(sub, resolver, preds=preds))
+            enc = idx.enclosing.get(id(sub.func), rec.node)
+            if ctor_types is None:
+                ctor_types = self._local_ctor_types(rec)
+            for tgt in self.resolve_call(rec.sf, sub.func, enc,
+                                         ctor_types):
+                sp = self.shaping_params(tgt, preds=preds)
+                if not sp:
+                    continue
+                tparams = tgt.params()
+                for i in sp:
+                    arg = sub.args[i] if i < len(sub.args) else None
+                    for kw in sub.keywords:
+                        if i < len(tparams) and kw.arg == tparams[i]:
+                            arg = kw.value
+                    if arg is not None:
+                        out.append(
+                            (arg, f"`{tgt.name}({tparams[i]}=…)`"))
         return out
 
     def transitive_summary(self, rec: FuncRecord) -> TransitiveSummary:

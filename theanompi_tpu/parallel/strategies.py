@@ -53,6 +53,15 @@ from ..utils import helper_funcs
 from ..ops import compress as compress_ops
 from . import buckets
 
+#: Deliberate non-bit-exact rounding sites, audited by tpulint's
+#: dtype-flow checker (docs/design.md §26) — every direct
+#: ``.astype(a).astype(b)`` round-trip must carry an entry here.
+NONBITEXACT = {
+    "Ring.__call__": "owned chunk is rounded to the wire dtype before "
+                     "the allgather so every rank (owner included) "
+                     "holds the identical bit pattern",
+}
+
 
 class Strategy:
     """Base: callable ``(tree, state, axis, size) -> (mean_tree, new_state)``

@@ -230,9 +230,15 @@ def key_extra(fn: str, model=None, exchanger=None,
             # is part of the identity.  Stamped only when the knob is on —
             # every pre-existing key (zero_opt sessions included) stays
             # byte-stable.
-            from ..parallel import update_sharding as _us
-            extra["ushard"] = int(model.config.get(
-                "ushard_min_bytes", _us.DEFAULT_MIN_BYTES))
+            mb = model.config.get("ushard_min_bytes")
+            if mb is None:
+                # update_sharding imports jax at module scope — resolve
+                # its default only when the config doesn't pin one, so
+                # jax-free callers (the schema-drift key_extra probe)
+                # can build extras without a backend
+                from ..parallel import update_sharding as _us
+                mb = _us.DEFAULT_MIN_BYTES
+            extra["ushard"] = int(mb)
     if spc is not None:
         extra["spc"] = int(spc)
     if exchanger is not None:
@@ -430,7 +436,7 @@ class CompileCache:
             self._write_entry(key, label, payload, in_tree, out_tree,
                               _mesh_device(mesh))
             self._record_manifest(key, label, compile_secs, len(payload),
-                                  mesh, compiled=compiled)
+                                  mesh, compiled=compiled, extra=extra)
             info["serialized"] = True
         except Exception as e:
             # rung 4: the backend (or this program shape) can't serialize —
@@ -465,7 +471,7 @@ class CompileCache:
             pass                              # metadata only — never fatal
 
     def _record_manifest(self, key, label, compile_secs, nbytes, mesh,
-                         compiled=None):
+                         compiled=None, extra=None):
         jax_v, jaxlib_v = _versions()
         dev = _mesh_device(mesh)
         m = self._load_manifest()
@@ -474,6 +480,11 @@ class CompileCache:
                   "platform": getattr(dev, "platform", "?"),
                   "device_kind": getattr(dev, "device_kind", "?"),
                   "created": time.time(), "hits": 0}
+        if extra:
+            # the key_extra dict that went into the program key, so
+            # `scripts/explain_program.py --diff` can name WHICH knob
+            # split two entries instead of shrugging at opaque hashes
+            m[key]["extra"] = dict(extra)
         if compiled is not None:
             # cost/memory summary taken at write time, so a later cache
             # HIT still tells you what you're running (flops, bytes, HBM
